@@ -1,0 +1,33 @@
+"""nanofed_tpu — a TPU-native federated learning framework.
+
+A ground-up re-design of the capabilities of NanoFed (camille-004/nanofed) for JAX/XLA:
+clients are a named mesh axis, local SGD runs under ``jit``+``vmap``, and FedAvg is a
+``psum``-weighted mean over ICI instead of JSON over HTTP.  See SURVEY.md for the full
+mapping to the reference.
+"""
+
+from nanofed_tpu.core import (
+    ClientData,
+    ClientMetrics,
+    ClientUpdates,
+    ModelUpdate,
+    ModelVersion,
+    NanoFedError,
+)
+from nanofed_tpu.utils import Logger, LogConfig, get_current_time, log_exec
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ClientData",
+    "ClientMetrics",
+    "ClientUpdates",
+    "LogConfig",
+    "Logger",
+    "ModelUpdate",
+    "ModelVersion",
+    "NanoFedError",
+    "__version__",
+    "get_current_time",
+    "log_exec",
+]

@@ -1,0 +1,55 @@
+"""Core contracts and value types (parity: ``nanofed/core/__init__.py``)."""
+
+from nanofed_tpu.core.exceptions import (
+    AggregationError,
+    CheckpointError,
+    CommunicationError,
+    ModelManagerError,
+    NanoFedError,
+    PrivacyError,
+    SecurityError,
+    TrainingError,
+    ValidationError,
+)
+from nanofed_tpu.core.interfaces import (
+    AggregatorProtocol,
+    CoordinatorProtocol,
+    LocalFitFn,
+    ModelManagerProtocol,
+    ModelProtocol,
+    ServerProtocol,
+)
+from nanofed_tpu.core.types import (
+    ClientData,
+    ClientMetrics,
+    ClientUpdates,
+    ModelUpdate,
+    ModelVersion,
+    Params,
+    PRNGKey,
+)
+
+__all__ = [
+    "AggregationError",
+    "AggregatorProtocol",
+    "CheckpointError",
+    "ClientData",
+    "ClientMetrics",
+    "ClientUpdates",
+    "CommunicationError",
+    "CoordinatorProtocol",
+    "LocalFitFn",
+    "ModelManagerError",
+    "ModelManagerProtocol",
+    "ModelProtocol",
+    "ModelUpdate",
+    "ModelVersion",
+    "NanoFedError",
+    "Params",
+    "PRNGKey",
+    "PrivacyError",
+    "SecurityError",
+    "ServerProtocol",
+    "TrainingError",
+    "ValidationError",
+]

@@ -1,0 +1,89 @@
+"""Structural typing contracts.
+
+Parity with ``nanofed/core/interfaces.py:13-67``, re-expressed for a functional JAX stack:
+the reference's Protocols describe *objects* (a torch ``nn.Module``, a trainer class); here
+models are ``(init, apply)`` pure-function pairs and trainers are pure ``local_fit``
+functions, so the Protocols describe those callables plus the host-side services
+(model store, coordinator, transport server) that remain object-shaped.
+
+Note: the reference misspells ``AggregatorProtoocol`` (``core/interfaces.py:23``) — fixed
+here, capability unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Protocol, runtime_checkable
+
+import jax
+
+from nanofed_tpu.core.types import (
+    ClientData,
+    ClientMetrics,
+    ClientUpdates,
+    ModelVersion,
+    Params,
+    PRNGKey,
+)
+
+
+@runtime_checkable
+class ModelProtocol(Protocol):
+    """A model as a pure init/apply pair (replaces the torch ``nn.Module`` protocol,
+    ``nanofed/core/interfaces.py:13-21``)."""
+
+    name: str
+
+    def init(self, rng: PRNGKey) -> Params: ...
+
+    def apply(
+        self, params: Params, x: jax.Array, *, train: bool = False, rng: PRNGKey | None = None
+    ) -> jax.Array: ...
+
+
+class LocalFitFn(Protocol):
+    """Client-side local training as a pure function (replaces ``TrainerProtocol``,
+    ``nanofed/core/interfaces.py:29-34``).
+
+    Must be jit-compatible: called under ``vmap`` over the client axis inside the round
+    step.  Returns the locally-trained parameters and the client's metrics.
+    """
+
+    def __call__(
+        self, params: Params, data: ClientData, rng: PRNGKey
+    ) -> tuple[Params, ClientMetrics]: ...
+
+
+class AggregatorProtocol(Protocol):
+    """Server-side combination of client results into the new global model
+    (replaces ``AggregatorProtoocol`` [sic], ``nanofed/core/interfaces.py:23-27``).
+
+    A strategy is a pure function over stacked client params — not a class hierarchy —
+    so it can run inside ``shard_map`` as a ``psum`` over the client mesh axis.
+    """
+
+    def __call__(self, global_params: Params, updates: ClientUpdates) -> Params: ...
+
+
+class ModelManagerProtocol(Protocol):
+    """Versioned persistence of the global model (parity:
+    ``nanofed/core/interfaces.py:36-50``)."""
+
+    def save_model(self, params: Params, metadata: dict[str, Any] | None = None) -> ModelVersion: ...
+
+    def load_model(self, version_id: str | None = None) -> tuple[Params, ModelVersion]: ...
+
+    def list_versions(self) -> list[ModelVersion]: ...
+
+
+class CoordinatorProtocol(Protocol):
+    """The round engine (parity: ``nanofed/core/interfaces.py:52-57``)."""
+
+    def run(self) -> Iterator[Any]: ...
+
+
+class ServerProtocol(Protocol):
+    """Optional transport front-end (parity: ``nanofed/core/interfaces.py:59-67``)."""
+
+    async def start(self) -> None: ...
+
+    async def stop(self) -> None: ...

@@ -1,0 +1,30 @@
+"""Data loading, client partitioning, and SPMD batching
+(parity+: ``nanofed/data/__init__.py`` exports only ``load_mnist_data``)."""
+
+from nanofed_tpu.data.batching import federate, pack_clients, pack_eval
+from nanofed_tpu.data.datasets import (
+    Dataset,
+    load_cifar,
+    load_mnist,
+    synthetic_classification,
+)
+from nanofed_tpu.data.partition import (
+    dirichlet_partition,
+    iid_partition,
+    label_skew_partition,
+    subset_iid,
+)
+
+__all__ = [
+    "Dataset",
+    "dirichlet_partition",
+    "federate",
+    "iid_partition",
+    "label_skew_partition",
+    "load_cifar",
+    "load_mnist",
+    "pack_clients",
+    "pack_eval",
+    "subset_iid",
+    "synthetic_classification",
+]

@@ -1,0 +1,55 @@
+"""Model abstraction: a named pure ``(init, apply)`` pair plus a registry.
+
+Replaces the reference's ``nn.Module`` subclassing (``nanofed/models/mnist.py:6``) with
+functional models whose parameters are explicit pytrees — the property that lets a round of
+federated training be a single jitted SPMD program over the client mesh axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+
+from nanofed_tpu.core.types import Params, PRNGKey
+
+InitFn = Callable[[PRNGKey], Params]
+# apply(params, x, train=..., rng=...) -> logits (or log-probs)
+ApplyFn = Callable[..., jax.Array]
+
+
+@dataclass(frozen=True)
+class Model:
+    """A model family member: ``init`` builds params from an rng; ``apply`` is the pure
+    forward pass (``train=True`` enables dropout and requires ``rng``)."""
+
+    name: str
+    init: InitFn
+    apply: ApplyFn
+    input_shape: tuple[int, ...] = field(default=())  # per-example shape, e.g. (28, 28, 1)
+    num_classes: int = 0
+
+
+_REGISTRY: dict[str, Callable[..., Model]] = {}
+
+
+def register_model(name: str) -> Callable[[Callable[..., Model]], Callable[..., Model]]:
+    """Decorator registering a model factory under ``name``."""
+
+    def deco(factory: Callable[..., Model]) -> Callable[..., Model]:
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs) -> Model:
+    """Build a registered model by name."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,46 @@
+"""Small linear / MLP models, used by unit tests the way the reference uses tiny
+``nn.Linear`` fixtures (``tests/unit/trainer/test_base_trainer.py:23-50``)."""
+
+from __future__ import annotations
+
+import jax
+
+from nanofed_tpu import nn
+from nanofed_tpu.core.types import Params, PRNGKey
+from nanofed_tpu.models.base import Model, register_model
+
+
+@register_model("linear")
+def linear(in_features: int = 10, num_classes: int = 2) -> Model:
+    def init(rng: PRNGKey) -> Params:
+        return {"fc": nn.dense_init(rng, in_features, num_classes)}
+
+    def apply(params: Params, x: jax.Array, *, train: bool = False, rng=None) -> jax.Array:
+        return nn.log_softmax(nn.dense(params["fc"], x))
+
+    return Model(
+        name="linear",
+        init=init,
+        apply=apply,
+        input_shape=(in_features,),
+        num_classes=num_classes,
+    )
+
+
+@register_model("mlp")
+def mlp(in_features: int = 784, hidden: int = 128, num_classes: int = 10) -> Model:
+    def init(rng: PRNGKey) -> Params:
+        k1, k2 = jax.random.split(rng)
+        return {
+            "fc1": nn.dense_init(k1, in_features, hidden),
+            "fc2": nn.dense_init(k2, hidden, num_classes),
+        }
+
+    def apply(params: Params, x: jax.Array, *, train: bool = False, rng=None) -> jax.Array:
+        x = nn.flatten(x) if x.ndim > 2 else x
+        x = nn.relu(nn.dense(params["fc1"], x))
+        return nn.log_softmax(nn.dense(params["fc2"], x))
+
+    return Model(
+        name="mlp", init=init, apply=apply, input_shape=(in_features,), num_classes=num_classes
+    )

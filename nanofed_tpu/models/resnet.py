@@ -1,0 +1,95 @@
+"""CIFAR-style ResNets with GroupNorm, for the FedProx / cross-silo benchmark configs.
+
+These models do not exist in the reference (its only model is the MNIST CNN,
+``nanofed/models/mnist.py:6-28``); they are required by the benchmark list in
+``BASELINE.json`` ("FedProx on CIFAR-10 ResNet-8", "cross-silo ResNet-18 on CIFAR-100").
+GroupNorm replaces BatchNorm because batch statistics are mutable state and are biased
+under non-IID federated clients.
+
+ResNet-8 is the CIFAR ResNet-(6n+2) family with n=1 (stages 16/32/64, one basic block
+each); ResNet-18 is the standard 4-stage/2-block layout with a 3x3 CIFAR stem.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from nanofed_tpu import nn
+from nanofed_tpu.core.types import Params, PRNGKey
+from nanofed_tpu.models.base import Model, register_model
+
+
+def _block_init(rng: PRNGKey, cin: int, cout: int) -> Params:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    p: Params = {
+        "conv1": nn.conv2d_init(k1, cin, cout, 3, use_bias=False),
+        "gn1": nn.group_norm_init(cout),
+        "conv2": nn.conv2d_init(k2, cout, cout, 3, use_bias=False),
+        "gn2": nn.group_norm_init(cout),
+    }
+    if cin != cout:
+        p["proj"] = nn.conv2d_init(k3, cin, cout, 1, use_bias=False)
+    return p
+
+
+def _block_apply(p: Params, x: jax.Array, stride: int) -> jax.Array:
+    out = nn.conv2d(p["conv1"], x, stride=stride, padding="SAME")
+    out = nn.relu(nn.group_norm(p["gn1"], out))
+    out = nn.conv2d(p["conv2"], out, stride=1, padding="SAME")
+    out = nn.group_norm(p["gn2"], out)
+    if "proj" in p:
+        x = nn.conv2d(p["proj"], x, stride=stride, padding="SAME")
+    return nn.relu(out + x)
+
+
+def _resnet(
+    name: str,
+    stage_channels: Sequence[int],
+    blocks_per_stage: int,
+    num_classes: int,
+    stem_channels: int,
+) -> Model:
+    def init(rng: PRNGKey) -> Params:
+        n_blocks = len(stage_channels) * blocks_per_stage
+        keys = jax.random.split(rng, n_blocks + 2)
+        params: Params = {
+            "stem": nn.conv2d_init(keys[0], 3, stem_channels, 3, use_bias=False),
+            "gn_stem": nn.group_norm_init(stem_channels),
+        }
+        cin = stem_channels
+        ki = 1
+        for si, cout in enumerate(stage_channels):
+            for bi in range(blocks_per_stage):
+                params[f"s{si}b{bi}"] = _block_init(keys[ki], cin, cout)
+                cin = cout
+                ki += 1
+        params["fc"] = nn.dense_init(keys[-1], cin, num_classes)
+        return params
+
+    def apply(params: Params, x: jax.Array, *, train: bool = False, rng=None) -> jax.Array:
+        x = nn.conv2d(params["stem"], x, padding="SAME")
+        x = nn.relu(nn.group_norm(params["gn_stem"], x))
+        for si in range(len(stage_channels)):
+            for bi in range(blocks_per_stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x = _block_apply(params[f"s{si}b{bi}"], x, stride)
+        x = nn.global_avg_pool(x)
+        return nn.log_softmax(nn.dense(params["fc"], x))
+
+    return Model(
+        name=name, init=init, apply=apply, input_shape=(32, 32, 3), num_classes=num_classes
+    )
+
+
+@register_model("resnet8")
+def resnet8(num_classes: int = 10) -> Model:
+    """ResNet-8 for CIFAR-10 (FedProx benchmark config)."""
+    return _resnet("resnet8", (16, 32, 64), 1, num_classes, stem_channels=16)
+
+
+@register_model("resnet18")
+def resnet18(num_classes: int = 100) -> Model:
+    """ResNet-18 for CIFAR-100 (cross-silo benchmark config)."""
+    return _resnet("resnet18", (64, 128, 256, 512), 2, num_classes, stem_channels=64)

@@ -1,0 +1,197 @@
+"""Minimal functional neural-net layer library.
+
+The reference builds models on ``torch.nn`` (``nanofed/models/mnist.py:6-28``).  Here models
+are pure ``(init, apply)`` functions over explicit parameter pytrees — no module objects, no
+mutable state — which is what lets a whole client population train under one
+``vmap``/``shard_map`` program.  Layout is NHWC (channels-last), the native layout for TPU
+convolutions; matmuls/convs stay large and batched so XLA tiles them onto the MXU.
+
+Normalization is GroupNorm rather than BatchNorm: batch statistics are both mutable state
+(breaking pure-function training) and statistically wrong under non-IID federated clients,
+so GroupNorm is the standard choice in FL (cf. FedProx/LEAF practice).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanofed_tpu.core.types import Params, PRNGKey
+
+# ---------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------
+
+
+def _fan_in_out(shape: Sequence[int]) -> tuple[int, int]:
+    if len(shape) == 2:  # dense [in, out]
+        return shape[0], shape[1]
+    # conv [kh, kw, cin, cout]
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def kaiming_uniform(rng: PRNGKey, shape: Sequence[int], dtype=jnp.float32) -> jax.Array:
+    """Kaiming uniform with torch's default bound: torch initializes Conv2d/Linear with
+    ``kaiming_uniform_(a=sqrt(5))`` which reduces to U(-1/sqrt(fan_in), 1/sqrt(fan_in)),
+    so training dynamics are comparable to the reference CNN."""
+    fan_in, _ = _fan_in_out(shape)
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(rng, shape, dtype, -bound, bound)
+
+
+def uniform_bias(rng: PRNGKey, fan_in: int, shape: Sequence[int], dtype=jnp.float32) -> jax.Array:
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(rng, shape, dtype, -bound, bound)
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+
+def dense_init(rng: PRNGKey, in_features: int, out_features: int, dtype=jnp.float32) -> Params:
+    k_w, k_b = jax.random.split(rng)
+    return {
+        "kernel": kaiming_uniform(k_w, (in_features, out_features), dtype),
+        "bias": uniform_bias(k_b, in_features, (out_features,), dtype),
+    }
+
+
+def dense(params: Params, x: jax.Array) -> jax.Array:
+    return x @ params["kernel"] + params["bias"]
+
+
+# ---------------------------------------------------------------------------
+# Conv2d (NHWC, HWIO kernels)
+# ---------------------------------------------------------------------------
+
+
+def conv2d_init(
+    rng: PRNGKey,
+    in_channels: int,
+    out_channels: int,
+    kernel_size: int | tuple[int, int],
+    dtype=jnp.float32,
+    use_bias: bool = True,
+) -> Params:
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+    k_w, k_b = jax.random.split(rng)
+    params = {"kernel": kaiming_uniform(k_w, (kh, kw, in_channels, out_channels), dtype)}
+    if use_bias:
+        params["bias"] = uniform_bias(k_b, in_channels * kh * kw, (out_channels,), dtype)
+    return params
+
+
+def conv2d(
+    params: Params,
+    x: jax.Array,
+    *,
+    stride: int | tuple[int, int] = 1,
+    padding: str = "VALID",
+) -> jax.Array:
+    """NHWC convolution via ``lax.conv_general_dilated`` — lowers straight to the MXU."""
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    out = lax.conv_general_dilated(
+        x,
+        params["kernel"],
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in params:
+        out = out + params["bias"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+def max_pool(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Array:
+    stride = window if stride is None else stride
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def avg_pool(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Array:
+    stride = window if stride is None else stride
+    summed = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+    return summed / (window * window)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    """[N, H, W, C] -> [N, C]."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Dropout (functional — rng passed in, no state)
+# ---------------------------------------------------------------------------
+
+
+def dropout(rng: PRNGKey | None, x: jax.Array, rate: float, train: bool) -> jax.Array:
+    """Inverted dropout; identity when ``train`` is False or rate == 0.
+
+    The reference model uses rates .25/.5 (``nanofed/models/mnist.py:12-13``).
+    """
+    if not train or rate == 0.0:
+        return x
+    if rng is None:
+        raise ValueError("dropout in train mode requires an rng key")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# GroupNorm
+# ---------------------------------------------------------------------------
+
+
+def group_norm_init(num_channels: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((num_channels,), dtype), "bias": jnp.zeros((num_channels,), dtype)}
+
+
+def group_norm(params: Params, x: jax.Array, num_groups: int = 8, eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over NHWC input."""
+    n, h, w, c = x.shape
+    g = min(num_groups, c)
+    while c % g != 0:  # pragma: no cover - configs keep c % g == 0
+        g -= 1
+    xg = x.reshape(n, h, w, g, c // g)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    return xg.reshape(n, h, w, c) * params["scale"] + params["bias"]
+
+
+# ---------------------------------------------------------------------------
+# Activations / outputs
+# ---------------------------------------------------------------------------
+
+relu = jax.nn.relu
+log_softmax = jax.nn.log_softmax
+
+
+def flatten(x: jax.Array) -> jax.Array:
+    """[N, ...] -> [N, prod(...)]."""
+    return x.reshape(x.shape[0], -1)

@@ -1,0 +1,48 @@
+"""Utilities: logging, timing, dates, and pytree arithmetic.
+
+Parity surface: ``nanofed/utils/__init__.py:1-4`` exports ``Logger``, ``LogConfig``,
+``log_exec``, ``get_current_time``; this package adds the pytree helpers the functional
+stack is built on.
+"""
+
+from nanofed_tpu.utils.dates import get_current_time
+from nanofed_tpu.utils.logger import LogConfig, Logger, log_exec
+from nanofed_tpu.utils.trees import (
+    tree_add,
+    tree_cast,
+    tree_clip_by_global_norm,
+    tree_flatten_with_names,
+    tree_global_norm,
+    tree_map_with_path_names,
+    tree_ravel,
+    tree_scale,
+    tree_size,
+    tree_sq_norm,
+    tree_sub,
+    tree_vdot,
+    tree_weighted_mean,
+    tree_where,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "Logger",
+    "LogConfig",
+    "log_exec",
+    "get_current_time",
+    "tree_add",
+    "tree_cast",
+    "tree_clip_by_global_norm",
+    "tree_flatten_with_names",
+    "tree_global_norm",
+    "tree_map_with_path_names",
+    "tree_ravel",
+    "tree_scale",
+    "tree_size",
+    "tree_sq_norm",
+    "tree_sub",
+    "tree_vdot",
+    "tree_weighted_mean",
+    "tree_where",
+    "tree_zeros_like",
+]

@@ -1,0 +1,10 @@
+"""UTC time helper. Parity: ``nanofed/utils/dates.py:4-5``."""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+
+def get_current_time() -> datetime:
+    """Timezone-aware UTC now."""
+    return datetime.now(timezone.utc)

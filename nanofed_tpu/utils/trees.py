@@ -1,0 +1,126 @@
+"""Pytree arithmetic used across the framework.
+
+These replace the reference's per-key Python dict loops over torch state_dicts (e.g. the
+FedAvg reduce at ``nanofed/server/aggregator/fedavg.py:56-63`` and DP clipping at
+``nanofed/privacy/mechanisms.py:85-104``) with ``jax.tree_util`` transforms that XLA fuses
+into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def tree_zeros_like(tree: PyTree) -> PyTree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: PyTree, s: jax.Array | float) -> PyTree:
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_where(pred: jax.Array, a: PyTree, b: PyTree) -> PyTree:
+    """Leafwise ``where(pred, a, b)`` with a scalar/broadcastable predicate."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def tree_vdot(a: PyTree, b: PyTree) -> jax.Array:
+    """Sum of elementwise products across all leaves (a full inner product)."""
+    leaves = jax.tree.leaves(jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b))
+    return jnp.sum(jnp.stack(leaves))
+
+
+def tree_sq_norm(tree: PyTree) -> jax.Array:
+    """Squared global L2 norm over every leaf."""
+    leaves = jax.tree.leaves(jax.tree.map(lambda x: jnp.sum(jnp.square(x)), tree))
+    return jnp.sum(jnp.stack(leaves))
+
+
+def tree_global_norm(tree: PyTree) -> jax.Array:
+    """Global L2 norm over all leaves — the quantity torch's ``clip_grad_norm_`` computes
+    in the reference's DP clipping (``nanofed/trainer/private.py:54-63``)."""
+    return jnp.sqrt(tree_sq_norm(tree))
+
+
+def tree_clip_by_global_norm(tree: PyTree, max_norm: float | jax.Array) -> tuple[PyTree, jax.Array]:
+    """Scale ``tree`` so its global norm is at most ``max_norm``.
+
+    Returns ``(clipped, pre_clip_norm)``.  Parity with
+    ``nanofed/privacy/mechanisms.py:85-104`` (clip coefficient ``C / (norm + 1e-6)``
+    capped at 1).
+    """
+    norm = tree_global_norm(tree)
+    coef = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return tree_scale(tree, coef), norm
+
+
+def tree_weighted_mean(stacked: PyTree, weights: jax.Array, eps: float = 1e-12) -> PyTree:
+    """Weighted mean over the leading axis of every leaf.
+
+    ``stacked`` has leaves ``[C, ...]``; ``weights`` is ``[C]``.  This is the whole FedAvg
+    reduce (``nanofed/server/aggregator/fedavg.py:46-78``) as one fused contraction per
+    leaf instead of a Python loop over clients and keys.
+    """
+    total = weights.sum()
+    denom = jnp.maximum(total, eps)
+
+    def leaf_mean(leaf: jax.Array) -> jax.Array:
+        w = weights.astype(leaf.dtype).reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.sum(leaf * w, axis=0) / denom.astype(leaf.dtype)
+
+    return jax.tree.map(leaf_mean, stacked)
+
+
+def tree_map_with_path_names(fn: Callable[[str, jax.Array], Any], tree: PyTree) -> PyTree:
+    """Map with a '/'-joined string path per leaf (used by persistence and validation)."""
+
+    def _fn(path, leaf):
+        name = "/".join(_key_str(k) for k in path)
+        return fn(name, leaf)
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)
+
+
+def _key_str(k: Any) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def tree_flatten_with_names(tree: PyTree) -> tuple[list[tuple[str, jax.Array]], Any]:
+    """Flatten to ``[(path_name, leaf), ...]`` plus the treedef, for serialization."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    named = [("/".join(_key_str(k) for k in path), leaf) for path, leaf in flat]
+    return named, treedef
+
+
+def tree_size(tree: PyTree) -> int:
+    """Total number of scalar parameters."""
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_cast(tree: PyTree, dtype: Any) -> PyTree:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_ravel(tree: PyTree) -> tuple[jax.Array, Callable[[jax.Array], PyTree]]:
+    """Flatten a pytree into one 1-D vector plus an unravel function."""
+    return jax.flatten_util.ravel_pytree(tree)

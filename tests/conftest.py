@@ -1,0 +1,51 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the TPU build's "fake backend" (SURVEY.md §4): where the reference mocks aiohttp
+sessions, we simulate the device mesh with ``--xla_force_host_platform_device_count=8`` so
+every ``shard_map``/collective path runs for real, just on CPU.
+"""
+
+import os
+
+# Force CPU even when the environment pre-sets a TPU platform (e.g. JAX_PLATFORMS=axon):
+# unit tests must exercise the multi-device code path, which needs 8 virtual devices.
+# NOTE: a sitecustomize may import jax at interpreter startup (before this file), so env
+# vars alone are too late for config-bound values — set the config explicitly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Unregister accelerator PJRT plugins that a sitecustomize may have registered: their
+# client init dials real hardware (and hangs the whole test run if the device tunnel is
+# busy/wedged) even under JAX_PLATFORMS=cpu.  Tests run exclusively on the virtual CPU mesh.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _plat in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_plat, None)
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.key(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,157 @@
+"""Data layer tests: loaders (IDX round-trip), partitioner properties, packing masks.
+
+Analogs: ``nanofed/data/mnist.py`` subset behavior; the padded packing is new TPU-side
+capability whose mask/weight accounting the aggregation correctness depends on.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.data import (
+    dirichlet_partition,
+    federate,
+    iid_partition,
+    label_skew_partition,
+    load_mnist,
+    pack_clients,
+    pack_eval,
+    subset_iid,
+    synthetic_classification,
+)
+
+
+# ---------------------------------------------------------------------------
+# Datasets
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_deterministic_and_learnable_shape():
+    d1 = synthetic_classification(100, 10, (28, 28, 1), seed=5)
+    d2 = synthetic_classification(100, 10, (28, 28, 1), seed=5)
+    np.testing.assert_array_equal(d1.x, d2.x)
+    assert d1.x.shape == (100, 28, 28, 1)
+    assert d1.y.min() >= 0 and d1.y.max() <= 9
+    assert set(np.unique(d1.y)).issubset(set(range(10)))
+
+
+def test_mnist_synthetic_fallback():
+    d = load_mnist("train", data_dir=None, synthetic_size=50)
+    assert d.x.shape == (50, 28, 28, 1)
+    assert d.num_classes == 10
+
+
+def _write_idx(path, arr):
+    ndim = arr.ndim
+    magic = (0x08 << 8) | ndim  # ubyte type
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", magic))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_idx_loading(tmp_path):
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28) % 255
+    lbls = np.array([3, 7], dtype=np.uint8)
+    _write_idx(tmp_path / "train-images-idx3-ubyte.gz", imgs)
+    _write_idx(tmp_path / "train-labels-idx1-ubyte.gz", lbls)
+    d = load_mnist("train", data_dir=tmp_path)
+    assert d.x.shape == (2, 28, 28, 1)
+    np.testing.assert_array_equal(d.y, [3, 7])
+    # Normalization applied: pixel 0 -> (0 - .1307)/.3081
+    assert d.x.min() == pytest.approx((0 - 0.1307) / 0.3081, abs=1e-4)
+
+
+def test_mnist_no_fallback_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_mnist("train", data_dir=tmp_path, synthetic_fallback=False)
+
+
+# ---------------------------------------------------------------------------
+# Partitioners
+# ---------------------------------------------------------------------------
+
+
+def test_iid_partition_covers_everything():
+    parts = iid_partition(100, 7, seed=1)
+    allidx = np.concatenate(parts)
+    assert sorted(allidx) == list(range(100))
+
+
+def test_iid_partition_proportions():
+    # The reference example's 12k/8k/4k split as fractions (run_experiment.py:126-131).
+    parts = iid_partition(600, 3, proportions=[0.2, 0.4, 0.1])
+    assert [len(p) for p in parts] == [120, 240, 60]
+    assert len(np.unique(np.concatenate(parts))) == 420  # disjoint
+
+
+def test_subset_iid_parity():
+    idx = subset_iid(1000, 0.25, seed=3)
+    assert len(idx) == 250
+    assert len(np.unique(idx)) == 250
+    with pytest.raises(ValueError):
+        subset_iid(10, 0.0)
+
+
+def test_label_skew_limits_classes_per_client():
+    y = np.repeat(np.arange(10), 50)  # 500 samples, 10 classes
+    parts = label_skew_partition(y, num_clients=10, shards_per_client=2, seed=0)
+    classes_per_client = [len(np.unique(y[p])) for p in parts]
+    assert max(classes_per_client) <= 3  # 2 shards ≈ ≤3 classes with boundary overlap
+    assert sum(len(p) for p in parts) == 500
+
+
+def test_dirichlet_partition_coverage_and_skew():
+    y = np.repeat(np.arange(10), 100)
+    parts = dirichlet_partition(y, num_clients=5, alpha=0.1, seed=0)
+    assert sum(len(p) for p in parts) == 1000
+    # Strong skew: some client concentrates a class heavily.
+    props = []
+    for p in parts:
+        counts = np.bincount(y[p], minlength=10)
+        props.append(counts.max() / max(1, counts.sum()))
+    assert max(props) > 0.4
+
+
+# ---------------------------------------------------------------------------
+# Packing
+# ---------------------------------------------------------------------------
+
+
+def test_pack_clients_masks_and_counts():
+    d = synthetic_classification(30, 3, (4,), seed=0)
+    parts = [np.arange(10), np.arange(10, 25), np.arange(25, 30)]
+    cd = pack_clients(d, parts, batch_size=4)
+    assert isinstance(cd, ClientData)
+    # capacity = 15 rounded up to multiple of 4 = 16
+    assert cd.x.shape == (3, 16, 4)
+    np.testing.assert_array_equal(np.asarray(cd.num_samples), [10, 15, 5])
+    # padded region is zeros with mask 0
+    assert cd.mask[0, 10:].sum() == 0
+    assert np.all(cd.x[0, 10:] == 0)
+
+
+def test_pack_real_samples_roundtrip():
+    d = synthetic_classification(12, 3, (2,), seed=1)
+    parts = [np.array([0, 5, 7])]
+    cd = pack_clients(d, parts, batch_size=1)
+    np.testing.assert_array_equal(cd.x[0, :3], d.x[[0, 5, 7]])
+    np.testing.assert_array_equal(cd.y[0, :3], d.y[[0, 5, 7]])
+
+
+def test_pack_eval_pads_to_batch():
+    d = synthetic_classification(10, 2, (3,), seed=2)
+    ed = pack_eval(d, batch_size=4)
+    assert ed.x.shape == (12, 3)
+    assert float(np.asarray(ed.mask).sum()) == 10.0
+
+
+def test_federate_one_call():
+    d = synthetic_classification(64, 4, (3,), seed=3)
+    cd = federate(d, num_clients=4, scheme="iid", batch_size=8)
+    assert cd.x.shape[0] == 4
+    assert float(np.asarray(cd.num_samples).sum()) == 64.0

@@ -1,0 +1,50 @@
+"""Logger parity tests (analog: the reference's singleton/context behaviors,
+``nanofed/utils/logger.py:59-88``)."""
+
+import asyncio
+import logging
+
+from nanofed_tpu.utils import LogConfig, Logger, log_exec
+
+
+def test_singleton():
+    assert Logger() is Logger()
+
+
+def test_context_stack_and_file_output(tmp_path):
+    log_file = tmp_path / "out.log"
+    log = Logger()
+    log.configure(LogConfig(level=logging.DEBUG, console=False, file_path=log_file))
+    with log.context("server"):
+        with log.context("aggregator"):
+            log.info("hello %d", 7)
+    log.configure(LogConfig(console=False))  # detach file handler before reading
+    text = log_file.read_text()
+    assert "server.aggregator" in text
+    assert "hello 7" in text
+
+
+def test_log_exec_sync(tmp_path):
+    log_file = tmp_path / "t.log"
+    Logger().configure(LogConfig(level=logging.DEBUG, console=False, file_path=log_file))
+
+    @log_exec
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    Logger().configure(LogConfig(console=False))
+    assert "Completed" in log_file.read_text()
+
+
+def test_log_exec_async(tmp_path):
+    log_file = tmp_path / "t.log"
+    Logger().configure(LogConfig(level=logging.DEBUG, console=False, file_path=log_file))
+
+    @log_exec
+    async def f(x):
+        return x * 2
+
+    assert asyncio.run(f(3)) == 6
+    Logger().configure(LogConfig(console=False))
+    assert "f in" in log_file.read_text()

@@ -1,0 +1,86 @@
+"""Pytree arithmetic unit tests (analog of the reference's exact-value aggregator tests,
+``tests/unit/server/aggregator/test_fedavg.py:21-76``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.utils import trees
+
+
+def _tree(a, b):
+    return {"w": jnp.asarray(a, jnp.float32), "b": {"x": jnp.asarray(b, jnp.float32)}}
+
+
+def test_global_norm_exact():
+    t = _tree([3.0], [4.0])
+    assert float(trees.tree_global_norm(t)) == pytest.approx(5.0)
+
+
+def test_clip_by_global_norm_scales_down():
+    t = _tree([3.0], [4.0])
+    clipped, norm = trees.tree_clip_by_global_norm(t, 1.0)
+    assert float(norm) == pytest.approx(5.0)
+    assert float(trees.tree_global_norm(clipped)) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_clip_by_global_norm_noop_below_threshold():
+    t = _tree([0.3], [0.4])
+    clipped, _ = trees.tree_clip_by_global_norm(t, 10.0)
+    np.testing.assert_allclose(clipped["w"], t["w"])
+
+
+def test_weighted_mean_exact():
+    # Two "clients" with weights 1 and 2: mean = (1*a + 2*b) / 3.
+    stacked = {"w": jnp.asarray([[3.0], [6.0]])}
+    out = trees.tree_weighted_mean(stacked, jnp.asarray([1.0, 2.0]))
+    assert float(out["w"][0]) == pytest.approx((3.0 + 12.0) / 3.0)
+
+
+def test_weighted_mean_ignores_zero_weight_clients():
+    stacked = {"w": jnp.asarray([[1.0], [999.0]])}
+    out = trees.tree_weighted_mean(stacked, jnp.asarray([1.0, 0.0]))
+    assert float(out["w"][0]) == pytest.approx(1.0)
+
+
+def test_weighted_mean_all_zero_weights_is_finite():
+    stacked = {"w": jnp.asarray([[1.0], [2.0]])}
+    out = trees.tree_weighted_mean(stacked, jnp.asarray([0.0, 0.0]))
+    assert np.isfinite(np.asarray(out["w"])).all()
+
+
+def test_ravel_roundtrip():
+    t = _tree([[1.0, 2.0], [3.0, 4.0]], [5.0])
+    vec, unravel = trees.tree_ravel(t)
+    assert vec.shape == (5,)
+    t2 = unravel(vec)
+    np.testing.assert_allclose(t2["b"]["x"], t["b"]["x"])
+    np.testing.assert_allclose(t2["w"], t["w"])
+
+
+def test_flatten_with_names():
+    t = _tree([1.0], [2.0])
+    named, _ = trees.tree_flatten_with_names(t)
+    names = [n for n, _ in named]
+    assert names == ["b/x", "w"]
+
+
+def test_where_selects_trees():
+    a, b = _tree([1.0], [1.0]), _tree([2.0], [2.0])
+    out = trees.tree_where(jnp.asarray(True), a, b)
+    assert float(out["w"][0]) == 1.0
+    out = trees.tree_where(jnp.asarray(False), a, b)
+    assert float(out["w"][0]) == 2.0
+
+
+def test_size_and_cast():
+    t = _tree([[1.0, 2.0]], [3.0])
+    assert trees.tree_size(t) == 3
+    c = trees.tree_cast(t, jnp.bfloat16)
+    assert all(leaf.dtype == jnp.bfloat16 for leaf in jax_leaves(c))
+
+
+def jax_leaves(t):
+    import jax
+
+    return jax.tree.leaves(t)
