@@ -1,0 +1,92 @@
+"""Benchmark: federated MNIST round wall-clock vs the reference's published number.
+
+The reference's only recorded perf number is the MNIST tutorial's round-0 wall-clock:
+53.48 s for 2 clients x 2 local epochs (12k + 4k samples, batch 64, SGD lr=0.1, ~1.2M-param
+CNN) on CPU (``examples/mnist/tutorial.ipynb`` cell-17; see BASELINE.md).  This benchmark
+runs the SAME logical workload — identical model architecture, client sample counts, local
+epochs, batch size, optimizer — as one jitted SPMD round on the TPU chip and reports the
+wall-clock of a steady-state round (compile excluded; the reference number also excludes
+torch import/setup).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where vs_baseline is the
+speedup factor (reference seconds / ours).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REFERENCE_ROUND_S = 53.48  # tutorial.ipynb cell-17: "Completed train_round in 53.48s"
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+    from nanofed_tpu.data import pack_clients, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.parallel import (
+        build_round_step,
+        init_server_state,
+        make_mesh,
+        pad_client_count,
+        pad_clients,
+        replicated_sharding,
+        shard_client_data,
+    )
+    from nanofed_tpu.trainer import TrainingConfig, stack_rngs
+
+    # Tutorial-parity workload: 2 clients with 12k / 4k MNIST-shaped samples.
+    model = get_model("mnist_cnn")
+    ds = synthetic_classification(16_000, 10, (28, 28, 1), seed=0)
+    parts = [np.arange(0, 12_000), np.arange(12_000, 16_000)]
+    batch, epochs = 64, 2
+    data = pack_clients(ds, parts, batch_size=batch)
+
+    mesh = make_mesh()
+    n_dev = len(mesh.devices.flat)
+    padded = pad_client_count(len(parts), n_dev)
+    data = pad_clients(data, padded)
+    data = shard_client_data(data, mesh)
+
+    training = TrainingConfig(batch_size=batch, local_epochs=epochs, learning_rate=0.1)
+    strategy = fedavg_strategy()
+    step = build_round_step(model.apply, training, mesh, strategy, donate=True)
+
+    repl = replicated_sharding(mesh)
+    params = jax.device_put(model.init(jax.random.key(0)), repl)
+    sos = jax.device_put(init_server_state(strategy, params), repl)
+    num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1))
+    weights = compute_weights(num_samples) * (num_samples > 0)
+
+    # Warm-up round: triggers XLA compile, excluded from timing.
+    res = step(params, sos, data, weights, stack_rngs(jax.random.key(0), padded))
+    params, sos = res.params, res.server_opt_state
+    jax.block_until_ready(params)
+
+    times = []
+    for r in range(1, 4):
+        t0 = time.perf_counter()
+        res = step(params, sos, data, weights, stack_rngs(jax.random.key(r), padded))
+        params, sos = res.params, res.server_opt_state
+        jax.block_until_ready(params)
+        times.append(time.perf_counter() - t0)
+
+    value = float(np.median(times))
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_fedavg_round_walltime_2clients_parity",
+                "value": round(value, 4),
+                "unit": "s",
+                "vs_baseline": round(REFERENCE_ROUND_S / value, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Server-side aggregation (parity: ``nanofed/server/aggregator/__init__.py`` exports
+BaseAggregator/FedAvgAggregator; privacy-aware and secure aggregation live in
+``nanofed_tpu.privacy`` and ``nanofed_tpu.security``)."""
+
+from nanofed_tpu.aggregation.base import (
+    AggregationResult,
+    Strategy,
+    fedadam_strategy,
+    fedavg_strategy,
+    fedavgm_strategy,
+    validate_updates,
+)
+from nanofed_tpu.aggregation.fedavg import (
+    aggregate_metrics,
+    compute_weights,
+    fedavg_combine,
+    psum_weighted_mean,
+    psum_weighted_metrics,
+)
+
+__all__ = [
+    "AggregationResult",
+    "Strategy",
+    "aggregate_metrics",
+    "compute_weights",
+    "fedadam_strategy",
+    "fedavg_strategy",
+    "fedavgm_strategy",
+    "fedavg_combine",
+    "psum_weighted_mean",
+    "psum_weighted_metrics",
+    "validate_updates",
+]

@@ -1,0 +1,79 @@
+"""Aggregation contracts and validation.
+
+The reference models aggregation as a class hierarchy over dict state_dicts
+(``nanofed/server/aggregator/base.py:14-82``).  Here an aggregation *strategy* is data: a
+weighting rule plus an optax server optimizer applied to the aggregated client delta.
+``new_global = global + server_opt(weighted_mean_k(params_k - global))`` — with SGD(1.0)
+this is algebraically exactly FedAvg (the weighted mean of client params), and any optax
+transform upgrades it to FedAvgM / FedAdam (Reddi et al. 2021) for free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import optax
+
+from nanofed_tpu.core.exceptions import AggregationError
+from nanofed_tpu.core.types import ClientUpdates, Params
+
+
+@dataclass(frozen=True)
+class AggregationResult:
+    """Parity with ``AggregationResult`` (``nanofed/server/aggregator/base.py:14-22``):
+    the new global params plus round bookkeeping and weighted-mean client metrics."""
+
+    params: Params
+    round_number: int
+    num_clients: int
+    metrics: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Strategy:
+    """A named server-side update rule.  ``server_tx`` consumes the *negative* aggregated
+    delta (so optax's gradient-descent convention applies it additively)."""
+
+    name: str
+    server_tx: optax.GradientTransformation
+
+
+def fedavg_strategy() -> Strategy:
+    """Exact FedAvg: apply the aggregated delta verbatim
+    (parity: ``nanofed/server/aggregator/fedavg.py:46-78``)."""
+    return Strategy(name="fedavg", server_tx=optax.sgd(1.0))
+
+
+def fedavgm_strategy(learning_rate: float = 1.0, momentum: float = 0.9) -> Strategy:
+    """FedAvg with server momentum (Hsu et al. 2019) — new capability."""
+    return Strategy(name="fedavgm", server_tx=optax.sgd(learning_rate, momentum=momentum))
+
+
+def fedadam_strategy(
+    learning_rate: float = 1e-2, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3
+) -> Strategy:
+    """FedAdam (Reddi et al. 2021) — new capability."""
+    return Strategy(name="fedadam", server_tx=optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
+
+
+def validate_updates(updates: ClientUpdates, global_params: Params) -> None:
+    """Structural validation before aggregation.
+
+    Parity with ``BaseAggregator._validate_updates`` (``nanofed/server/aggregator/
+    base.py:41-57``): all clients must carry the same architecture as the global model.
+    Under the stacked layout this is one treedef/shape comparison, not a per-client loop.
+    Statistical/robustness checks live in ``nanofed_tpu.security.validation``.
+    """
+    g_leaves, g_def = jax.tree.flatten(global_params)
+    u_leaves, u_def = jax.tree.flatten(updates.params)
+    if g_def != u_def:
+        raise AggregationError(f"update tree structure mismatch: {u_def} != {g_def}")
+    c = updates.weights.shape[0]
+    for g, u in zip(g_leaves, u_leaves):
+        if u.shape != (c, *g.shape):
+            raise AggregationError(
+                f"update leaf shape {u.shape} incompatible with global {g.shape} "
+                f"and client count {c}"
+            )

@@ -1,0 +1,84 @@
+"""FedAvg reductions: host-side (stacked arrays) and in-mesh (``psum`` over the client
+axis).
+
+The reference's FedAvg is a Python double loop over clients and state-dict keys
+(``nanofed/server/aggregator/fedavg.py:56-63``) with weights proportional to sample counts
+(``:101-125``).  Here the same math is one contraction per pytree leaf; inside
+``shard_map`` the cross-device half of the reduction is an ICI ``psum`` — this is the wire
+protocol of the framework, replacing ``POST /update`` + JSON decode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, Params
+from nanofed_tpu.utils.trees import tree_weighted_mean
+
+
+def fedavg_combine(updates: ClientUpdates) -> Params:
+    """Sample-count-weighted mean of stacked client params (host/test path).
+
+    Exact parity with ``FedAvgAggregator.aggregate`` (``fedavg.py:46-78``).
+    """
+    return tree_weighted_mean(updates.params, updates.weights)
+
+
+def aggregate_metrics(metrics: ClientMetrics, weights: jax.Array) -> dict[str, jax.Array]:
+    """Weighted metric averaging, parity with ``_aggregate_metrics``
+    (``fedavg.py:80-99``)."""
+    den = jnp.maximum(weights.sum(), 1e-12)
+    return {
+        "loss": (metrics.loss * weights).sum() / den,
+        "accuracy": (metrics.accuracy * weights).sum() / den,
+        "samples": metrics.samples.sum(),
+    }
+
+
+def compute_weights(
+    num_samples: jax.Array, participation: jax.Array | None = None
+) -> jax.Array:
+    """FedAvg weights: proportional to client sample counts, zeroed for non-participants.
+
+    Parity: ``_compute_weights`` (``fedavg.py:101-125``) uses ``num_samples`` with a
+    default of 1.0 per client; partial participation (the reference's
+    ``min_completion_rate`` wait-barrier, ``coordinator.py:205-245``) is re-specified as a
+    mask — zero-weight clients drop out of the ``psum`` exactly like clients that never
+    reported drop out of the buffer.
+    """
+    w = jnp.maximum(num_samples, 1.0)
+    if participation is not None:
+        w = w * participation
+    return w
+
+
+def psum_weighted_mean(tree: Params, weights: jax.Array, axis_name: str) -> Params:
+    """In-mesh weighted mean over the client axis: local contraction then ICI ``psum``.
+
+    ``tree`` leaves are ``[C_local, ...]`` (this device's clients); ``weights`` is
+    ``[C_local]``.  Safe under all-zero weights (returns zeros).
+    """
+    den = lax.psum(weights.sum(), axis_name)
+    den = jnp.maximum(den, 1e-12)
+
+    def leaf_mean(leaf: jax.Array) -> jax.Array:
+        w = weights.astype(leaf.dtype)
+        local = jnp.tensordot(w, leaf, axes=1)
+        return lax.psum(local, axis_name) / den.astype(leaf.dtype)
+
+    return jax.tree.map(leaf_mean, tree)
+
+
+def psum_weighted_metrics(
+    metrics: ClientMetrics, weights: jax.Array, axis_name: str
+) -> dict[str, jax.Array]:
+    """In-mesh weighted metric means + total sample count (masked by participation)."""
+    den = jnp.maximum(lax.psum(weights.sum(), axis_name), 1e-12)
+    participating = (weights > 0).astype(metrics.samples.dtype)
+    return {
+        "loss": lax.psum((metrics.loss * weights).sum(), axis_name) / den,
+        "accuracy": lax.psum((metrics.accuracy * weights).sum(), axis_name) / den,
+        "samples": lax.psum((metrics.samples * participating).sum(), axis_name),
+    }

@@ -49,6 +49,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         data_dir=args.data_dir,
         out_dir=args.out_dir,
         seed=args.seed,
+        train_size=args.train_size,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -72,6 +73,10 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--data-dir", default=None)
     run.add_argument("--out-dir", default="runs")
     run.add_argument("--seed", type=int, default=0)
+    run.add_argument(
+        "--train-size", type=int, default=None,
+        help="cap the (synthetic) training set size; default = full dataset",
+    )
 
     args = parser.parse_args(argv)
     if args.cmd == "info":

@@ -1,0 +1,91 @@
+"""High-level experiment runner (the programmatic equivalent of the reference's
+``examples/mnist/run_experiment.py:89-131`` main, and the engine behind ``nanofed-tpu run``)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from nanofed_tpu.data import federate, load_cifar, load_mnist, pack_eval
+from nanofed_tpu.models import get_model
+from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig, RoundStatus
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.utils.logger import Logger
+
+
+def run_experiment(
+    model: str = "mnist_cnn",
+    num_clients: int = 10,
+    num_rounds: int = 2,
+    local_epochs: int = 2,
+    batch_size: int = 64,
+    learning_rate: float = 0.1,
+    scheme: str = "iid",
+    participation: float = 1.0,
+    data_dir: str | None = None,
+    out_dir: str | Path = "runs",
+    seed: int = 0,
+    prox_mu: float = 0.0,
+    eval_every: int = 0,
+    train_size: int | None = None,
+    **scheme_kwargs: Any,
+) -> dict[str, Any]:
+    """Run a full federated experiment; returns a summary dict."""
+    log = Logger()
+    mdl = get_model(model)
+    test_size = (train_size or 0) // 6 or None
+    if mdl.input_shape == (28, 28, 1):
+        train = load_mnist("train", data_dir, synthetic_size=train_size)
+        test = load_mnist("test", data_dir, synthetic_size=test_size)
+    elif mdl.input_shape == (32, 32, 3):
+        nc = mdl.num_classes
+        train = load_cifar("train", data_dir, num_classes=nc, synthetic_size=train_size)
+        test = load_cifar("test", data_dir, num_classes=nc, synthetic_size=test_size)
+    else:
+        from nanofed_tpu.data import synthetic_classification
+
+        train = synthetic_classification(
+            train_size or 4096, mdl.num_classes, mdl.input_shape, seed=seed
+        )
+        test = synthetic_classification(
+            test_size or 1024, mdl.num_classes, mdl.input_shape, seed=seed + 1
+        )
+    log.info("dataset %s: %d train / %d test samples", train.name, len(train), len(test))
+
+    client_data = federate(
+        train, num_clients=num_clients, scheme=scheme, batch_size=batch_size,
+        seed=seed, **scheme_kwargs,
+    )
+    coordinator = Coordinator(
+        model=mdl,
+        train_data=client_data,
+        config=CoordinatorConfig(
+            num_rounds=num_rounds,
+            participation_rate=participation,
+            seed=seed,
+            base_dir=out_dir,
+            eval_every=eval_every,
+        ),
+        training=TrainingConfig(
+            batch_size=batch_size,
+            local_epochs=local_epochs,
+            learning_rate=learning_rate,
+            prox_mu=prox_mu,
+        ),
+        eval_data=pack_eval(test, batch_size=256),
+    )
+    rounds = coordinator.run()
+    final_eval = coordinator.evaluate()
+    completed = [r for r in rounds if r.status == RoundStatus.COMPLETED]
+    return {
+        "model": model,
+        "num_clients": num_clients,
+        "rounds_completed": len(completed),
+        "rounds_failed": len(rounds) - len(completed),
+        "final_train_metrics": completed[-1].agg_metrics if completed else {},
+        "final_eval_metrics": final_eval,
+        "round_durations_s": [r.duration_s for r in rounds],
+        "devices": [str(d) for d in jax.devices()],
+    }

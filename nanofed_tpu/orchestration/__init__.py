@@ -1,0 +1,19 @@
+"""Round orchestration (parity: ``nanofed/orchestration/__init__.py`` exports
+Coordinator/CoordinatorConfig/coordinate and the round types)."""
+
+from nanofed_tpu.orchestration.coordinator import Coordinator, CoordinatorConfig
+from nanofed_tpu.orchestration.types import (
+    ClientInfo,
+    RoundMetrics,
+    RoundStatus,
+    TrainingProgress,
+)
+
+__all__ = [
+    "ClientInfo",
+    "Coordinator",
+    "CoordinatorConfig",
+    "RoundMetrics",
+    "RoundStatus",
+    "TrainingProgress",
+]

@@ -1,0 +1,62 @@
+"""Round / progress value types (parity: ``nanofed/orchestration/types.py:7-47``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+
+class RoundStatus(Enum):
+    """Parity with ``RoundStatus`` (``orchestration/types.py``)."""
+
+    PENDING = "pending"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+
+@dataclass(frozen=True)
+class ClientInfo:
+    """Host-side record of one simulated client (parity: ``ClientInfo``)."""
+
+    client_id: str
+    num_samples: int
+
+
+@dataclass(frozen=True)
+class RoundMetrics:
+    """One round's outcome (parity: ``RoundMetrics`` — round id, status, client count,
+    aggregated metrics — plus eval metrics and wall-clock, which the reference logs but
+    does not type)."""
+
+    round_id: int
+    status: RoundStatus
+    num_clients: int  # participating (completed) clients
+    agg_metrics: dict[str, float] = field(default_factory=dict)
+    eval_metrics: dict[str, float] = field(default_factory=dict)
+    duration_s: float = 0.0
+    timestamp: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "round_id": self.round_id,
+            "status": self.status.value,
+            "num_clients": self.num_clients,
+            "agg_metrics": self.agg_metrics,
+            "eval_metrics": self.eval_metrics,
+            "duration_s": self.duration_s,
+            "timestamp": self.timestamp,
+        }
+
+
+@dataclass(frozen=True)
+class TrainingProgress:
+    """Live progress snapshot (parity: ``TrainingProgress`` +
+    ``Coordinator.training_progress``, ``coordinator.py:181-190``)."""
+
+    current_round: int
+    total_rounds: int
+    completed_rounds: int
+    failed_rounds: int
+    global_metrics: dict[str, float] = field(default_factory=dict)

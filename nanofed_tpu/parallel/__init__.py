@@ -1,0 +1,34 @@
+"""SPMD parallelism: device mesh, client-axis sharding, and the jitted round step.
+
+This package is the TPU-native replacement for the reference's entire
+``nanofed/communication`` + polling layer: the client axis of the mesh is the federation,
+and ICI collectives are the transport (SURVEY.md §2, bottom rows).
+"""
+
+from nanofed_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    client_sharding,
+    make_mesh,
+    pad_client_count,
+    pad_clients,
+    replicated_sharding,
+    shard_client_data,
+)
+from nanofed_tpu.parallel.round_step import (
+    RoundStepResult,
+    build_round_step,
+    init_server_state,
+)
+
+__all__ = [
+    "CLIENT_AXIS",
+    "RoundStepResult",
+    "build_round_step",
+    "client_sharding",
+    "init_server_state",
+    "make_mesh",
+    "pad_client_count",
+    "pad_clients",
+    "replicated_sharding",
+    "shard_client_data",
+]

@@ -1,0 +1,120 @@
+"""The federated round as one jitted SPMD program.
+
+This module replaces the reference's entire round machinery — the 1 Hz polling barrier
+(``nanofed/orchestration/coordinator.py:205-245``), JSON weight deserialization
+(``:307-322``), the Python FedAvg loops (``server/aggregator/fedavg.py:56-63``), and the
+HTTP transport between them — with a single ``jit(shard_map(...))``:
+
+    per device:  vmap(local_fit) over its shard of clients      (MXU: batched SGD)
+    across mesh: psum-weighted mean of client deltas over ICI   (the "wire")
+    replicated:  server optimizer applies the aggregated delta  (FedAvg/FedAvgM/FedAdam)
+
+The round barrier is implicit in SPMD lockstep; partial participation is a zero-weight
+mask, not a timeout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
+from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
+from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
+from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.trainer.config import TrainingConfig
+from nanofed_tpu.trainer.local import GradFn, make_local_fit
+from nanofed_tpu.utils.trees import tree_sq_norm, tree_where
+
+
+class RoundStepResult(NamedTuple):
+    params: Params  # new global params (replicated)
+    server_opt_state: Any  # server optimizer state (replicated)
+    metrics: dict[str, jax.Array]  # weighted scalar metrics for the round
+    client_metrics: ClientMetrics  # per-client arrays [C] (for round metrics JSON parity)
+    update_sq_norms: jax.Array  # [C] squared L2 norm of each client's delta
+
+
+RoundStepFn = Callable[..., RoundStepResult]
+
+
+def build_round_step(
+    apply_fn: Callable[..., jax.Array],
+    training: TrainingConfig,
+    mesh: Mesh,
+    strategy: Strategy | None = None,
+    grad_fn: GradFn | None = None,
+    axis_name: str = CLIENT_AXIS,
+    donate: bool = False,
+) -> RoundStepFn:
+    """Compile the round function for a mesh.
+
+    Returns ``round_step(global_params, server_opt_state, data, weights, rngs)`` where
+    ``data`` leaves are ``[C, N, ...]`` sharded over ``axis_name``, ``weights`` is ``[C]``
+    (sample counts x participation mask — zero drops a client out of the reduction), and
+    ``rngs`` is ``[C]`` per-client keys.  Initialize ``server_opt_state`` with
+    ``init_server_state``.
+
+    ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
+    params-sized HBM copy per round) — the caller must then treat the inputs as consumed
+    and keep only the returned arrays, as ``Coordinator`` does.
+    """
+    strategy = strategy or fedavg_strategy()
+    local_fit = make_local_fit(apply_fn, training, grad_fn=grad_fn)
+    server_tx = strategy.server_tx
+
+    def shard_body(gp, sos, data: ClientData, weights, rngs):
+        # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
+        # device-varying, so cast explicitly for the vmapped compute path.
+        gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
+        result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
+        delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
+
+        total_w = lax.psum(weights.sum(), axis_name)
+        agg_delta = psum_weighted_mean(delta, weights, axis_name)
+        # optax convention: pass the NEGATIVE delta as "gradient" so SGD(1.0) applies
+        # +delta (exact FedAvg).  A round with zero total weight (no participants /
+        # all failed — the reference marks these FAILED, coordinator.py:295-304) must
+        # leave params AND server state untouched, even for stateful server optimizers.
+        neg_delta = jax.tree.map(jnp.negative, agg_delta)
+        updates, new_sos = server_tx.update(neg_delta, sos, gp)
+        ok = total_w > 0
+        new_gp = tree_where(ok, optax.apply_updates(gp, updates), gp)
+        new_sos = tree_where(ok, new_sos, sos)
+
+        metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
+        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        sq_norms = jax.vmap(tree_sq_norm)(delta)
+        return new_gp, new_sos, metrics, result.metrics, sq_norms
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def round_step(
+        global_params: Params,
+        server_opt_state: Any,
+        data: ClientData,
+        weights: jax.Array,
+        rngs: PRNGKey,
+    ) -> RoundStepResult:
+        gp, sos, metrics, client_metrics, sq_norms = sharded(
+            global_params, server_opt_state, data, weights, rngs
+        )
+        return RoundStepResult(gp, sos, metrics, client_metrics, sq_norms)
+
+    return round_step
+
+
+def init_server_state(strategy: Strategy, global_params: Params) -> Any:
+    return strategy.server_tx.init(global_params)

@@ -1,0 +1,31 @@
+"""Client-side training (parity: ``nanofed/trainer/__init__.py`` exports BaseTrainer/
+TorchTrainer/PrivateTrainer/TrainingConfig/Callback/MetricsLogger; the DP trainer lives in
+``nanofed_tpu.privacy.dp_trainer``)."""
+
+from nanofed_tpu.trainer.api import Trainer
+from nanofed_tpu.trainer.callbacks import BaseCallback, Callback, MetricsLogger
+from nanofed_tpu.trainer.config import TrainingConfig
+from nanofed_tpu.trainer.local import (
+    LocalFitResult,
+    StepStats,
+    make_evaluator,
+    make_grad_fn,
+    make_local_fit,
+    make_optimizer,
+    stack_rngs,
+)
+
+__all__ = [
+    "BaseCallback",
+    "Callback",
+    "LocalFitResult",
+    "MetricsLogger",
+    "StepStats",
+    "Trainer",
+    "TrainingConfig",
+    "make_evaluator",
+    "make_grad_fn",
+    "make_local_fit",
+    "make_optimizer",
+    "stack_rngs",
+]

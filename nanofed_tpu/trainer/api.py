@@ -1,0 +1,84 @@
+"""Host-facing Trainer: the standalone single-client API.
+
+The reference exposes ``TorchTrainer.train_epoch(model, loader, optimizer, epoch)`` driven
+by user code (``nanofed/trainer/base.py:116-198``, ``examples/mnist/run_experiment.py:75-78``).
+The equivalent here wraps the jitted ``local_fit``: one call runs all local epochs on
+device, then per-epoch/per-batch metric arrays are replayed into callbacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from nanofed_tpu.core.types import ClientData, Params, PRNGKey
+from nanofed_tpu.trainer.callbacks import Callback
+from nanofed_tpu.trainer.config import TrainingConfig
+from nanofed_tpu.trainer.local import GradFn, LocalFitResult, make_evaluator, make_local_fit
+from nanofed_tpu.utils.logger import Logger, log_exec
+
+
+class Trainer:
+    """Single-client trainer over a functional model.
+
+    >>> trainer = Trainer(model.apply, TrainingConfig(batch_size=64, local_epochs=2))
+    >>> params, metrics = trainer.fit(params, client_data, rng)
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[..., jax.Array],
+        config: TrainingConfig,
+        grad_fn: GradFn | None = None,
+        callbacks: Sequence[Callback] = (),
+    ) -> None:
+        self.config = config
+        self.callbacks = list(callbacks)
+        # collect_batch_metrics feeds on_batch_end; force it on when batch callbacks exist.
+        if self.callbacks and not config.collect_batch_metrics:
+            config = dataclasses.replace(config, collect_batch_metrics=True)
+            self.config = config
+        self._local_fit = jax.jit(make_local_fit(apply_fn, config, grad_fn=grad_fn))
+        self._evaluate = make_evaluator(apply_fn, batch_size=config.batch_size)
+
+    @log_exec(block=True)
+    def fit(
+        self, params: Params, data: ClientData, rng: PRNGKey
+    ) -> tuple[Params, dict[str, float]]:
+        """Run all local epochs; returns (new_params, final-epoch metrics dict)."""
+        result: LocalFitResult = self._local_fit(params, data, rng)
+        self._replay_callbacks(result)
+        m = result.metrics
+        return result.params, {
+            "loss": float(m.loss),
+            "accuracy": float(m.accuracy),
+            "samples_processed": int(m.samples),
+        }
+
+    def evaluate(self, params: Params, data: ClientData) -> dict[str, float]:
+        out = self._evaluate(params, data)
+        return {k: float(v) for k, v in out.items()}
+
+    def _replay_callbacks(self, result: LocalFitResult) -> None:
+        if not self.callbacks:
+            return
+        e_loss = np.asarray(result.epoch_loss)
+        e_acc = np.asarray(result.epoch_accuracy)
+        b_loss = np.asarray(result.batch_loss)
+        log = Logger()
+        with log.context("trainer"):
+            for e in range(len(e_loss)):
+                for cb in self.callbacks:
+                    cb.on_epoch_start(e)
+                if self.config.collect_batch_metrics:
+                    for b in range(b_loss.shape[1]):
+                        for cb in self.callbacks:
+                            cb.on_batch_end(e, b, {"loss": float(b_loss[e, b])})
+                for cb in self.callbacks:
+                    cb.on_epoch_end(
+                        e, {"loss": float(e_loss[e]), "accuracy": float(e_acc[e])}
+                    )
+                log.debug("epoch %d: loss=%.4f acc=%.4f", e, e_loss[e], e_acc[e])

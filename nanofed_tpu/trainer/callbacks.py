@@ -1,0 +1,73 @@
+"""Host-side training callbacks.
+
+The reference invokes ``Callback.on_epoch_start/on_epoch_end/on_batch_end`` inline in its
+Python batch loop (``nanofed/trainer/base.py:46-51,134-181``; note its Protocol misspells
+``on_eopch_start`` — fixed here).  In a jitted trainer there is no host code between
+batches, so callbacks are *metric sinks replayed after the fact*: ``local_fit`` returns
+per-epoch (and optionally per-batch) metric arrays, and the host ``Trainer`` feeds them to
+callbacks in order.  Observable behavior (files written, values seen) matches; the timing
+is post-hoc.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Callback(Protocol):
+    """Parity surface of ``nanofed/trainer/base.py:46-51``."""
+
+    def on_epoch_start(self, epoch: int) -> None: ...
+
+    def on_epoch_end(self, epoch: int, metrics: dict[str, Any]) -> None: ...
+
+    def on_batch_end(self, epoch: int, batch: int, metrics: dict[str, Any]) -> None: ...
+
+
+class BaseCallback:
+    """No-op base so subclasses override only what they need."""
+
+    def on_epoch_start(self, epoch: int) -> None:  # noqa: B027
+        pass
+
+    def on_epoch_end(self, epoch: int, metrics: dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+    def on_batch_end(self, epoch: int, batch: int, metrics: dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+
+class MetricsLogger(BaseCallback):
+    """JSON metrics file sink.
+
+    Parity with ``nanofed/trainer/callback.py:10-53`` (accumulates epoch/batch metrics and
+    rewrites one JSON file), but appends atomically once per epoch instead of rewriting on
+    every batch.
+    """
+
+    def __init__(self, path: str | Path, client_id: str = "client") -> None:
+        self._path = Path(path)
+        self._client_id = client_id
+        self._epochs: list[dict[str, Any]] = []
+        self._batches: list[dict[str, Any]] = []
+
+    def on_batch_end(self, epoch: int, batch: int, metrics: dict[str, Any]) -> None:
+        self._batches.append({"epoch": epoch, "batch": batch, **metrics})
+
+    def on_epoch_end(self, epoch: int, metrics: dict[str, Any]) -> None:
+        self._epochs.append({"epoch": epoch, **metrics})
+        self._flush()
+
+    def _flush(self) -> None:
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        payload = {
+            "client_id": self._client_id,
+            "epochs": self._epochs,
+            "batches": self._batches,
+        }
+        tmp = self._path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(payload, indent=2))
+        tmp.replace(self._path)

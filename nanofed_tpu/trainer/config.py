@@ -1,0 +1,44 @@
+"""Training configuration.
+
+Parity with ``TrainingConfig`` (``nanofed/trainer/base.py:16-24``: epochs, batch_size,
+learning_rate, device, max_batches, log_interval) — device/log_interval are meaningless in
+a jitted SPMD program and are replaced by TPU-relevant knobs (momentum/weight_decay/
+prox_mu/dtype).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, slots=True)
+class TrainingConfig:
+    """Static hyperparameters of local training (hashable: it is a jit-static argument).
+
+    ``prox_mu > 0`` turns FedAvg local training into FedProx (Li et al. 2020): the local
+    objective gains ``mu/2 * ||w - w_global||^2``, pulling client iterates toward the
+    round's starting point (new capability; required by BASELINE.json config #3).
+    ``collect_batch_metrics`` returns per-step loss curves for host-side batch callbacks
+    (parity with ``MetricsLogger.on_batch_end``, ``nanofed/trainer/callback.py:38-53``).
+    """
+
+    batch_size: int = 64
+    local_epochs: int = 1
+    learning_rate: float = 0.1
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    max_batches: int | None = None
+    prox_mu: float = 0.0
+    collect_batch_metrics: bool = False
+
+    def __post_init__(self) -> None:
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if self.local_epochs < 1:
+            raise ValueError("local_epochs must be >= 1")
+        if self.learning_rate <= 0:
+            raise ValueError("learning_rate must be > 0")
+        if self.max_batches is not None and self.max_batches < 1:
+            raise ValueError("max_batches must be >= 1 when set")
+        if self.prox_mu < 0:
+            raise ValueError("prox_mu must be >= 0")

@@ -20,13 +20,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Unregister accelerator PJRT plugins that a sitecustomize may have registered: their
+# Unregister the out-of-tree accelerator plugin a sitecustomize may have registered: its
 # client init dials real hardware (and hangs the whole test run if the device tunnel is
-# busy/wedged) even under JAX_PLATFORMS=cpu.  Tests run exclusively on the virtual CPU mesh.
+# busy/wedged).  Only the plugin is removed — built-in platform names (tpu/cuda/...) must
+# stay registered or MLIR lowering-rule registration rejects them as unknown platforms.
 from jax._src import xla_bridge as _xb  # noqa: E402
 
-for _plat in ("axon", "tpu", "cuda", "rocm"):
-    _xb._backend_factories.pop(_plat, None)
+_xb._backend_factories.pop("axon", None)
 
 jax.config.update("jax_threefry_partitionable", True)
 

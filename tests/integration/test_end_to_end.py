@@ -1,0 +1,147 @@
+"""End-to-end federated training on the CPU mesh — the replacement for the reference's
+``tests/integration/test_client_server_communication.py`` (which needed a live aiohttp
+server; here the transport is the mesh itself)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.data import federate, pack_eval, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig, RoundStatus
+from nanofed_tpu.trainer import TrainingConfig
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    return get_model("mlp", in_features=16, hidden=32, num_classes=4)
+
+
+def _data(n=1024, classes=4, feat=16, seed=0):
+    return synthetic_classification(n, classes, (feat,), seed=seed)
+
+
+def test_full_training_run_learns_and_writes_metrics(mlp, tmp_path, devices):
+    train = _data()
+    test = _data(n=256, seed=9)
+    cd = federate(train, num_clients=8, scheme="iid", batch_size=32)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(num_rounds=4, seed=0, base_dir=tmp_path, eval_every=2),
+        training=TrainingConfig(batch_size=32, local_epochs=2),
+        eval_data=pack_eval(test, batch_size=64),
+    )
+    rounds = coord.run()
+    assert len(rounds) == 4
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+    # Learning happened and generalized.
+    assert rounds[-1].agg_metrics["loss"] < rounds[0].agg_metrics["loss"]
+    final = coord.evaluate()
+    assert final["accuracy"] > 0.9
+
+    # Per-round metrics JSON parity (coordinator.py:247-280).
+    f = tmp_path / "metrics" / "metrics_round_2.json"
+    payload = json.loads(f.read_text())
+    assert payload["round_id"] == 2
+    assert payload["status"] == "completed"
+    assert len(payload["clients"]["weights"]) == 8
+    # round ids are 0-based; eval_every=2 evaluates after rounds 1 and 3, not 2.
+    assert payload["eval_metrics"] == {}
+    f3 = json.loads((tmp_path / "metrics" / "metrics_round_3.json").read_text())
+    assert "accuracy" in f3["eval_metrics"]
+
+
+def test_eval_every_schedule(mlp, tmp_path, devices):
+    cd = federate(_data(n=256), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(num_rounds=2, base_dir=tmp_path, eval_every=2),
+        training=TrainingConfig(batch_size=16),
+        eval_data=pack_eval(_data(n=128, seed=5), batch_size=64),
+    )
+    rounds = coord.run()
+    assert rounds[0].eval_metrics == {}
+    assert "accuracy" in rounds[1].eval_metrics
+
+
+def test_partial_participation_and_dropout_failed_rounds(mlp, tmp_path, devices):
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=6,
+            participation_rate=0.5,  # cohort of 4
+            dropout_rate=0.9,  # nearly everyone "times out"
+            min_completion_rate=0.75,  # needs 3/4 to survive
+            base_dir=tmp_path,
+        ),
+        training=TrainingConfig(batch_size=16),
+    )
+    rounds = coord.run()
+    failed = [r for r in rounds if r.status == RoundStatus.FAILED]
+    assert failed, "with 90% dropout some rounds must fail"
+    # Failed rounds leave the model untouched and carry no agg metrics.
+    assert all(r.agg_metrics == {} for r in failed)
+    progress = coord.training_progress
+    assert progress.failed_rounds == len(failed)
+    assert progress.completed_rounds == 6 - len(failed)
+
+
+def test_unequal_client_sizes(mlp, tmp_path, devices):
+    """The reference example's 12k/8k/4k pattern, scaled down: weights ∝ samples."""
+    from nanofed_tpu.data import iid_partition, pack_clients
+
+    ds = _data(n=700)
+    parts = iid_partition(700, 3, seed=0, proportions=[0.5, 0.3, 0.2])
+    cd = pack_clients(ds, parts, batch_size=16)
+    coord = Coordinator(
+        model=get_model("mlp", in_features=16, hidden=32, num_classes=4),
+        train_data=cd,  # 3 clients on 8 devices -> padded to 8
+        config=CoordinatorConfig(num_rounds=2, base_dir=tmp_path),
+        training=TrainingConfig(batch_size=16),
+    )
+    rounds = coord.run()
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+    assert rounds[0].agg_metrics["participating_clients"] == 3
+    payload = json.loads((tmp_path / "metrics" / "metrics_round_0.json").read_text())
+    w = np.asarray(payload["clients"]["weights"])
+    assert w[0] > w[1] > w[2] > 0
+    assert np.all(w[3:] == 0)  # padded dummy clients
+
+
+def test_label_skew_noniid_run(mlp, tmp_path, devices):
+    """Benchmark config #2 shape: non-IID label-skew with partial participation."""
+    cd = federate(
+        _data(n=512), num_clients=16, scheme="label_skew", batch_size=16, shards_per_client=2
+    )
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=3, participation_rate=0.25, base_dir=tmp_path, seed=1
+        ),
+        training=TrainingConfig(batch_size=16),
+    )
+    rounds = coord.run()
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+    assert all(r.agg_metrics["participating_clients"] == 4 for r in rounds)
+
+
+def test_run_experiment_cli_engine(tmp_path, devices):
+    from nanofed_tpu.experiments import run_experiment
+
+    out = run_experiment(
+        model="mlp",
+        num_clients=8,
+        num_rounds=2,
+        local_epochs=1,
+        batch_size=32,
+        out_dir=tmp_path,
+        train_size=512,
+    )
+    assert out["rounds_completed"] == 2
+    assert "accuracy" in out["final_eval_metrics"]

@@ -1,0 +1,121 @@
+"""Aggregation tests — exact weighted-mean values like the reference's
+``tests/unit/server/aggregator/test_fedavg.py:21-76``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nanofed_tpu.aggregation import (
+    aggregate_metrics,
+    compute_weights,
+    fedadam_strategy,
+    fedavg_combine,
+    fedavgm_strategy,
+    fedavg_strategy,
+    psum_weighted_mean,
+    validate_updates,
+)
+from nanofed_tpu.core.exceptions import AggregationError
+from nanofed_tpu.core.types import ClientMetrics, ClientUpdates
+from nanofed_tpu.parallel import make_mesh
+
+
+def _updates(params_list, weights, losses=None, accs=None, samples=None):
+    c = len(params_list)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+    return ClientUpdates(
+        params=stacked,
+        weights=jnp.asarray(weights, jnp.float32),
+        metrics=ClientMetrics(
+            loss=jnp.asarray(losses if losses is not None else [0.0] * c),
+            accuracy=jnp.asarray(accs if accs is not None else [0.0] * c),
+            samples=jnp.asarray(samples if samples is not None else [1.0] * c),
+        ),
+    )
+
+
+def test_fedavg_exact_weighted_average():
+    # Two clients, weights 1:2 — parity with the reference's exact assertions.
+    p1 = {"w": jnp.asarray([3.0, 0.0])}
+    p2 = {"w": jnp.asarray([6.0, 3.0])}
+    out = fedavg_combine(_updates([p1, p2], [1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out["w"]), [(3 + 12) / 3, (0 + 6) / 3])
+
+
+def test_metric_aggregation_exact():
+    # loss = 0.1 * 1/3 + 0.2 * 2/3 (the reference's documented example).
+    m = ClientMetrics(
+        loss=jnp.asarray([0.1, 0.2]),
+        accuracy=jnp.asarray([1.0, 0.4]),
+        samples=jnp.asarray([100.0, 200.0]),
+    )
+    out = aggregate_metrics(m, jnp.asarray([1.0, 2.0]))
+    assert float(out["loss"]) == pytest.approx(0.1 / 3 + 0.4 / 3)
+    assert float(out["accuracy"]) == pytest.approx(1 / 3 + 0.8 / 3)
+    assert float(out["samples"]) == 300.0
+
+
+def test_compute_weights_masking():
+    w = compute_weights(jnp.asarray([10.0, 20.0, 30.0]), jnp.asarray([1.0, 0.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(w), [10.0, 0.0, 30.0])
+
+
+def test_validate_updates_rejects_bad_tree():
+    good = {"w": jnp.zeros((2, 3))}
+    with pytest.raises(AggregationError):
+        validate_updates(
+            ClientUpdates(
+                params={"other": jnp.zeros((2, 3))},
+                weights=jnp.ones(2),
+                metrics=ClientMetrics(jnp.zeros(2), jnp.zeros(2), jnp.zeros(2)),
+            ),
+            {"w": jnp.zeros(3)},
+        )
+    with pytest.raises(AggregationError):
+        validate_updates(
+            ClientUpdates(
+                params={"w": jnp.zeros((2, 4))},
+                weights=jnp.ones(2),
+                metrics=ClientMetrics(jnp.zeros(2), jnp.zeros(2), jnp.zeros(2)),
+            ),
+            {"w": jnp.zeros(3)},
+        )
+    # Well-formed passes.
+    validate_updates(
+        ClientUpdates(
+            params=good,
+            weights=jnp.ones(2),
+            metrics=ClientMetrics(jnp.zeros(2), jnp.zeros(2), jnp.zeros(2)),
+        ),
+        {"w": jnp.zeros(3)},
+    )
+
+
+def test_psum_weighted_mean_matches_host(devices):
+    """The in-mesh reduction must equal the host-side weighted mean exactly."""
+    mesh = make_mesh()
+    c = 8
+    tree = {"w": jnp.arange(c * 3, dtype=jnp.float32).reshape(c, 3)}
+    weights = jnp.asarray([1.0, 2.0, 0.0, 1.0, 3.0, 1.0, 0.5, 2.5])
+
+    expected = np.asarray(
+        (tree["w"] * weights[:, None]).sum(0) / weights.sum()
+    )
+
+    def body(t, w):
+        return psum_weighted_mean(t, w, "clients")
+
+    out = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P("clients"), P("clients")), out_specs=P()
+        )
+    )(tree, weights)
+    np.testing.assert_allclose(np.asarray(out["w"]), expected, rtol=1e-6)
+
+
+def test_strategies_construct():
+    assert fedavg_strategy().name == "fedavg"
+    assert fedavgm_strategy().name == "fedavgm"
+    assert fedadam_strategy().name == "fedadam"

@@ -1,0 +1,143 @@
+"""SPMD round-step tests on the 8-device CPU mesh — the "fake backend" replacing the
+reference's mocked-aiohttp transport tests (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.aggregation import compute_weights, fedavg_strategy, fedavgm_strategy
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.data import federate, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.parallel import (
+    build_round_step,
+    init_server_state,
+    make_mesh,
+    pad_client_count,
+    pad_clients,
+    shard_client_data,
+)
+from nanofed_tpu.trainer import TrainingConfig, make_local_fit, stack_rngs
+from nanofed_tpu.utils.trees import tree_weighted_mean
+
+
+def _setup(num_clients=8, batch=16, n=512, classes=4, feat=8, seed=0):
+    m = get_model("mlp", in_features=feat, hidden=16, num_classes=classes)
+    ds = synthetic_classification(n, classes, (feat,), seed=seed)
+    cd = federate(ds, num_clients=num_clients, scheme="iid", batch_size=batch, seed=seed)
+    mesh = make_mesh()
+    return m, cd, mesh
+
+
+def test_round_step_matches_vmap_plus_host_mean(devices):
+    """SPMD result == (vmap local_fit, host weighted mean): the mesh reduction is exact."""
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=16, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    strat = fedavg_strategy()
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    sos = init_server_state(strat, params)
+    weights = compute_weights(jnp.asarray(cd.num_samples))
+    rngs = stack_rngs(jax.random.key(7), 8)
+
+    sharded = shard_client_data(cd, mesh)
+    res = step(params, sos, sharded, weights, rngs)
+
+    # Reference computation: plain vmap (no mesh) + host weighted mean.
+    fit = make_local_fit(m.apply, cfg)
+    cd_host = jax.tree.map(jnp.asarray, cd)
+    host = jax.vmap(fit, in_axes=(None, 0, 0))(params, cd_host, rngs)
+    expected = tree_weighted_mean(host.params, weights)
+
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # Per-client metrics come back in client order.
+    np.testing.assert_allclose(
+        np.asarray(res.client_metrics.loss), np.asarray(host.metrics.loss), rtol=2e-4
+    )
+
+
+def test_zero_weight_round_is_identity(devices):
+    """All clients masked out => FAILED-round semantics: params and state unchanged."""
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=16)
+    params = m.init(jax.random.key(0))
+    strat = fedavgm_strategy()  # stateful server opt: state must also stay unchanged
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    sos = init_server_state(strat, params)
+    res = step(params, sos, shard_client_data(cd, mesh), jnp.zeros(8), stack_rngs(jax.random.key(0), 8))
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for got, want in zip(jax.tree.leaves(res.server_opt_state), jax.tree.leaves(sos)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(res.metrics["participating_clients"]) == 0
+
+
+def test_partial_participation_masks_clients(devices):
+    """Zero-weight clients must not influence the aggregate."""
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=16)
+    params = m.init(jax.random.key(0))
+    strat = fedavg_strategy()
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    sos = init_server_state(strat, params)
+    rngs = stack_rngs(jax.random.key(3), 8)
+    sharded = shard_client_data(cd, mesh)
+
+    ns = jnp.asarray(cd.num_samples)
+    mask = jnp.asarray([1.0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+    res_masked = step(params, sos, sharded, compute_weights(ns, mask), rngs)
+
+    # Same two clients alone on a fresh 2-client setup would give the same params:
+    fit = make_local_fit(m.apply, cfg)
+    cd_host = jax.tree.map(jnp.asarray, cd)
+    host = jax.vmap(fit, in_axes=(None, 0, 0))(params, cd_host, rngs)
+    w2 = compute_weights(ns, mask)
+    expected = tree_weighted_mean(host.params, w2)
+    for got, want in zip(jax.tree.leaves(res_masked.params), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert int(res_masked.metrics["participating_clients"]) == 2
+
+
+def test_client_padding_roundtrip(devices):
+    """10 clients on 8 devices: pad to 16, dummies carry zero weight."""
+    m = get_model("mlp", in_features=8, hidden=16, num_classes=4)
+    ds = synthetic_classification(400, 4, (8,), seed=1)
+    cd = federate(ds, num_clients=10, scheme="iid", batch_size=16, seed=1)
+    mesh = make_mesh()
+    padded_c = pad_client_count(10, 8)
+    assert padded_c == 16
+    padded = pad_clients(cd, padded_c)
+    assert padded.x.shape[0] == 16
+    np.testing.assert_array_equal(np.asarray(padded.mask[10:]).sum(), 0.0)
+
+    cfg = TrainingConfig(batch_size=16)
+    params = m.init(jax.random.key(0))
+    strat = fedavg_strategy()
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    sos = init_server_state(strat, params)
+    weights = compute_weights(jnp.asarray(padded.num_samples)) * (
+        jnp.asarray(padded.num_samples) > 0
+    )
+    res = step(
+        params, sos, shard_client_data(padded, mesh), weights, stack_rngs(jax.random.key(0), 16)
+    )
+    assert int(res.metrics["participating_clients"]) == 10
+    assert np.isfinite(np.asarray(res.metrics["loss"]))
+
+
+def test_multi_round_training_learns(devices):
+    m, cd, mesh = _setup(n=1024, batch=32)
+    cfg = TrainingConfig(batch_size=32, local_epochs=2)
+    params = m.init(jax.random.key(0))
+    strat = fedavg_strategy()
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    sos = init_server_state(strat, params)
+    weights = compute_weights(jnp.asarray(cd.num_samples))
+    sharded = shard_client_data(cd, mesh)
+    losses = []
+    for r in range(4):
+        res = step(params, sos, sharded, weights, stack_rngs(jax.random.key(r), 8))
+        params, sos = res.params, res.server_opt_state
+        losses.append(float(res.metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7

@@ -1,0 +1,197 @@
+"""Local trainer tests (analog: ``tests/unit/trainer/test_base_trainer.py`` /
+``test_torch.py`` — tiny real models, exact behavioral assertions)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.data import pack_clients, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.trainer import (
+    Trainer,
+    TrainingConfig,
+    make_evaluator,
+    make_local_fit,
+)
+from nanofed_tpu.trainer.callbacks import MetricsLogger
+from nanofed_tpu.utils.trees import tree_sub, tree_global_norm
+
+
+def _client(n=64, in_dim=8, classes=2, seed=0, batch=16):
+    ds = synthetic_classification(n, classes, (in_dim,), seed=seed)
+    return pack_clients(ds, [np.arange(n)], batch_size=batch)
+
+
+def _one(cd: ClientData) -> ClientData:
+    return ClientData(*(jnp.asarray(a[0]) for a in cd))
+
+
+def test_local_fit_reduces_loss(rng):
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client())
+    fit = make_local_fit(m.apply, TrainingConfig(batch_size=16, local_epochs=5))
+    res = fit(params, data, jax.random.key(1))
+    assert float(res.epoch_loss[-1]) < float(res.epoch_loss[0])
+    assert res.epoch_loss.shape == (5,)
+    assert float(res.metrics.samples) == 64.0
+
+
+def test_local_fit_changes_params_and_is_deterministic(rng):
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client())
+    fit = jax.jit(make_local_fit(m.apply, TrainingConfig(batch_size=16)))
+    r1 = fit(params, data, jax.random.key(1))
+    r2 = fit(params, data, jax.random.key(1))
+    assert float(tree_global_norm(tree_sub(r1.params, params))) > 0
+    np.testing.assert_array_equal(
+        np.asarray(r1.params["fc"]["kernel"]), np.asarray(r2.params["fc"]["kernel"])
+    )
+
+
+def test_padding_does_not_affect_result(rng):
+    """The correctness trap from SURVEY.md §7: padded samples must be exact no-ops."""
+    m = get_model("linear", in_features=4, num_classes=2)
+    params = m.init(rng)
+    ds = synthetic_classification(32, 2, (4,), seed=3)
+    tight = _one(pack_clients(ds, [np.arange(32)], batch_size=8))  # no padding
+    padded = _one(pack_clients(ds, [np.arange(32)], batch_size=8, capacity=64))  # 32 pad slots
+    # Use 1 epoch without shuffling effects: same seed shuffles differently for n=32 vs 64,
+    # so compare against a config with batch_size == capacity (single full batch).
+    fit_tight = make_local_fit(m.apply, TrainingConfig(batch_size=32, local_epochs=1))
+    fit_pad = make_local_fit(m.apply, TrainingConfig(batch_size=64, local_epochs=1))
+    r_tight = fit_tight(params, tight, jax.random.key(0))
+    r_pad = fit_pad(params, padded, jax.random.key(0))
+    # One full-batch gradient step over identical real samples => identical params.
+    np.testing.assert_allclose(
+        np.asarray(r_tight.params["fc"]["kernel"]),
+        np.asarray(r_pad.params["fc"]["kernel"]),
+        rtol=1e-5,
+    )
+    assert float(r_pad.metrics.samples) == 32.0  # mask-based, not capacity-based
+
+
+def test_empty_client_is_noop(rng):
+    m = get_model("linear", in_features=4, num_classes=2)
+    params = m.init(rng)
+    empty = ClientData(
+        x=jnp.zeros((16, 4)), y=jnp.zeros((16,), jnp.int32), mask=jnp.zeros((16,))
+    )
+    fit = make_local_fit(m.apply, TrainingConfig(batch_size=8, local_epochs=2))
+    res = fit(params, empty, jax.random.key(0))
+    np.testing.assert_array_equal(
+        np.asarray(res.params["fc"]["kernel"]), np.asarray(params["fc"]["kernel"])
+    )
+    assert float(res.metrics.samples) == 0.0
+
+
+def test_max_batches_caps_work(rng):
+    m = get_model("linear", in_features=4, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client(n=64, in_dim=4, batch=8))
+    fit_all = make_local_fit(m.apply, TrainingConfig(batch_size=8, collect_batch_metrics=True))
+    fit_capped = make_local_fit(
+        m.apply, TrainingConfig(batch_size=8, max_batches=2, collect_batch_metrics=True)
+    )
+    assert fit_all(params, data, jax.random.key(0)).batch_loss.shape == (1, 8)
+    assert fit_capped(params, data, jax.random.key(0)).batch_loss.shape == (1, 2)
+
+
+def test_fedprox_pulls_toward_anchor(rng):
+    """With a strong (but stable: lr*mu < 2) prox_mu the local update stays near the
+    round's starting params."""
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client())
+    free = make_local_fit(m.apply, TrainingConfig(batch_size=16, local_epochs=5))
+    prox = make_local_fit(m.apply, TrainingConfig(batch_size=16, local_epochs=5, prox_mu=5.0))
+    d_free = float(tree_global_norm(tree_sub(free(params, data, jax.random.key(1)).params, params)))
+    d_prox = float(tree_global_norm(tree_sub(prox(params, data, jax.random.key(1)).params, params)))
+    assert d_prox < d_free * 0.5
+
+
+def test_vmap_over_clients(rng):
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(rng)
+    ds = synthetic_classification(96, 2, (8,), seed=0)
+    cd = pack_clients(ds, [np.arange(0, 48), np.arange(48, 96)], batch_size=16)
+    cd = jax.tree.map(jnp.asarray, cd)
+    fit = make_local_fit(m.apply, TrainingConfig(batch_size=16))
+    res = jax.vmap(fit, in_axes=(None, 0, 0))(params, cd, jax.random.split(jax.random.key(0), 2))
+    assert res.metrics.loss.shape == (2,)
+    assert res.params["fc"]["kernel"].shape[0] == 2
+
+
+def test_evaluator_exact_on_known_params(rng):
+    m = get_model("linear", in_features=4, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client(n=32, in_dim=4, batch=8))
+    ev = make_evaluator(m.apply, batch_size=8)
+    out = ev(params, data)
+    assert 0.0 <= float(out["accuracy"]) <= 1.0
+    assert np.isfinite(float(out["loss"]))
+
+
+def test_trainer_api_with_callbacks(rng, tmp_path):
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(rng)
+    data = _one(_client())
+    sink = MetricsLogger(tmp_path / "metrics.json", client_id="c0")
+    trainer = Trainer(
+        m.apply,
+        TrainingConfig(batch_size=16, local_epochs=3, collect_batch_metrics=True),
+        callbacks=[sink],
+    )
+    new_params, metrics = trainer.fit(params, data, jax.random.key(0))
+    assert set(metrics) == {"loss", "accuracy", "samples_processed"}
+    import json
+
+    payload = json.loads((tmp_path / "metrics.json").read_text())
+    assert payload["client_id"] == "c0"
+    assert len(payload["epochs"]) == 3
+    assert len(payload["batches"]) == 3 * 4  # 64/16 steps per epoch
+
+
+def test_trainer_forces_batch_metrics_for_callbacks(rng, tmp_path):
+    """Callbacks with the default config must auto-enable collect_batch_metrics."""
+    m = get_model("linear", in_features=8, num_classes=2)
+    trainer = Trainer(
+        m.apply,
+        TrainingConfig(batch_size=16, local_epochs=1),  # collect_batch_metrics=False
+        callbacks=[MetricsLogger(tmp_path / "m.json")],
+    )
+    assert trainer.config.collect_batch_metrics
+    trainer.fit(m.init(rng), _one(_client()), jax.random.key(0))
+    assert (tmp_path / "m.json").exists()
+
+
+def test_evaluator_handles_misaligned_batch(rng):
+    """Eval must never silently drop tail samples (batch_size not dividing n)."""
+    m = get_model("linear", in_features=4, num_classes=2)
+    params = m.init(rng)
+    ds = synthetic_classification(100, 2, (4,), seed=7)
+    from nanofed_tpu.data import pack_eval
+
+    data = _one_eval(pack_eval(ds, batch_size=10))  # n=100
+    full = make_evaluator(m.apply, batch_size=10)(params, data)
+    odd = make_evaluator(m.apply, batch_size=64)(params, data)  # 100 % 64 != 0
+    assert float(full["accuracy"]) == pytest.approx(float(odd["accuracy"]), abs=1e-6)
+    assert float(full["loss"]) == pytest.approx(float(odd["loss"]), rel=1e-5)
+
+
+def _one_eval(cd):
+    return ClientData(*(jnp.asarray(a) for a in cd))
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        TrainingConfig(batch_size=0)
+    with pytest.raises(ValueError):
+        TrainingConfig(local_epochs=0)
+    with pytest.raises(ValueError):
+        TrainingConfig(learning_rate=-1.0)
+    with pytest.raises(ValueError):
+        TrainingConfig(prox_mu=-0.1)
