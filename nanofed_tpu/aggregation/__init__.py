@@ -1,6 +1,6 @@
 """Server-side aggregation (parity: ``nanofed/server/aggregator/__init__.py`` exports
-BaseAggregator/FedAvgAggregator; privacy-aware and secure aggregation live in
-``nanofed_tpu.privacy`` and ``nanofed_tpu.security``)."""
+BaseAggregator/FedAvgAggregator/PrivacyAwareAggregator; secure aggregation lives in
+``nanofed_tpu.security``)."""
 
 from nanofed_tpu.aggregation.base import (
     AggregationResult,
@@ -17,10 +17,24 @@ from nanofed_tpu.aggregation.fedavg import (
     psum_weighted_mean,
     psum_weighted_metrics,
 )
+from nanofed_tpu.aggregation.privacy import (
+    PrivacyAwareAggregationConfig,
+    apply_central_privacy,
+    central_mechanism,
+    epsilon_adjusted_weights,
+    record_central_privacy,
+    validate_private_round,
+)
 
 __all__ = [
     "AggregationResult",
+    "PrivacyAwareAggregationConfig",
     "Strategy",
+    "apply_central_privacy",
+    "central_mechanism",
+    "epsilon_adjusted_weights",
+    "record_central_privacy",
+    "validate_private_round",
     "aggregate_metrics",
     "compute_weights",
     "fedadam_strategy",

@@ -28,12 +28,14 @@ def fedavg_combine(updates: ClientUpdates) -> Params:
 
 def aggregate_metrics(metrics: ClientMetrics, weights: jax.Array) -> dict[str, jax.Array]:
     """Weighted metric averaging, parity with ``_aggregate_metrics``
-    (``fedavg.py:80-99``)."""
+    (``fedavg.py:80-99``).  ``samples`` counts participants only (weights > 0), matching
+    the in-mesh ``psum_weighted_metrics`` exactly."""
     den = jnp.maximum(weights.sum(), 1e-12)
+    participating = (weights > 0).astype(metrics.samples.dtype)
     return {
         "loss": (metrics.loss * weights).sum() / den,
         "accuracy": (metrics.accuracy * weights).sum() / den,
-        "samples": metrics.samples.sum(),
+        "samples": (metrics.samples * participating).sum(),
     }
 
 
@@ -42,13 +44,15 @@ def compute_weights(
 ) -> jax.Array:
     """FedAvg weights: proportional to client sample counts, zeroed for non-participants.
 
-    Parity: ``_compute_weights`` (``fedavg.py:101-125``) uses ``num_samples`` with a
-    default of 1.0 per client; partial participation (the reference's
+    Parity: ``_compute_weights`` (``fedavg.py:101-125``) defaults a *missing* sample
+    count to 1.0; here counts are always known, and a count of ZERO means a padding
+    client — it gets weight 0 so ``pad_clients`` dummies never dilute the mean, with or
+    without an explicit participation mask.  Partial participation (the reference's
     ``min_completion_rate`` wait-barrier, ``coordinator.py:205-245``) is re-specified as a
     mask — zero-weight clients drop out of the ``psum`` exactly like clients that never
     reported drop out of the buffer.
     """
-    w = jnp.maximum(num_samples, 1.0)
+    w = jnp.maximum(num_samples, 0.0)
     if participation is not None:
         w = w * participation
     return w

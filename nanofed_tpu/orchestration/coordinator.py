@@ -24,6 +24,7 @@ Observable parity notes:
 from __future__ import annotations
 
 import json
+import math
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -196,7 +197,11 @@ class Coordinator:
         host_rng = np.random.default_rng(self.config.seed * 100_003 + round_id)
 
         # --- participant sampling (replaces the HTTP wait barrier) ---
-        cohort = max(1, round(self.num_clients * self.config.participation_rate))
+        # ceil, per the CoordinatorConfig contract (round() would banker's-round .5 down).
+        cohort = min(
+            self.num_clients,
+            max(1, math.ceil(self.num_clients * self.config.participation_rate)),
+        )
         sampled = host_rng.choice(self.num_clients, size=cohort, replace=False)
         survived = sampled
         if self.config.dropout_rate > 0:
@@ -243,13 +248,15 @@ class Coordinator:
                 k: float(v) for k, v in self._evaluator(self.params, self._eval_data).items()
             }
 
-        # Per-client detail for the metrics file (parity: coordinator.py:247-280).
-        self._last_client_detail = {
-            "weights": np.asarray(weights).tolist(),
-            "client_loss": np.asarray(result.client_metrics.loss).tolist(),
-            "client_accuracy": np.asarray(result.client_metrics.accuracy).tolist(),
-            "update_sq_norms": np.asarray(result.update_sq_norms).tolist(),
-        }
+        # Per-client detail for the metrics file (parity: coordinator.py:247-280).  Only
+        # consumed by _save_round_metrics — skip the device->host transfers otherwise.
+        if self.config.save_metrics:
+            self._last_client_detail = {
+                "weights": np.asarray(weights).tolist(),
+                "client_loss": np.asarray(result.client_metrics.loss).tolist(),
+                "client_accuracy": np.asarray(result.client_metrics.accuracy).tolist(),
+                "update_sq_norms": np.asarray(result.update_sq_norms).tolist(),
+            }
 
         jax.block_until_ready(self.params)
         duration = time.perf_counter() - t0

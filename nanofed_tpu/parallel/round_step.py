@@ -26,11 +26,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
 from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
+from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn, make_local_fit
-from nanofed_tpu.utils.trees import tree_sq_norm, tree_where
+from nanofed_tpu.utils.trees import tree_clip_by_global_norm, tree_sq_norm, tree_where
 
 
 class RoundStepResult(NamedTuple):
@@ -50,6 +52,8 @@ def build_round_step(
     mesh: Mesh,
     strategy: Strategy | None = None,
     grad_fn: GradFn | None = None,
+    local_fit: Callable | None = None,
+    central_privacy: PrivacyAwareAggregationConfig | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
 ) -> RoundStepFn:
@@ -61,15 +65,31 @@ def build_round_step(
     ``rngs`` is ``[C]`` per-client keys.  Initialize ``server_opt_state`` with
     ``init_server_state``.
 
+    ``local_fit`` overrides the default fit (e.g. ``make_private_local_fit`` for DP-SGD
+    clients); it must have the ``local_fit(global_params, data, rng)`` signature.
+
+    ``central_privacy`` turns the reduce into DP-FedAvg (McMahan et al. 2018), the in-mesh
+    form of ``PrivacyAwareAggregator``'s central path (``nanofed/server/aggregator/
+    privacy.py:179-194``): each client's delta is clipped to C, aggregation uses *uniform*
+    weights over participants (so per-client sensitivity is exactly C/K), and one Gaussian
+    draw of std σ·C/K is added to the replicated aggregate.  The server noise key is
+    derived from ``rngs`` so the signature is unchanged; accounting stays host-side via
+    ``record_central_privacy``.
+
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
     and keep only the returned arrays, as ``Coordinator`` does.
     """
     strategy = strategy or fedavg_strategy()
-    local_fit = make_local_fit(apply_fn, training, grad_fn=grad_fn)
+    if local_fit is not None and grad_fn is not None:
+        raise ValueError(
+            "pass either grad_fn (used to build the default local fit) or a complete "
+            "local_fit, not both — a supplied local_fit ignores grad_fn"
+        )
+    local_fit = local_fit or make_local_fit(apply_fn, training, grad_fn=grad_fn)
     server_tx = strategy.server_tx
 
-    def shard_body(gp, sos, data: ClientData, weights, rngs):
+    def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng):
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
         gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
@@ -77,7 +97,18 @@ def build_round_step(
         delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
 
         total_w = lax.psum(weights.sum(), axis_name)
-        agg_delta = psum_weighted_mean(delta, weights, axis_name)
+        if central_privacy is not None:
+            clip = central_privacy.privacy.max_gradient_norm
+            sigma = central_privacy.privacy.noise_multiplier
+            delta = jax.vmap(lambda d: tree_clip_by_global_norm(d, clip)[0])(delta)
+            uniform = (weights > 0).astype(jnp.float32)
+            participants = jnp.maximum(lax.psum(uniform.sum(), axis_name), 1.0)
+            agg_delta = psum_weighted_mean(delta, uniform, axis_name)
+            gen = get_noise_generator(central_privacy.privacy.noise_type)
+            server_noise = tree_noise(noise_rng, agg_delta, sigma * clip / participants, gen)
+            agg_delta = jax.tree.map(jnp.add, agg_delta, server_noise)
+        else:
+            agg_delta = psum_weighted_mean(delta, weights, axis_name)
         # optax convention: pass the NEGATIVE delta as "gradient" so SGD(1.0) applies
         # +delta (exact FedAvg).  A round with zero total weight (no participants /
         # all failed — the reference marks these FAILED, coordinator.py:295-304) must
@@ -96,7 +127,7 @@ def build_round_step(
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P()),
         out_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
     )
 
@@ -108,8 +139,11 @@ def build_round_step(
         weights: jax.Array,
         rngs: PRNGKey,
     ) -> RoundStepResult:
+        # Replicated server-side noise key (central DP), derived so every device draws the
+        # identical noise on the replicated aggregate.
+        noise_rng = jax.random.fold_in(rngs[0], 0x5EED)
         gp, sos, metrics, client_metrics, sq_norms = sharded(
-            global_params, server_opt_state, data, weights, rngs
+            global_params, server_opt_state, data, weights, rngs, noise_rng
         )
         return RoundStepResult(gp, sos, metrics, client_metrics, sq_norms)
 

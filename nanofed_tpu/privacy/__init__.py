@@ -1,0 +1,68 @@
+"""Differential-privacy subsystem: config, noise, accounting, mechanisms.
+
+TPU-native re-design of ``nanofed/privacy/``: noise generation and clip+noise mechanisms
+are pure jit/vmap-compatible functions on pytrees keyed by explicit PRNG keys; budget
+accounting is host-side NumPy fed by event counts returned from compiled code.  DP-SGD
+itself lives in ``nanofed_tpu.trainer.private``; privacy-aware aggregation in
+``nanofed_tpu.aggregation.privacy``.
+"""
+
+from nanofed_tpu.privacy.accounting import (
+    DEFAULT_RDP_ORDERS,
+    BasePrivacyAccountant,
+    GaussianAccountant,
+    PrivacyAccountant,
+    PrivacySpent,
+    RDPAccountant,
+    noise_multiplier_for_budget,
+)
+from nanofed_tpu.privacy.config import (
+    MAX_DELTA,
+    MAX_EPSILON,
+    MIN_DELTA,
+    MIN_EPSILON,
+    NoiseType,
+    PrivacyConfig,
+)
+from nanofed_tpu.privacy.mechanisms import (
+    PrivacyMechanism,
+    PrivacyType,
+    make_privacy_mechanism,
+    privatize_stacked_updates,
+)
+from nanofed_tpu.privacy.noise import (
+    GaussianNoiseGenerator,
+    LaplacianNoiseGenerator,
+    NoiseGenerator,
+    get_noise_generator,
+    tree_add_noise,
+    tree_noise,
+    validate_noise_input,
+)
+
+__all__ = [
+    "DEFAULT_RDP_ORDERS",
+    "MAX_DELTA",
+    "MAX_EPSILON",
+    "MIN_DELTA",
+    "MIN_EPSILON",
+    "BasePrivacyAccountant",
+    "GaussianAccountant",
+    "GaussianNoiseGenerator",
+    "LaplacianNoiseGenerator",
+    "NoiseGenerator",
+    "NoiseType",
+    "PrivacyAccountant",
+    "PrivacyConfig",
+    "PrivacyMechanism",
+    "PrivacySpent",
+    "PrivacyType",
+    "RDPAccountant",
+    "get_noise_generator",
+    "make_privacy_mechanism",
+    "noise_multiplier_for_budget",
+    "privatize_stacked_updates",
+    "tree_add_noise",
+    "tree_noise",
+    "validate_noise_input",
+]

@@ -1,0 +1,56 @@
+"""Privacy configuration with validated bounds.
+
+Parity with the reference's pydantic config (``nanofed/privacy/config.py:24-86``) and its
+bound constants (``nanofed/privacy/constants.py:3-10``): ε ∈ [0.01, 10], δ ∈ [1e-10, 0.1],
+positive clipping norm and noise multiplier, Gaussian|Laplacian noise.  Implemented as a
+frozen dataclass (hashable — it rides into ``jit`` as a static argument) instead of a
+pydantic model.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+MIN_EPSILON = 0.01
+MAX_EPSILON = 10.0
+MIN_DELTA = 1e-10
+MAX_DELTA = 0.1
+
+
+class NoiseType(enum.Enum):
+    """Noise distribution for DP mechanisms (parity: ``NoiseType``,
+    ``nanofed/privacy/config.py:17-21``)."""
+
+    GAUSSIAN = "gaussian"
+    LAPLACIAN = "laplacian"
+
+
+@dataclass(frozen=True, slots=True)
+class PrivacyConfig:
+    """Differential-privacy budget and mechanism parameters.
+
+    ``epsilon``/``delta`` are the *target budget* the accountant validates against;
+    ``max_gradient_norm`` is the clipping bound C; ``noise_multiplier`` is σ (noise std is
+    σ·C).  Bounds match the reference's validated ranges.
+    """
+
+    epsilon: float = 1.0
+    delta: float = 1e-5
+    max_gradient_norm: float = 1.0
+    noise_multiplier: float = 1.0
+    noise_type: NoiseType = NoiseType.GAUSSIAN
+
+    def __post_init__(self) -> None:
+        if not (MIN_EPSILON <= self.epsilon <= MAX_EPSILON):
+            raise ValueError(
+                f"epsilon must be in [{MIN_EPSILON}, {MAX_EPSILON}], got {self.epsilon}"
+            )
+        if not (MIN_DELTA <= self.delta <= MAX_DELTA):
+            raise ValueError(f"delta must be in [{MIN_DELTA}, {MAX_DELTA}], got {self.delta}")
+        if self.max_gradient_norm <= 0:
+            raise ValueError("max_gradient_norm must be > 0")
+        if self.noise_multiplier <= 0:
+            raise ValueError("noise_multiplier must be > 0")
+        if not isinstance(self.noise_type, NoiseType):
+            raise ValueError(f"noise_type must be a NoiseType, got {self.noise_type!r}")

@@ -1,0 +1,98 @@
+"""Central and local DP mechanisms over whole model pytrees.
+
+Parity with ``nanofed/privacy/mechanisms.py``: clip a model update to a global-norm bound
+then add calibrated noise (``mechanisms.py:85-129``), with a central variant (applied
+server-side to each client's update before aggregation) and a local variant (applied
+client-side; the reference forces batch_size=1 for it, ``mechanisms.py:148-158``).  Both
+are pure jit-compatible functions here — the reference's stateful accounting side effect
+is split out: mechanisms *return* the event they performed and the caller feeds the
+accountant (keeps the compiled path functional).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax
+
+from nanofed_tpu.core.types import PRNGKey, PyTree
+from nanofed_tpu.privacy.accounting import BasePrivacyAccountant
+from nanofed_tpu.privacy.config import PrivacyConfig
+from nanofed_tpu.privacy.noise import get_noise_generator, tree_add_noise
+from nanofed_tpu.utils.trees import tree_clip_by_global_norm
+
+
+class PrivacyType(enum.Enum):
+    """Where the mechanism runs (parity: ``PrivacyType``, ``mechanisms.py:18-22``)."""
+
+    CENTRAL = "central"
+    LOCAL = "local"
+
+
+@dataclass(frozen=True, slots=True)
+class PrivacyMechanism:
+    """A configured clip+noise mechanism.
+
+    ``privatize`` is the pure hot path (jit/vmap-safe); ``record`` is the host-side
+    accounting half.  ``batch_size`` enters the noise scale as σ·C/B, matching the
+    reference's ``_compute_noise_scale`` (``mechanisms.py:77-83``); the local variant pins
+    B=1 (``mechanisms.py:148-158``).
+    """
+
+    config: PrivacyConfig
+    privacy_type: PrivacyType = PrivacyType.CENTRAL
+    batch_size: int = 1
+
+    def __post_init__(self) -> None:
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if self.privacy_type is PrivacyType.LOCAL and self.batch_size != 1:
+            raise ValueError("local DP uses batch_size=1 (each update is one user's data)")
+
+    @property
+    def noise_scale(self) -> float:
+        return self.config.noise_multiplier * self.config.max_gradient_norm / self.batch_size
+
+    def privatize(self, rng: PRNGKey, update: PyTree) -> PyTree:
+        """Clip ``update`` to global norm C then add noise of scale σ·C/B.
+
+        Parity: ``BasePrivacyMechanism.add_noise`` (``mechanisms.py:106-129``) minus the
+        in-place accounting (see ``record``).
+        """
+        clipped, _ = tree_clip_by_global_norm(update, self.config.max_gradient_norm)
+        gen = get_noise_generator(self.config.noise_type)
+        return tree_add_noise(rng, clipped, self.noise_scale, gen)
+
+    def record(
+        self, accountant: BasePrivacyAccountant, sampling_rate: float = 1.0, count: int = 1
+    ) -> None:
+        """Feed ``count`` privatize calls into ``accountant`` (the host-side half of the
+        reference's ``accountant.add_noise_event`` call inside ``add_noise``,
+        ``mechanisms.py:119-121``)."""
+        accountant.add_noise_event(self.config.noise_multiplier, sampling_rate, count=count)
+
+
+def make_privacy_mechanism(
+    privacy_type: PrivacyType | str, config: PrivacyConfig, batch_size: int = 1
+) -> PrivacyMechanism:
+    """Factory (parity: ``PrivacyMechanismFactory.create``, ``mechanisms.py:161-174``)."""
+    ptype = PrivacyType(privacy_type) if not isinstance(privacy_type, PrivacyType) else privacy_type
+    if ptype is PrivacyType.LOCAL:
+        return PrivacyMechanism(config=config, privacy_type=ptype, batch_size=1)
+    return PrivacyMechanism(config=config, privacy_type=ptype, batch_size=batch_size)
+
+
+def privatize_stacked_updates(
+    rng: PRNGKey, stacked_params: PyTree, mechanism: PrivacyMechanism
+) -> PyTree:
+    """Central-DP the whole round in one shot: vmap ``privatize`` over the leading client
+    axis with independent per-client keys.
+
+    This is the TPU form of the reference's per-update loop in
+    ``PrivacyAwareAggregator._process_central_updates`` (``aggregator/privacy.py:179-194``).
+    """
+    leaves = jax.tree.leaves(stacked_params)
+    num_clients = leaves[0].shape[0]
+    keys = jax.random.split(rng, num_clients)
+    return jax.vmap(mechanism.privatize)(keys, stacked_params)

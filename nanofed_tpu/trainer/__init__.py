@@ -1,6 +1,5 @@
 """Client-side training (parity: ``nanofed/trainer/__init__.py`` exports BaseTrainer/
-TorchTrainer/PrivateTrainer/TrainingConfig/Callback/MetricsLogger; the DP trainer lives in
-``nanofed_tpu.privacy.dp_trainer``)."""
+TorchTrainer/PrivateTrainer/TrainingConfig/Callback/MetricsLogger)."""
 
 from nanofed_tpu.trainer.api import Trainer
 from nanofed_tpu.trainer.callbacks import BaseCallback, Callback, MetricsLogger
@@ -14,6 +13,13 @@ from nanofed_tpu.trainer.local import (
     make_optimizer,
     stack_rngs,
 )
+from nanofed_tpu.trainer.private import (
+    local_fit_noise_events,
+    make_dp_grad_fn,
+    make_private_local_fit,
+    record_local_fit,
+    validate_privacy_budget,
+)
 
 __all__ = [
     "BaseCallback",
@@ -23,9 +29,14 @@ __all__ = [
     "StepStats",
     "Trainer",
     "TrainingConfig",
+    "local_fit_noise_events",
+    "make_dp_grad_fn",
     "make_evaluator",
     "make_grad_fn",
     "make_local_fit",
     "make_optimizer",
+    "make_private_local_fit",
+    "record_local_fit",
     "stack_rngs",
+    "validate_privacy_budget",
 ]

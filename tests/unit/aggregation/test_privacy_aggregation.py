@@ -1,0 +1,114 @@
+"""Privacy-aware aggregation (parity: ``tests/unit/server/aggregator/
+test_privacy_aggregation.py`` — central noise, local reweighting, min-client and budget
+validation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.aggregation import (
+    PrivacyAwareAggregationConfig,
+    apply_central_privacy,
+    epsilon_adjusted_weights,
+    record_central_privacy,
+    validate_private_round,
+)
+from nanofed_tpu.core.exceptions import AggregationError
+from nanofed_tpu.privacy import GaussianAccountant, PrivacyConfig, PrivacySpent, PrivacyType
+
+
+class TestConfig:
+    def test_required_clients_with_dropout_tolerance(self):
+        cfg = PrivacyAwareAggregationConfig(min_clients=10, dropout_tolerance=0.3)
+        assert cfg.required_clients == 7
+        assert PrivacyAwareAggregationConfig(min_clients=1).required_clients == 1
+
+    def test_bounds(self):
+        with pytest.raises(ValueError):
+            PrivacyAwareAggregationConfig(min_clients=0)
+        with pytest.raises(ValueError):
+            PrivacyAwareAggregationConfig(dropout_tolerance=1.5)
+
+
+class TestValidation:
+    def test_too_few_clients_rejected(self):
+        cfg = PrivacyAwareAggregationConfig(min_clients=5)
+        with pytest.raises(AggregationError, match="not enough clients"):
+            validate_private_round(cfg, num_participants=3)
+        validate_private_round(cfg, num_participants=5)
+
+    def test_local_dp_requires_spends(self):
+        cfg = PrivacyAwareAggregationConfig(privacy_type=PrivacyType.LOCAL)
+        with pytest.raises(AggregationError, match="privacy_spent"):
+            validate_private_round(cfg, num_participants=2)
+        with pytest.raises(AggregationError, match="missing privacy budget"):
+            validate_private_round(
+                cfg, 2, [PrivacySpent(0.5, 1e-5), None]
+            )
+
+    def test_local_dp_budget_enforced(self):
+        cfg = PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(epsilon=1.0), privacy_type=PrivacyType.LOCAL
+        )
+        with pytest.raises(AggregationError, match="exceeded budget"):
+            validate_private_round(
+                cfg, 2, [PrivacySpent(0.5, 1e-5), PrivacySpent(3.0, 1e-5)]
+            )
+        validate_private_round(
+            cfg, 2, [PrivacySpent(0.5, 1e-5), PrivacySpent(0.9, 1e-5)]
+        )
+
+
+class TestCentral:
+    def test_clips_and_noises_each_client(self, rng):
+        cfg = PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1e-6)
+        )
+        deltas = {"w": jnp.full((4, 30), 7.0)}
+        out = apply_central_privacy(rng, deltas, cfg)
+        norms = np.linalg.norm(np.asarray(out["w"]), axis=1)
+        np.testing.assert_allclose(norms, 1.0, rtol=1e-3)
+
+    def test_noise_scale_shrinks_with_cohort(self, rng):
+        priv = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        cfg = PrivacyAwareAggregationConfig(privacy=priv)
+        small = apply_central_privacy(rng, {"w": jnp.zeros((2, 4000))}, cfg)
+        large = apply_central_privacy(rng, {"w": jnp.zeros((40, 4000))}, cfg)
+        # scale = sigma*C/K: 40-client noise std is 20x smaller than 2-client.
+        assert float(jnp.std(small["w"])) > 5 * float(jnp.std(large["w"]))
+
+    def test_jits_inside_round_step_style_fn(self, rng):
+        cfg = PrivacyAwareAggregationConfig(privacy=PrivacyConfig())
+        f = jax.jit(lambda k, d: apply_central_privacy(k, d, cfg))
+        out = f(rng, {"w": jnp.ones((3, 5))})
+        assert np.isfinite(np.asarray(out["w"])).all()
+
+    def test_accounting_one_event_per_round(self):
+        # The in-mesh reduce is ONE release per round (effective multiplier sigma,
+        # independent of cohort size) — not K events.
+        cfg = PrivacyAwareAggregationConfig(privacy=PrivacyConfig(noise_multiplier=2.0))
+        acc = GaussianAccountant()
+        record_central_privacy(acc, cfg, num_rounds=5)
+        assert acc.state_dict()["events"] == [[2.0, 1.0, 5.0]]
+
+
+class TestLocalReweighting:
+    def test_epsilon_weighting_normalizes(self):
+        w = jnp.array([10.0, 10.0, 10.0])
+        eps = jnp.array([1.0, 2.0, 3.0])
+        out = np.asarray(epsilon_adjusted_weights(w, eps))
+        assert out.sum() == pytest.approx(1.0)
+        # More epsilon spent => higher weight.
+        assert out[2] > out[1] > out[0]
+        np.testing.assert_allclose(out, np.array([1, 2, 3]) / 6, rtol=1e-6)
+
+    def test_combines_with_sample_counts(self):
+        w = jnp.array([30.0, 10.0])
+        eps = jnp.array([1.0, 1.0])
+        out = np.asarray(epsilon_adjusted_weights(w, eps))
+        np.testing.assert_allclose(out, [0.75, 0.25], rtol=1e-6)
+
+    def test_zero_safe(self):
+        out = np.asarray(epsilon_adjusted_weights(jnp.zeros(3), jnp.zeros(3)))
+        assert np.isfinite(out).all()

@@ -1,0 +1,212 @@
+"""Accountant math: exact values, composition, monotonicity, amplification, edge cases,
+stress — the capability set of the reference's deepest suite
+(``tests/unit/privacy/test_gaussian.py``, ``test_rdp.py``, ``test_privacy_properties.py``,
+``test_privacy_edge_cases.py``, ``test_privacy_stress.py``)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.privacy import (
+    GaussianAccountant,
+    PrivacySpent,
+    RDPAccountant,
+    noise_multiplier_for_budget,
+)
+
+
+class TestPrivacySpent:
+    def test_valid(self):
+        s = PrivacySpent(epsilon_spent=1.0, delta_spent=1e-5)
+        assert s.epsilon_spent == 1.0
+        assert s.to_dict() == {"epsilon_spent": 1.0, "delta_spent": 1e-5}
+        assert PrivacySpent.from_dict(s.to_dict()) == s
+
+    def test_negative_epsilon_rejected(self):
+        with pytest.raises(ValueError):
+            PrivacySpent(epsilon_spent=-0.1, delta_spent=1e-5)
+
+    def test_delta_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            PrivacySpent(epsilon_spent=1.0, delta_spent=1.5)
+
+
+class TestGaussianAccountant:
+    def test_empty_spend_is_zero(self):
+        acc = GaussianAccountant()
+        spent = acc.get_privacy_spent(1e-5)
+        assert spent.epsilon_spent == 0.0
+        assert spent.delta_spent == 0.0
+
+    def test_single_event_exact_value(self):
+        # eps = q * sqrt(2 ln(1.25/delta)) / sigma
+        acc = GaussianAccountant()
+        acc.add_noise_event(noise_multiplier=2.0, sampling_rate=0.1)
+        expect = 0.1 * math.sqrt(2 * math.log(1.25 / 1e-5)) / 2.0
+        assert acc.get_privacy_spent(1e-5).epsilon_spent == pytest.approx(expect)
+
+    def test_basic_composition_with_delta_split(self):
+        """k events compose to eps = k * q*sqrt(2 ln(1.25k/delta))/sigma: each event is
+        evaluated at delta/k so the composed guarantee really holds at the queried delta
+        (slightly superlinear in k — never the anti-conservative fixed-delta linear sum)."""
+        a1, a10 = GaussianAccountant(), GaussianAccountant()
+        a1.add_noise_event(1.0, 0.01)
+        a10.add_noise_event(1.0, 0.01, count=10)
+        e1 = a1.get_privacy_spent(1e-5).epsilon_spent
+        e10 = a10.get_privacy_spent(1e-5).epsilon_spent
+        expect = 10 * 0.01 * math.sqrt(2 * math.log(1.25 * 10 / 1e-5)) / 1.0
+        assert e10 == pytest.approx(expect)
+        assert e10 >= 10 * e1  # superlinear: delta/k makes each event cost more
+        assert a10.get_privacy_spent(1e-5).delta_spent == 1e-5
+
+    def test_epsilon_decreases_with_sigma(self):
+        eps = []
+        for sigma in [0.5, 1.0, 2.0, 4.0]:
+            acc = GaussianAccountant()
+            acc.add_noise_event(sigma, 0.1)
+            eps.append(acc.get_privacy_spent(1e-5).epsilon_spent)
+        assert eps == sorted(eps, reverse=True)
+
+    def test_epsilon_scales_with_sampling_rate(self):
+        acc_lo, acc_hi = GaussianAccountant(), GaussianAccountant()
+        acc_lo.add_noise_event(1.0, 0.01)
+        acc_hi.add_noise_event(1.0, 0.1)
+        assert (
+            acc_hi.get_privacy_spent(1e-5).epsilon_spent
+            == pytest.approx(10 * acc_lo.get_privacy_spent(1e-5).epsilon_spent)
+        )
+
+    def test_invalid_events_rejected(self):
+        acc = GaussianAccountant()
+        with pytest.raises(ValueError):
+            acc.add_noise_event(0.0, 0.1)
+        with pytest.raises(ValueError):
+            acc.add_noise_event(1.0, 0.0)
+        with pytest.raises(ValueError):
+            acc.add_noise_event(1.0, 1.5)
+        with pytest.raises(ValueError):
+            acc.add_noise_event(1.0, 0.1, count=0)
+
+    def test_invalid_delta_rejected(self):
+        acc = GaussianAccountant()
+        acc.add_noise_event(1.0, 0.1)
+        for bad in [0.0, 1.0, -0.1]:
+            with pytest.raises(ValueError):
+                acc.get_privacy_spent(bad)
+
+    def test_validate_budget(self):
+        acc = GaussianAccountant()
+        acc.add_noise_event(1.0, 0.01)
+        assert acc.validate_budget(epsilon=10.0, delta=1e-5)
+        assert not acc.validate_budget(epsilon=1e-6, delta=1e-5)
+
+    def test_reset_and_state_roundtrip(self):
+        acc = GaussianAccountant()
+        acc.add_noise_event(1.0, 0.1, count=3)
+        acc.add_noise_event(2.0, 0.2)
+        state = acc.state_dict()
+        acc2 = GaussianAccountant()
+        acc2.load_state_dict(state)
+        assert acc2.get_privacy_spent(1e-5) == acc.get_privacy_spent(1e-5)
+        acc.reset()
+        assert acc.num_events == 0
+        assert acc.get_privacy_spent(1e-5).epsilon_spent == 0.0
+
+
+class TestRDPAccountant:
+    def test_empty_spend_is_zero(self):
+        assert RDPAccountant().get_privacy_spent(1e-5).epsilon_spent == 0.0
+
+    def test_single_event_matches_manual_conversion(self):
+        acc = RDPAccountant(orders=[2.0, 8.0, 32.0])
+        acc.add_noise_event(1.0, 0.1)
+        # eps(alpha) = q^2*alpha/(2 sigma^2) + ln(1/delta)/(alpha-1)
+        manual = min(
+            0.01 * a / 2.0 + math.log(1e5) / (a - 1.0) for a in [2.0, 8.0, 32.0]
+        )
+        assert acc.get_privacy_spent(1e-5).epsilon_spent == pytest.approx(manual)
+
+    def test_additive_rdp_composition(self):
+        a1, a5 = RDPAccountant(), RDPAccountant()
+        a1.add_noise_event(1.0, 0.05)
+        a5.add_noise_event(1.0, 0.05, count=5)
+        np.testing.assert_allclose(a5.total_rdp(), 5 * a1.total_rdp())
+
+    def test_monotone_in_events(self):
+        acc = RDPAccountant()
+        prev = 0.0
+        for _ in range(20):
+            acc.add_noise_event(1.0, 0.05)
+            cur = acc.get_privacy_spent(1e-5).epsilon_spent
+            assert cur > prev
+            prev = cur
+
+    def test_tighter_than_gaussian_for_many_events(self):
+        # The point of RDP: sublinear composition beats linear for long runs.
+        g, r = GaussianAccountant(), RDPAccountant()
+        g.add_noise_event(1.0, 0.01, count=10_000)
+        r.add_noise_event(1.0, 0.01, count=10_000)
+        assert (
+            r.get_privacy_spent(1e-5).epsilon_spent
+            < g.get_privacy_spent(1e-5).epsilon_spent
+        )
+
+    def test_amplification_by_subsampling(self):
+        eps = []
+        for q in [0.001, 0.01, 0.1, 1.0]:
+            acc = RDPAccountant()
+            acc.add_noise_event(1.0, q, count=100)
+            eps.append(acc.get_privacy_spent(1e-5).epsilon_spent)
+        assert eps == sorted(eps)
+
+    def test_large_q_falls_back_to_unsampled_bound(self):
+        """Beyond the small-q regime the q² approximation must NOT be applied — events
+        fall back to the exact non-subsampled Gaussian RDP (conservative)."""
+        mid, full = RDPAccountant(), RDPAccountant()
+        mid.add_noise_event(1.0, 0.5, count=10)
+        full.add_noise_event(1.0, 1.0, count=10)
+        np.testing.assert_allclose(mid.total_rdp(), full.total_rdp())
+        # ... which is strictly more spend than the (unsafe) q² formula would claim.
+        small = RDPAccountant()
+        small.add_noise_event(1.0, 0.1, count=10)
+        assert (
+            mid.get_privacy_spent(1e-5).epsilon_spent
+            > small.get_privacy_spent(1e-5).epsilon_spent
+        )
+
+    def test_orders_must_exceed_one(self):
+        with pytest.raises(ValueError):
+            RDPAccountant(orders=[0.5, 2.0])
+
+    def test_optimal_order_in_grid(self):
+        acc = RDPAccountant()
+        acc.add_noise_event(1.0, 0.01, count=100)
+        assert acc.optimal_order(1e-5) in set(acc.orders)
+
+    def test_stress_100k_events_collapsed(self):
+        # Runs of identical events collapse; 100k-step accounting is O(1) space.
+        acc = RDPAccountant()
+        acc.add_noise_event(1.1, 0.004, count=100_000)
+        assert len(acc.state_dict()["events"]) == 1
+        spent = acc.get_privacy_spent(1e-5)
+        assert 0 < spent.epsilon_spent < 100
+
+
+class TestNoiseCalibration:
+    def test_calibrated_sigma_meets_budget(self):
+        sigma = noise_multiplier_for_budget(
+            epsilon=2.0, delta=1e-5, sampling_rate=0.01, num_events=1000
+        )
+        acc = RDPAccountant()
+        acc.add_noise_event(sigma, 0.01, count=1000)
+        assert acc.get_privacy_spent(1e-5).epsilon_spent <= 2.0
+        # ... and is not wastefully large: slightly less noise must blow the budget.
+        acc2 = RDPAccountant()
+        acc2.add_noise_event(max(sigma - 0.05, 1e-3), 0.01, count=1000)
+        assert acc2.get_privacy_spent(1e-5).epsilon_spent > 2.0
+
+    def test_tighter_budget_needs_more_noise(self):
+        s1 = noise_multiplier_for_budget(1.0, 1e-5, 0.01, 100)
+        s2 = noise_multiplier_for_budget(5.0, 1e-5, 0.01, 100)
+        assert s1 > s2

@@ -1,0 +1,86 @@
+"""Clip+noise mechanisms over pytrees (parity: ``tests/unit/privacy/test_mechanism.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.privacy import (
+    GaussianAccountant,
+    PrivacyConfig,
+    PrivacyType,
+    make_privacy_mechanism,
+    privatize_stacked_updates,
+)
+from nanofed_tpu.utils.trees import tree_global_norm
+
+
+def big_update():
+    return {"w": jnp.full((10, 10), 5.0), "b": jnp.full((10,), 5.0)}
+
+
+class TestMechanism:
+    def test_clips_to_max_norm(self, rng):
+        cfg = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1e-6)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg)
+        out = mech.privatize(rng, big_update())
+        assert float(tree_global_norm(out)) == pytest.approx(1.0, rel=1e-3)
+
+    def test_small_update_not_scaled_up(self, rng):
+        cfg = PrivacyConfig(max_gradient_norm=100.0, noise_multiplier=1e-6)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg)
+        small = {"w": jnp.ones((2,)) * 0.1}
+        out = mech.privatize(rng, small)
+        np.testing.assert_allclose(np.asarray(out["w"]), 0.1, atol=1e-3)
+
+    def test_noise_scale_divides_by_batch(self):
+        cfg = PrivacyConfig(max_gradient_norm=2.0, noise_multiplier=3.0)
+        assert make_privacy_mechanism("central", cfg, batch_size=6).noise_scale == pytest.approx(1.0)
+        assert make_privacy_mechanism("local", cfg).noise_scale == pytest.approx(6.0)
+
+    def test_local_forces_batch_one(self):
+        cfg = PrivacyConfig()
+        mech = make_privacy_mechanism(PrivacyType.LOCAL, cfg, batch_size=32)
+        assert mech.batch_size == 1
+
+    def test_noise_actually_added(self, rng):
+        cfg = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg)
+        zero = {"w": jnp.zeros((1000,))}
+        out = mech.privatize(rng, zero)
+        assert float(jnp.std(out["w"])) == pytest.approx(1.0, rel=0.1)
+
+    def test_record_feeds_accountant(self):
+        cfg = PrivacyConfig(noise_multiplier=2.0)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg, batch_size=4)
+        acc = GaussianAccountant()
+        mech.record(acc, sampling_rate=0.5, count=3)
+        assert acc.num_events == 3
+        assert acc.state_dict()["events"] == [[2.0, 0.5, 3.0]]
+
+
+class TestStackedPrivatization:
+    def test_per_client_independent_noise(self, rng):
+        cfg = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg, batch_size=1)
+        stacked = {"w": jnp.zeros((4, 100))}
+        out = privatize_stacked_updates(rng, stacked, mech)
+        assert out["w"].shape == (4, 100)
+        rows = np.asarray(out["w"])
+        for i in range(3):
+            assert not np.array_equal(rows[i], rows[i + 1])
+
+    def test_each_client_clipped(self, rng):
+        cfg = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1e-6)
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg, batch_size=1)
+        stacked = {"w": jnp.full((3, 50), 9.0)}
+        out = privatize_stacked_updates(rng, stacked, mech)
+        norms = np.linalg.norm(np.asarray(out["w"]), axis=1)
+        np.testing.assert_allclose(norms, 1.0, rtol=1e-3)
+
+    def test_jit_compatible(self, rng):
+        cfg = PrivacyConfig()
+        mech = make_privacy_mechanism(PrivacyType.CENTRAL, cfg, batch_size=2)
+        stacked = {"w": jnp.ones((2, 10))}
+        out = jax.jit(lambda k, s: privatize_stacked_updates(k, s, mech))(rng, stacked)
+        assert np.isfinite(np.asarray(out["w"])).all()

@@ -1,0 +1,109 @@
+"""Noise generators (shape/scale/seed reproducibility — parity with
+``tests/unit/privacy/test_generators.py``) and config bounds (parity with
+``test_config.py``)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.privacy import (
+    GaussianNoiseGenerator,
+    LaplacianNoiseGenerator,
+    NoiseType,
+    PrivacyConfig,
+    get_noise_generator,
+    tree_add_noise,
+    tree_noise,
+    validate_noise_input,
+)
+
+
+class TestGenerators:
+    @pytest.mark.parametrize("gen", [GaussianNoiseGenerator(), LaplacianNoiseGenerator()])
+    def test_shape_and_dtype(self, gen, rng):
+        out = gen.sample(rng, (4, 7), 1.0)
+        assert out.shape == (4, 7)
+
+    def test_seed_reproducibility(self, rng):
+        gen = GaussianNoiseGenerator()
+        a = gen.sample(rng, (100,), 2.0)
+        b = gen.sample(rng, (100,), 2.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = gen.sample(jax.random.key(1), (100,), 2.0)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_gaussian_scale(self, rng):
+        out = GaussianNoiseGenerator().sample(rng, (200_000,), 3.0)
+        assert float(jnp.std(out)) == pytest.approx(3.0, rel=0.02)
+        assert float(jnp.mean(out)) == pytest.approx(0.0, abs=0.05)
+
+    def test_laplace_scale(self, rng):
+        # Laplace(b) has std b*sqrt(2).
+        out = LaplacianNoiseGenerator().sample(rng, (200_000,), 2.0)
+        assert float(jnp.std(out)) == pytest.approx(2.0 * np.sqrt(2), rel=0.02)
+
+    def test_zero_scale_is_zero(self, rng):
+        out = GaussianNoiseGenerator().sample(rng, (10,), 0.0)
+        np.testing.assert_array_equal(np.asarray(out), np.zeros(10))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            validate_noise_input((-1, 3), 1.0)
+        with pytest.raises(ValueError):
+            validate_noise_input((3,), -1.0)
+
+    def test_factory(self):
+        assert isinstance(get_noise_generator(NoiseType.GAUSSIAN), GaussianNoiseGenerator)
+        assert isinstance(get_noise_generator("laplacian"), LaplacianNoiseGenerator)
+
+
+class TestTreeNoise:
+    def test_leaves_get_independent_noise(self, rng):
+        tree = {"a": jnp.zeros((50,)), "b": jnp.zeros((50,))}
+        noised = tree_noise(rng, tree, 1.0)
+        assert not np.array_equal(np.asarray(noised["a"]), np.asarray(noised["b"]))
+
+    def test_add_noise_preserves_structure_and_dtype(self, rng):
+        tree = {"w": jnp.ones((3, 4), jnp.bfloat16), "b": jnp.ones((4,))}
+        out = tree_add_noise(rng, tree, 0.5)
+        assert jax.tree.structure(out) == jax.tree.structure(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["b"].shape == (4,)
+
+    def test_jit_compatible(self, rng):
+        tree = {"w": jnp.zeros((8,))}
+        jitted = jax.jit(lambda k, t: tree_add_noise(k, t, 1.0))
+        out = jitted(rng, tree)
+        assert np.isfinite(np.asarray(out["w"])).all()
+
+
+class TestPrivacyConfig:
+    def test_defaults_valid(self):
+        cfg = PrivacyConfig()
+        assert cfg.epsilon == 1.0 and cfg.noise_type is NoiseType.GAUSSIAN
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {"epsilon": 0.001},
+            {"epsilon": 100.0},
+            {"delta": 1e-12},
+            {"delta": 0.5},
+            {"max_gradient_norm": 0.0},
+            {"noise_multiplier": -1.0},
+        ],
+    )
+    def test_bounds_enforced(self, kw):
+        with pytest.raises(ValueError):
+            PrivacyConfig(**kw)
+
+    def test_frozen(self):
+        cfg = PrivacyConfig()
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            cfg.epsilon = 2.0
+
+    def test_hashable_for_jit_static(self):
+        assert hash(PrivacyConfig()) == hash(PrivacyConfig())

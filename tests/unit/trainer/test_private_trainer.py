@@ -1,0 +1,133 @@
+"""DP-SGD trainer tests (parity: ``tests/unit/trainer/test_private_trainer.py`` —
+clipping, noise, budget behaviors — plus per-example-clipping checks the reference can't
+make)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.data import pack_clients, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.privacy import GaussianAccountant, PrivacyConfig, RDPAccountant
+from nanofed_tpu.trainer import (
+    TrainingConfig,
+    local_fit_noise_events,
+    make_dp_grad_fn,
+    make_private_local_fit,
+    record_local_fit,
+    validate_privacy_budget,
+)
+from nanofed_tpu.trainer.private import get_privacy_spent
+from nanofed_tpu.utils.trees import tree_global_norm, tree_sub
+
+
+def _data(n=64, in_dim=8, classes=2, batch=16, seed=0):
+    ds = synthetic_classification(n, classes, (in_dim,), seed=seed)
+    cd = pack_clients(ds, [np.arange(n)], batch_size=batch)
+    return ClientData(*(jnp.asarray(a[0]) for a in cd))
+
+
+def _model(rng, in_dim=8, classes=2):
+    m = get_model("linear", in_features=in_dim, num_classes=classes)
+    return m, m.init(rng)
+
+
+class TestDPGradFn:
+    def test_grad_norm_bounded_by_clip(self, rng):
+        """With negligible noise, the DP gradient's norm is ≤ C (mean of ≤C-norm terms)."""
+        m, params = _model(rng)
+        cfg = PrivacyConfig(max_gradient_norm=0.05, noise_multiplier=1e-6)
+        grad_fn = make_dp_grad_fn(m.apply, cfg)
+        d = _data()
+        xb, yb, mb = d.x[:16], d.y[:16], d.mask[:16]
+        grads, stats = grad_fn(params, xb, yb, mb, jax.random.key(1))
+        assert float(tree_global_norm(grads)) <= 0.05 * 1.001
+        assert float(stats.count) == 16.0
+
+    def test_noise_changes_grads(self, rng):
+        m, params = _model(rng)
+        d = _data()
+        xb, yb, mb = d.x[:16], d.y[:16], d.mask[:16]
+        quiet = make_dp_grad_fn(m.apply, PrivacyConfig(noise_multiplier=1e-6))
+        loud = make_dp_grad_fn(m.apply, PrivacyConfig(noise_multiplier=5.0))
+        g0, _ = quiet(params, xb, yb, mb, jax.random.key(1))
+        g1, _ = loud(params, xb, yb, mb, jax.random.key(1))
+        assert float(tree_global_norm(tree_sub(g0, g1))) > 0.1
+
+    def test_padded_examples_contribute_nothing(self, rng):
+        """A padded example's clipped per-example gradient is zeroed before the sum."""
+        m, params = _model(rng, in_dim=4)
+        ds = synthetic_classification(16, 2, (4,), seed=1)
+        cfg = PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1e-6)
+        grad_fn = make_dp_grad_fn(m.apply, cfg)
+        x = jnp.asarray(ds.x)
+        y = jnp.asarray(ds.y)
+        half_mask = jnp.concatenate([jnp.ones(8), jnp.zeros(8)])
+        # Same real data, garbage in the padded slots:
+        x_garbage = x.at[8:].set(1e3)
+        g_ref, s_ref = grad_fn(params, x, y, half_mask, jax.random.key(2))
+        g_pad, s_pad = grad_fn(params, x_garbage, y, half_mask, jax.random.key(2))
+        np.testing.assert_allclose(
+            np.asarray(jax.flatten_util.ravel_pytree(g_ref)[0]),
+            np.asarray(jax.flatten_util.ravel_pytree(g_pad)[0]),
+            rtol=1e-5,
+        )
+        assert float(s_ref.count) == 8.0 == float(s_pad.count)
+
+
+class TestPrivateLocalFit:
+    def test_trains_and_is_deterministic(self, rng):
+        m, params = _model(rng)
+        fit = jax.jit(
+            make_private_local_fit(
+                m.apply,
+                TrainingConfig(batch_size=16, local_epochs=3),
+                PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=0.5),
+            )
+        )
+        d = _data()
+        r1 = fit(params, d, jax.random.key(1))
+        r2 = fit(params, d, jax.random.key(1))
+        assert float(r1.epoch_loss[-1]) < float(r1.epoch_loss[0])
+        np.testing.assert_array_equal(np.asarray(r1.epoch_loss), np.asarray(r2.epoch_loss))
+
+    def test_vmaps_over_clients(self, rng):
+        m, params = _model(rng)
+        fit = make_private_local_fit(
+            m.apply, TrainingConfig(batch_size=16, local_epochs=1), PrivacyConfig()
+        )
+        ds = synthetic_classification(128, 2, (8,), seed=0)
+        cd = pack_clients(ds, [np.arange(64), np.arange(64, 128)], batch_size=16)
+        stacked = ClientData(*(jnp.asarray(a) for a in cd))
+        keys = jax.random.split(jax.random.key(1), 2)
+        res = jax.vmap(fit, in_axes=(None, 0, 0))(params, stacked, keys)
+        assert res.metrics.loss.shape == (2,)
+        assert np.isfinite(np.asarray(res.metrics.loss)).all()
+
+
+class TestAccountingIntegration:
+    def test_event_count_static(self):
+        cfg = TrainingConfig(batch_size=16, local_epochs=3)
+        assert local_fit_noise_events(cfg, data_capacity=64) == 12
+        capped = TrainingConfig(batch_size=16, local_epochs=3, max_batches=2)
+        assert local_fit_noise_events(capped, data_capacity=64) == 6
+
+    def test_record_uses_true_sampling_rate(self):
+        acc = RDPAccountant()
+        t = TrainingConfig(batch_size=16, local_epochs=1)
+        p = PrivacyConfig(noise_multiplier=1.0)
+        record_local_fit(acc, p, t, data_capacity=64, num_samples=64)
+        # q = 16/64 = 0.25, 4 events
+        assert acc.state_dict()["events"] == [[1.0, 0.25, 4.0]]
+
+    def test_budget_validation_flips(self):
+        acc = GaussianAccountant()
+        p = PrivacyConfig(epsilon=0.5, delta=1e-5, noise_multiplier=1.0)
+        t = TrainingConfig(batch_size=32, local_epochs=1)
+        assert validate_privacy_budget(acc, p)
+        for _ in range(50):
+            record_local_fit(acc, p, t, data_capacity=6400, num_samples=6400)
+        assert not validate_privacy_budget(acc, p)
+        assert get_privacy_spent(acc, p).epsilon_spent > 0.5
