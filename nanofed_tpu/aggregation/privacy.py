@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from nanofed_tpu.core.exceptions import AggregationError
 from nanofed_tpu.core.types import PRNGKey, PyTree
 from nanofed_tpu.privacy.accounting import BasePrivacyAccountant, PrivacySpent
-from nanofed_tpu.privacy.config import PrivacyConfig
+from nanofed_tpu.privacy.config import PrivacyConfig, require_gaussian_accounting
 from nanofed_tpu.privacy.mechanisms import (
     PrivacyMechanism,
     PrivacyType,
@@ -124,6 +124,7 @@ def record_central_privacy(
     would over-report ε by ~K×.)  For the per-update host path
     (``apply_central_privacy``), account with ``central_mechanism(...).record`` instead.
     """
+    require_gaussian_accounting(config.privacy)
     accountant.add_noise_event(config.privacy.noise_multiplier, 1.0, count=num_rounds)
 
 

@@ -185,6 +185,11 @@ class Coordinator:
                         params=self.params,
                         server_state=self.server_state,
                         metrics=metrics.to_dict(),
+                        status=(
+                            "COMPLETED"
+                            if metrics.status == RoundStatus.COMPLETED
+                            else "FAILED"
+                        ),
                     )
                 if self.on_round_end is not None:
                     self.on_round_end(metrics)

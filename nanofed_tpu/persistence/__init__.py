@@ -1,0 +1,43 @@
+"""Persistence: model versioning, round-state checkpointing, fault tolerance.
+
+Replaces ``nanofed/server/model_manager/`` and ``nanofed/server/fault_tolerance.py``.
+"""
+
+from nanofed_tpu.persistence.model_manager import ModelManager, make_json_serializable
+from nanofed_tpu.persistence.serialization import (
+    load_pytree_npz,
+    load_state_pickle,
+    save_pytree_npz,
+    save_state_pickle,
+    tree_to_numpy,
+)
+from nanofed_tpu.persistence.state_store import (
+    COMPLETED,
+    FAILED,
+    RECOVERABLE_EXCEPTIONS,
+    CheckpointMetadata,
+    FileStateStore,
+    RestoredState,
+    SimpleRecoveryStrategy,
+    is_recoverable,
+    run_fault_tolerant,
+)
+
+__all__ = [
+    "COMPLETED",
+    "FAILED",
+    "RECOVERABLE_EXCEPTIONS",
+    "CheckpointMetadata",
+    "FileStateStore",
+    "ModelManager",
+    "RestoredState",
+    "SimpleRecoveryStrategy",
+    "is_recoverable",
+    "load_pytree_npz",
+    "load_state_pickle",
+    "make_json_serializable",
+    "run_fault_tolerant",
+    "save_pytree_npz",
+    "save_state_pickle",
+    "tree_to_numpy",
+]

@@ -1,0 +1,110 @@
+"""Pytree (de)serialization for checkpoints.
+
+The reference persists torch ``state_dict``s with ``torch.save`` (pickle) plus JSON
+sidecars (``nanofed/server/model_manager/manager.py:99-142``, ``fault_tolerance.py:83-136``).
+Here model parameters are saved as ``.npz`` archives keyed by '/'-joined pytree paths —
+binary, compressed, language-neutral, and loadable without executing code — while round
+state (which includes arbitrary optax pytrees) uses pickle of a numpy-ified tree, the
+direct analog of ``torch.save``.
+
+Loading supports two modes:
+* ``like=`` a template pytree — leaves are restored into the template's exact structure
+  (NamedTuples, custom nodes), required when the result feeds back into a jitted step.
+* no template — reconstructs a nested ``dict`` from the '/'-joined names.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from nanofed_tpu.core.exceptions import CheckpointError
+from nanofed_tpu.core.types import PyTree
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+
+def tree_to_numpy(tree: PyTree) -> PyTree:
+    """Fetch every leaf to host memory as a numpy array (one device->host sync)."""
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_pytree_npz(path: str | Path, tree: PyTree) -> None:
+    """Save a pytree of arrays as a compressed ``.npz`` keyed by leaf path names."""
+    named, _ = tree_flatten_with_names(tree)
+    arrays = {name: np.asarray(leaf) for name, leaf in named}
+    if len(arrays) != len(named):
+        raise CheckpointError("pytree has duplicate leaf path names; cannot serialize")
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    tmp.replace(path)  # atomic publish: no torn checkpoint on crash
+
+
+def load_pytree_npz(path: str | Path, like: PyTree | None = None) -> PyTree:
+    """Load a ``.npz`` checkpoint back into a pytree.
+
+    With ``like``, leaves are placed into the template's structure (names must match
+    exactly).  Without it, returns a nested dict built from the '/'-joined names.
+    """
+    path = Path(path)
+    if not path.exists():
+        raise CheckpointError(f"checkpoint not found: {path}")
+    with np.load(path) as data:
+        arrays = {name: data[name] for name in data.files}
+    if like is None:
+        return _nest(arrays)
+    named, treedef = tree_flatten_with_names(like)
+    missing = [name for name, _ in named if name not in arrays]
+    if missing:
+        raise CheckpointError(
+            f"checkpoint {path} is missing leaves {missing[:5]} for the given template"
+        )
+    leaves = []
+    for name, leaf in named:
+        arr = arrays[name]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise CheckpointError(
+                f"shape mismatch for '{name}': checkpoint {arr.shape} vs template "
+                f"{np.shape(leaf)}"
+            )
+        leaves.append(arr)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _nest(flat: dict[str, np.ndarray]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for name, arr in flat.items():
+        node = out
+        parts = name.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def save_state_pickle(path: str | Path, tree: PyTree) -> None:
+    """Pickle an arbitrary pytree (optax states etc.) with numpy leaves.
+
+    The analog of the reference's ``torch.save(state, "state.pt")``
+    (``fault_tolerance.py:109-111``).  Only load checkpoints you wrote.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(tree_to_numpy(tree), f, protocol=pickle.HIGHEST_PROTOCOL)
+    tmp.replace(path)
+
+
+def load_state_pickle(path: str | Path) -> PyTree:
+    path = Path(path)
+    if not path.exists():
+        raise CheckpointError(f"checkpoint not found: {path}")
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -23,6 +23,7 @@ from nanofed_tpu.privacy.config import (
     MIN_EPSILON,
     NoiseType,
     PrivacyConfig,
+    require_gaussian_accounting,
 )
 from nanofed_tpu.privacy.mechanisms import (
     PrivacyMechanism,

@@ -54,3 +54,20 @@ class PrivacyConfig:
             raise ValueError("noise_multiplier must be > 0")
         if not isinstance(self.noise_type, NoiseType):
             raise ValueError(f"noise_type must be a NoiseType, got {self.noise_type!r}")
+
+
+def require_gaussian_accounting(privacy: PrivacyConfig) -> None:
+    """Reject accounting for non-Gaussian noise.
+
+    The Gaussian/RDP accountants bound only the Gaussian mechanism; feeding them
+    Laplacian events would report a meaningless (ε, δ).  (The reference silently does
+    exactly that — ``nanofed/privacy/accountant/gaussian.py`` has no mechanism check;
+    a quirk deliberately not carried over.)
+    """
+    from nanofed_tpu.core.exceptions import PrivacyError
+
+    if privacy.noise_type is not NoiseType.GAUSSIAN:
+        raise PrivacyError(
+            f"privacy accounting supports only NoiseType.GAUSSIAN, got "
+            f"{privacy.noise_type}; Laplacian noise has no accountant in this framework"
+        )

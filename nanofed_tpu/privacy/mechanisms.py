@@ -18,7 +18,7 @@ import jax
 
 from nanofed_tpu.core.types import PRNGKey, PyTree
 from nanofed_tpu.privacy.accounting import BasePrivacyAccountant
-from nanofed_tpu.privacy.config import PrivacyConfig
+from nanofed_tpu.privacy.config import PrivacyConfig, require_gaussian_accounting
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_add_noise
 from nanofed_tpu.utils.trees import tree_clip_by_global_norm
 
@@ -70,6 +70,7 @@ class PrivacyMechanism:
         """Feed ``count`` privatize calls into ``accountant`` (the host-side half of the
         reference's ``accountant.add_noise_event`` call inside ``add_noise``,
         ``mechanisms.py:119-121``)."""
+        require_gaussian_accounting(self.config)
         accountant.add_noise_event(self.config.noise_multiplier, sampling_rate, count=count)
 
 

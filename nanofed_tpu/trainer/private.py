@@ -23,7 +23,11 @@ import jax.numpy as jnp
 
 from nanofed_tpu.core.types import Params, PyTree
 from nanofed_tpu.privacy.accounting import BasePrivacyAccountant, PrivacySpent
-from nanofed_tpu.privacy.config import NoiseType, PrivacyConfig
+from nanofed_tpu.privacy.config import (
+    NoiseType,
+    PrivacyConfig,
+    require_gaussian_accounting,
+)
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn, StepStats, make_local_fit
@@ -112,6 +116,7 @@ def record_local_fit(
     (clamped to 1), correcting the reference's ``samples / max_gradient_norm`` quirk
     (``accountant/gaussian.py:23-25``).
     """
+    require_gaussian_accounting(privacy)
     q = min(1.0, config.batch_size / max(num_samples, 1))
     accountant.add_noise_event(
         privacy.noise_multiplier, q, count=local_fit_noise_events(config, data_capacity)

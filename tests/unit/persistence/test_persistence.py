@@ -1,0 +1,171 @@
+"""Persistence tests — replacement for the reference's
+``tests/unit/server/test_model_manager.py:38-83`` and ``test_fault_tolerance.py:56-212``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.exceptions import CheckpointError, ModelManagerError, NanoFedError
+from nanofed_tpu.models import get_model
+from nanofed_tpu.persistence import (
+    CheckpointMetadata,
+    FileStateStore,
+    ModelManager,
+    SimpleRecoveryStrategy,
+    is_recoverable,
+    load_pytree_npz,
+    save_pytree_npz,
+)
+
+
+@pytest.fixture
+def params():
+    return get_model("mlp", in_features=4, hidden=8, num_classes=3).init(jax.random.key(0))
+
+
+class TestSerialization:
+    def test_npz_round_trip_exact(self, params, tmp_path):
+        p = tmp_path / "ckpt.npz"
+        save_pytree_npz(p, params)
+        restored = load_pytree_npz(p, like=params)
+        assert jax.tree.structure(restored) == jax.tree.structure(params)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_load_without_template_gives_nested_dict(self, params, tmp_path):
+        p = tmp_path / "ckpt.npz"
+        save_pytree_npz(p, {"layer": {"w": jnp.ones((2, 2)), "b": jnp.zeros(2)}})
+        d = load_pytree_npz(p)
+        assert set(d) == {"layer"}
+        assert set(d["layer"]) == {"w", "b"}
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(CheckpointError):
+            load_pytree_npz(tmp_path / "nope.npz")
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        p = tmp_path / "ckpt.npz"
+        save_pytree_npz(p, {"w": jnp.ones((2, 2))})
+        with pytest.raises(CheckpointError):
+            load_pytree_npz(p, like={"w": jnp.ones((3, 3))})
+
+
+class TestModelManager:
+    def test_save_load_round_trip(self, params, tmp_path):
+        mm = ModelManager(tmp_path)
+        v = mm.save_model(params, metadata={"round": 3, "metrics": {"loss": 0.5}})
+        assert v.version_id.startswith("model_v_")
+        assert v.round_number == 3
+        restored, version = mm.load_model(like=params)
+        assert version.version_id == v.version_id
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_load_latest_and_specific(self, params, tmp_path):
+        mm = ModelManager(tmp_path)
+        v1 = mm.save_model(params, metadata={"round": 0})
+        bigger = jax.tree.map(lambda x: x + 1.0, params)
+        v2 = mm.save_model(bigger, metadata={"round": 1})
+        latest, version = mm.load_model(like=params)
+        assert version.version_id == v2.version_id
+        first, _ = mm.load_model(version_id=v1.version_id, like=params)
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(latest)[0]),
+            np.asarray(jax.tree.leaves(first)[0]) + 1.0,
+        )
+
+    def test_list_versions_ordered(self, params, tmp_path):
+        mm = ModelManager(tmp_path)
+        ids = [mm.save_model(params, metadata={"round": i}).version_id for i in range(3)]
+        assert [v.version_id for v in mm.list_versions()] == ids
+
+    def test_counter_survives_new_manager(self, params, tmp_path):
+        ModelManager(tmp_path).save_model(params)
+        v2 = ModelManager(tmp_path).save_model(params)
+        assert v2.version_id.endswith("_0002")
+
+    def test_load_empty_raises(self, tmp_path):
+        with pytest.raises(ModelManagerError):
+            ModelManager(tmp_path).load_model()
+
+
+class TestFileStateStore:
+    def test_checkpoint_restore_round_trip(self, params, tmp_path):
+        store = FileStateStore(tmp_path)
+        opt_state = {"momentum": jax.tree.map(jnp.zeros_like, params)}
+        store.checkpoint(2, params, server_state=opt_state, metrics={"loss": 0.1})
+        restored = store.restore_latest()
+        assert restored is not None
+        assert restored.round_number == 2
+        assert restored.metadata.metrics["loss"] == 0.1
+        assert jax.tree.structure(restored.params) == jax.tree.structure(
+            jax.tree.map(np.asarray, params)
+        )
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored.params)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+    def test_restore_latest_skips_failed(self, params, tmp_path):
+        store = FileStateStore(tmp_path)
+        store.checkpoint(0, params, status="COMPLETED")
+        store.checkpoint(1, params, status="FAILED")
+        restored = store.restore_latest()
+        assert restored.round_number == 0
+
+    def test_restore_latest_empty_is_none(self, tmp_path):
+        assert FileStateStore(tmp_path).restore_latest() is None
+
+    def test_torn_checkpoint_ignored(self, params, tmp_path):
+        store = FileStateStore(tmp_path)
+        store.checkpoint(0, params)
+        # Simulate a crash mid-write of round 1: state without metadata.
+        d = store.base_dir / "round_1"
+        d.mkdir()
+        (d / "state.pkl").write_bytes(b"garbage")
+        assert store.restore_latest().round_number == 0
+
+    def test_prune_keeps_last_k(self, params, tmp_path):
+        store = FileStateStore(tmp_path, keep_last=2)
+        for r in range(5):
+            store.checkpoint(r, params)
+        rounds = [m.round_number for m in store.list_checkpoints()]
+        assert rounds == [3, 4]
+
+    def test_metadata_round_trip(self):
+        m = CheckpointMetadata(round_number=7, status="FAILED", timestamp="t", metrics={"a": 1})
+        assert CheckpointMetadata.from_dict(m.to_dict()) == m
+
+
+class TestRecoveryPolicy:
+    def test_recoverable_exceptions(self):
+        assert is_recoverable(TimeoutError())
+        assert is_recoverable(ConnectionError())
+        assert is_recoverable(RuntimeError())
+        assert not is_recoverable(ValueError())
+        assert not is_recoverable(NanoFedError("deterministic bug"))
+
+    def test_strategy_respects_max_retries(self):
+        s = SimpleRecoveryStrategy(max_retries=2)
+        assert s.should_recover(TimeoutError(), attempt=0)
+        assert s.should_recover(TimeoutError(), attempt=1)
+        assert not s.should_recover(TimeoutError(), attempt=2)
+        assert not s.should_recover(ValueError(), attempt=0)
+
+
+class TestReviewRegressions:
+    """Pin down fixes from code review: malformed configs, FAILED status, retry budget."""
+
+    def test_malformed_config_skipped_in_listing(self, params, tmp_path):
+        mm = ModelManager(tmp_path)
+        v = mm.save_model(params)
+        (mm.configs_dir / "model_v_x_0099.json").write_text("{}")  # valid JSON, no keys
+        assert [x.version_id for x in mm.list_versions()] == [v.version_id]
+        restored, version = mm.load_model(like=params)
+        assert version.version_id == v.version_id
+
+    def test_failed_round_checkpoint_status(self, params, tmp_path):
+        store = FileStateStore(tmp_path)
+        store.checkpoint(0, params, status="COMPLETED")
+        store.checkpoint(1, params, status="FAILED")
+        metas = {m.round_number: m.status for m in store.list_checkpoints()}
+        assert metas == {0: "COMPLETED", 1: "FAILED"}

@@ -84,3 +84,28 @@ class TestStackedPrivatization:
         stacked = {"w": jnp.ones((2, 10))}
         out = jax.jit(lambda k, s: privatize_stacked_updates(k, s, mech))(rng, stacked)
         assert np.isfinite(np.asarray(out["w"])).all()
+
+
+def test_laplacian_accounting_rejected():
+    """Gaussian/RDP accountants only bound the Gaussian mechanism — recording Laplacian
+    events must fail loudly instead of reporting a meaningless epsilon (a reference quirk
+    deliberately not carried over)."""
+    from nanofed_tpu.core.exceptions import PrivacyError
+    from nanofed_tpu.privacy import (
+        GaussianAccountant,
+        NoiseType,
+        PrivacyConfig,
+        PrivacyMechanism,
+        PrivacyType,
+    )
+
+    cfg = PrivacyConfig(noise_type=NoiseType.LAPLACIAN)
+    mech = PrivacyMechanism(config=cfg, privacy_type=PrivacyType.CENTRAL)
+    with pytest.raises(PrivacyError):
+        mech.record(GaussianAccountant())
+
+    from nanofed_tpu.trainer import TrainingConfig
+    from nanofed_tpu.trainer.private import record_local_fit
+
+    with pytest.raises(PrivacyError):
+        record_local_fit(GaussianAccountant(), cfg, TrainingConfig(), 64, 64)
