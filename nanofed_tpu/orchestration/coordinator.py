@@ -98,6 +98,9 @@ class Coordinator:
         model_manager=None,
         state_store=None,
         grad_fn: GradFn | None = None,
+        validation=None,
+        central_privacy=None,
+        local_fit: Callable | None = None,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
     ) -> None:
         self.model = model
@@ -121,7 +124,8 @@ class Coordinator:
 
         self._round_step = build_round_step(
             model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
-            donate=True,
+            local_fit=local_fit, central_privacy=central_privacy,
+            validation=validation, donate=True,
         )
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None
@@ -241,7 +245,9 @@ class Coordinator:
         self.server_state = result.server_opt_state
 
         agg = {k: float(v) for k, v in result.metrics.items()}
-        agg["participating_clients"] = int(agg["participating_clients"])
+        for count_key in ("participating_clients", "valid_clients"):
+            if count_key in agg:
+                agg[count_key] = int(agg[count_key])
 
         eval_metrics: dict[str, float] = {}
         if (

@@ -30,6 +30,11 @@ from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import CLIENT_AXIS
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
+from nanofed_tpu.security.validation import (
+    ValidationConfig,
+    loo_zscore,
+    stacked_leaf_stats,
+)
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn, make_local_fit
 from nanofed_tpu.utils.trees import tree_clip_by_global_norm, tree_sq_norm, tree_where
@@ -54,6 +59,7 @@ def build_round_step(
     grad_fn: GradFn | None = None,
     local_fit: Callable | None = None,
     central_privacy: PrivacyAwareAggregationConfig | None = None,
+    validation: ValidationConfig | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
 ) -> RoundStepFn:
@@ -76,6 +82,13 @@ def build_round_step(
     derived from ``rngs`` so the signature is unchanged; accounting stays host-side via
     ``record_central_privacy``.
 
+    ``validation`` enables in-mesh update validation (the SPMD form of
+    ``DefaultModelValidator``, ``nanofed/server/validation.py:53-135``): per-client
+    finiteness + global-norm bound checks plus cohort z-score anomaly detection, with the
+    cohort statistics computed by ``psum`` across the mesh.  Invalid clients get weight 0 —
+    rejection without data-dependent shapes.  The validity count is reported as
+    ``metrics["valid_clients"]``.
+
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
     and keep only the returned arrays, as ``Coordinator`` does.
@@ -95,6 +108,36 @@ def build_round_step(
         gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
         result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
         delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
+
+        if validation is not None:
+            # In-mesh DefaultModelValidator: all checks on the client DELTA, cohort stats
+            # across the mesh via psum.  Range check is PER-LEAF (ValidationConfig's
+            # documented semantics, matching validate_range); anomaly detection uses the
+            # GLOBAL norm (matching validate_statistics).
+            stats = stacked_leaf_stats(delta)
+            delta = stats.sanitized
+            range_ok = jnp.all(jnp.sqrt(stats.leaf_sq) <= validation.max_norm, axis=0)
+            participating = (weights > 0).astype(jnp.float32)
+            # Cohort anomaly detection: leave-one-out z-score over eligible participants
+            # (see loo_zscore for why exclusion and LOO both matter).
+            eligible = participating * stats.finite * range_ok
+            _, anomalous = loo_zscore(
+                stats.global_norm,
+                eligible,
+                validation.z_score_threshold,
+                float(validation.min_clients_for_stats),
+                sum_fn=lambda x: lax.psum(x.sum(), axis_name),
+            )
+            valid = stats.finite & range_ok & ~anomalous
+            weights = weights * valid.astype(weights.dtype)
+            # Rejected clients' metrics may be NaN; zero their whole metric ROW so the
+            # weighted reduce stays finite.  Valid clients' metrics pass through untouched
+            # — a finite-delta client with an inf loss keeps its divergence visible.
+            result = result._replace(
+                metrics=jax.tree.map(
+                    lambda m: jnp.where(valid, m, jnp.zeros_like(m)), result.metrics
+                )
+            )
 
         total_w = lax.psum(weights.sum(), axis_name)
         if central_privacy is not None:
@@ -120,7 +163,15 @@ def build_round_step(
         new_sos = tree_where(ok, new_sos, sos)
 
         metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
-        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        if validation is not None:
+            # participating = PRE-validation cohort; valid = the subset that survived.
+            # The difference is the number of rejected updates this round.
+            metrics["participating_clients"] = lax.psum(participating.sum(), axis_name)
+            metrics["valid_clients"] = lax.psum(
+                (valid & (participating > 0)).sum(), axis_name
+            )
+        else:
+            metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
         sq_norms = jax.vmap(tree_sq_norm)(delta)
         return new_gp, new_sos, metrics, result.metrics, sq_norms
 

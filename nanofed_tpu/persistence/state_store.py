@@ -147,7 +147,13 @@ class FileStateStore:
 
     def _prune(self) -> None:
         metas = self.list_checkpoints()
+        # The newest COMPLETED checkpoint is the recovery point restore_latest() needs;
+        # it must survive pruning even when newer FAILED rounds fill the keep budget.
+        completed = [m for m in metas if m.status == COMPLETED]
+        protect = {completed[-1].round_number} if completed else set()
         for meta in metas[: max(0, len(metas) - self.keep_last)]:
+            if meta.round_number in protect:
+                continue
             d = self._round_dir(meta.round_number)
             for f in d.iterdir():
                 f.unlink()

@@ -1,0 +1,77 @@
+"""Update validation, signing, and secure aggregation.
+
+Replaces ``nanofed/server/validation.py`` and ``nanofed/server/aggregator/secure.py``
+(stage 8 of SURVEY.md §7): statistical validation runs vectorized over the stacked client
+axis and folds into aggregation weights; signing and secure aggregation are host-path,
+cross-trust-domain features for the real-network mode.
+"""
+
+# secure_agg and signing need the optional `cryptography` dependency ([net] extra); they
+# are exposed lazily so importing the validation path (pulled in by the core round engine)
+# works on a base install.
+_CRYPTO_EXPORTS = {
+    "ClientKeyPair": "secure_agg",
+    "SecureAggregationConfig": "secure_agg",
+    "Share": "secure_agg",
+    "ThresholdSecureAggregator": "secure_agg",
+    "TransportBox": "secure_agg",
+    "add_shares": "secure_agg",
+    "dequantize": "secure_agg",
+    "mask_update": "secure_agg",
+    "quantize": "secure_agg",
+    "reconstruct_vector": "secure_agg",
+    "share_vector": "secure_agg",
+    "unmask_sum": "secure_agg",
+    "SecurityManager": "signing",
+    "canonical_bytes": "signing",
+    "verify_signature": "signing",
+}
+
+
+def __getattr__(name: str):
+    if name in _CRYPTO_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f"nanofed_tpu.security.{_CRYPTO_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+from nanofed_tpu.security.validation import (  # noqa: E402
+    ValidationConfig,
+    ValidationReport,
+    ValidationResult,
+    apply_validation_mask,
+    reference_shapes,
+    validate_client_updates,
+    validate_range,
+    validate_shape,
+    validate_statistics,
+)
+
+__all__ = [
+    "ClientKeyPair",
+    "SecureAggregationConfig",
+    "SecurityManager",
+    "Share",
+    "ThresholdSecureAggregator",
+    "TransportBox",
+    "ValidationConfig",
+    "ValidationReport",
+    "ValidationResult",
+    "add_shares",
+    "apply_validation_mask",
+    "canonical_bytes",
+    "dequantize",
+    "mask_update",
+    "quantize",
+    "reconstruct_vector",
+    "reference_shapes",
+    "share_vector",
+    "unmask_sum",
+    "validate_client_updates",
+    "validate_range",
+    "validate_shape",
+    "validate_statistics",
+    "verify_signature",
+]

@@ -1,0 +1,322 @@
+"""Secure aggregation: the server learns only the SUM of client updates.
+
+Capability parity with ``nanofed/server/aggregator/secure.py`` — but that file's crypto is
+placeholder-grade (XOR of RSA-OAEP ciphertexts presented as homomorphic addition,
+``secure.py:143-153``; a masking scheme where the server decrypts every individual update,
+``secure.py:275-313``).  Per SURVEY.md §7, the *capability* is re-implemented honestly here
+with the standard constructions:
+
+* **Pairwise additive masking** (the SecAgg construction of Bonawitz et al., CCS 2017,
+  single-round, no-dropout variant): every client pair (i, j) derives a shared seed via
+  X25519 ECDH + HKDF; client i adds ``PRG(seed_ij)`` for j > i and subtracts it for j < i.
+  In the modular sum over all clients the masks cancel *exactly* — updates are fixed-point
+  quantized to uint32 so cancellation is bit-exact, not float-approximate.  The server sees
+  only uniformly-masked vectors and the final sum.
+
+* **Shamir threshold secret sharing** over the Mersenne prime 2^31 − 1: each client splits
+  its quantized update into ``n`` shares of which any ``threshold`` reconstruct; share
+  addition is pointwise, so summing every client's share ``k`` and reconstructing yields the
+  cohort sum while fewer than ``threshold`` servers learn nothing.  (Honest replacement for
+  ``ThresholdSecureAggregation``, ``nanofed/server/aggregator/privacy.py:72-110``, which is
+  a plain stacked sum.)
+
+* **AES-GCM transport encryption** for update payloads in the real-network mode (the honest
+  role of ``SecureMaskingAggregator``'s AES layer, ``secure.py:221-247``).
+
+Everything here is host-path code: secure aggregation is a cross-trust-domain feature that
+only exists when clients are genuinely separate parties (SURVEY.md §7 stage 8).  The
+in-simulator SPMD path never pays for it.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import numpy as np
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from nanofed_tpu.core.exceptions import AggregationError
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.utils.trees import tree_ravel
+
+
+@dataclass(frozen=True)
+class SecureAggregationConfig:
+    """Parity: ``SecureAggregationConfig`` (``nanofed/server/aggregator/secure.py:32-40``).
+
+    ``frac_bits`` sets fixed-point precision (quantization step 2^-frac_bits); the masked
+    ring is uint32.  The sum of all clients' scaled values must stay within ±2^31·2^-frac_bits
+    to avoid wraparound — with the default 16 fractional bits that is ±32768 total mass,
+    far above any normalized model update.
+    """
+
+    min_clients: int = 3
+    frac_bits: int = 16
+    threshold: int = 2  # Shamir reconstruction threshold
+
+
+# ---------------------------------------------------------------------------------------
+# Fixed-point quantization (exact modular arithmetic ⇒ exact mask cancellation)
+# ---------------------------------------------------------------------------------------
+
+
+def quantize(vec: np.ndarray, frac_bits: int) -> np.ndarray:
+    """Float vector → uint32 fixed-point (two's-complement wraparound encodes sign)."""
+    scaled = np.round(np.asarray(vec, np.float64) * (1 << frac_bits)).astype(np.int64)
+    return (scaled % (1 << 32)).astype(np.uint32)
+
+
+def dequantize(vec: np.ndarray, frac_bits: int) -> np.ndarray:
+    """uint32 fixed-point → float64, interpreting values as centered (signed) residues."""
+    as_int = vec.astype(np.int64)
+    centered = np.where(as_int >= 1 << 31, as_int - (1 << 32), as_int)
+    return centered.astype(np.float64) / (1 << frac_bits)
+
+
+# ---------------------------------------------------------------------------------------
+# Pairwise additive masking (SecAgg)
+# ---------------------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ClientKeyPair:
+    """One client's X25519 keypair for pairwise seed agreement."""
+
+    private: X25519PrivateKey
+
+    @staticmethod
+    def generate() -> "ClientKeyPair":
+        return ClientKeyPair(private=X25519PrivateKey.generate())
+
+    def public_bytes(self) -> bytes:
+        return self.private.public_key().public_bytes(
+            encoding=serialization.Encoding.Raw, format=serialization.PublicFormat.Raw
+        )
+
+
+def _pair_seed(my_key: ClientKeyPair, peer_public: bytes, round_context: bytes) -> bytes:
+    """Shared 32-byte seed for a client pair: ECDH → HKDF bound to the round context.
+
+    Symmetric by construction (X25519(sk_i, pk_j) == X25519(sk_j, pk_i)), so both ends of
+    the pair expand the identical mask and the ± cancellation is exact.
+    """
+    shared = my_key.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
+    return HKDF(
+        algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg", info=round_context
+    ).derive(shared)
+
+
+def _prg_uint32(seed: bytes, size: int) -> np.ndarray:
+    """Expand a 32-byte seed into ``size`` uniform uint32 words (Philox counter PRG)."""
+    words = np.frombuffer(seed[:16], dtype=np.uint64)
+    return np.random.Generator(np.random.Philox(key=words)).integers(
+        0, 1 << 32, size=size, dtype=np.uint32
+    )
+
+
+def mask_update(
+    params: Params,
+    client_index: int,
+    my_key: ClientKeyPair,
+    all_public_keys: Sequence[bytes],
+    round_number: int,
+    config: SecureAggregationConfig | None = None,
+    weight: float = 1.0,
+) -> np.ndarray:
+    """Client side: quantize ``weight · params`` and add the pairwise masks.
+
+    Returns the masked flat uint32 vector to send to the server.  ``weight`` lets FedAvg
+    weighting survive secure aggregation: clients pre-scale by (their weight / total) so the
+    server-side sum IS the weighted mean.
+    """
+    config = config or SecureAggregationConfig()
+    if len(all_public_keys) < config.min_clients:
+        raise AggregationError(
+            f"Need at least {config.min_clients} clients, got {len(all_public_keys)}"
+        )
+    flat, _ = tree_ravel(params)
+    vec = quantize(np.asarray(flat, np.float64) * weight, config.frac_bits)
+    ctx = f"round:{round_number}".encode()
+    for j, peer_pk in enumerate(all_public_keys):
+        if j == client_index:
+            continue
+        mask = _prg_uint32(_pair_seed(my_key, peer_pk, ctx), vec.size)
+        if j > client_index:
+            vec = vec + mask  # uint32 wraps mod 2^32 by construction
+        else:
+            vec = vec - mask
+    return vec
+
+
+def unmask_sum(
+    masked_updates: Iterable[np.ndarray],
+    template: Params,
+    config: SecureAggregationConfig | None = None,
+) -> Params:
+    """Server side: modular sum of masked vectors — pairwise masks cancel — then
+    dequantize and unravel back into the model pytree."""
+    config = config or SecureAggregationConfig()
+    vectors = list(masked_updates)
+    if len(vectors) < config.min_clients:
+        raise AggregationError(
+            f"Need at least {config.min_clients} clients, got {len(vectors)}"
+        )
+    total = np.zeros_like(vectors[0])
+    for v in vectors:
+        total = total + v
+    _, unravel = tree_ravel(template)
+    import jax.numpy as jnp
+
+    return unravel(jnp.asarray(dequantize(total, config.frac_bits), jnp.float32))
+
+
+# ---------------------------------------------------------------------------------------
+# Shamir threshold secret sharing over GF(2^31 - 1)
+# ---------------------------------------------------------------------------------------
+
+_PRIME = (1 << 31) - 1  # Mersenne prime; int64 products of residues stay < 2^62
+
+
+def _mod(x: np.ndarray) -> np.ndarray:
+    return np.mod(x, _PRIME)
+
+
+@dataclass(frozen=True)
+class Share:
+    """One party's share: evaluation point ``x`` and the share vector."""
+
+    x: int
+    values: np.ndarray  # int64 residues mod _PRIME
+
+
+def share_vector(
+    values: np.ndarray, num_shares: int, threshold: int, rng: np.random.Generator | None = None
+) -> list[Share]:
+    """Split an int64 vector (entries in (−2^30, 2^30), negatives encoded mod p) into
+    ``num_shares`` Shamir shares with reconstruction threshold ``threshold``."""
+    if not 1 <= threshold <= num_shares:
+        raise AggregationError(f"invalid threshold {threshold} for {num_shares} shares")
+    rng = rng or np.random.default_rng(secrets.randbits(64))
+    secret = _mod(np.asarray(values, np.int64))
+    # Random degree-(t-1) polynomial per element with constant term = secret.
+    coeffs = rng.integers(0, _PRIME, size=(threshold - 1, secret.size), dtype=np.int64)
+    shares = []
+    for x in range(1, num_shares + 1):
+        acc = np.zeros_like(secret)
+        for c in coeffs[::-1]:  # Horner: acc = acc*x + c
+            acc = _mod(acc * x + c)
+        shares.append(Share(x=x, values=_mod(acc * x + secret)))
+    return shares
+
+
+def _lagrange_at_zero(xs: Sequence[int]) -> list[int]:
+    """Lagrange basis coefficients ℓ_k(0) mod p for the given evaluation points."""
+    coeffs = []
+    for k, xk in enumerate(xs):
+        num, den = 1, 1
+        for m, xm in enumerate(xs):
+            if m == k:
+                continue
+            num = (num * (-xm)) % _PRIME
+            den = (den * (xk - xm)) % _PRIME
+        coeffs.append((num * pow(den, _PRIME - 2, _PRIME)) % _PRIME)
+    return coeffs
+
+
+def reconstruct_vector(shares: Sequence[Share], threshold: int) -> np.ndarray:
+    """Recover the secret vector from any ``threshold`` shares (centered back to signed)."""
+    if len(shares) < threshold:
+        raise AggregationError(f"need {threshold} shares, got {len(shares)}")
+    use = shares[:threshold]
+    acc = np.zeros_like(use[0].values)
+    for coef, share in zip(_lagrange_at_zero([s.x for s in use]), use):
+        acc = _mod(acc + _mod(share.values * coef))
+    return np.where(acc > _PRIME // 2, acc - _PRIME, acc)
+
+
+def add_shares(per_client_shares: Sequence[Sequence[Share]]) -> list[Share]:
+    """Pointwise share addition: party k sums every client's k-th share.  Reconstructing
+    the result yields the SUM of all client secrets — the threshold secure-sum."""
+    num_parties = len(per_client_shares[0])
+    out = []
+    for k in range(num_parties):
+        x = per_client_shares[0][k].x
+        acc = np.zeros_like(per_client_shares[0][k].values)
+        for client in per_client_shares:
+            if client[k].x != x:
+                raise AggregationError("share evaluation points misaligned across clients")
+            acc = _mod(acc + client[k].values)
+        out.append(Share(x=x, values=acc))
+    return out
+
+
+class ThresholdSecureAggregator:
+    """Threshold secure-sum of model updates via Shamir sharing.
+
+    Honest replacement for ``ThresholdSecureAggregation``
+    (``nanofed/server/aggregator/privacy.py:72-110``).  Values are fixed-point quantized
+    (entries must stay within ±2^30·2^-frac_bits after summation).
+    """
+
+    def __init__(self, num_parties: int, config: SecureAggregationConfig | None = None):
+        self._config = config or SecureAggregationConfig()
+        self._num_parties = num_parties
+
+    def share_update(self, params: Params, weight: float = 1.0) -> list[Share]:
+        flat, _ = tree_ravel(params)
+        scaled = np.round(
+            np.asarray(flat, np.float64) * weight * (1 << self._config.frac_bits)
+        ).astype(np.int64)
+        return share_vector(scaled, self._num_parties, self._config.threshold)
+
+    def aggregate(self, per_client_shares: Sequence[Sequence[Share]], template: Params) -> Params:
+        if len(per_client_shares) < self._config.min_clients:
+            raise AggregationError(
+                f"Need at least {self._config.min_clients} clients, "
+                f"got {len(per_client_shares)}"
+            )
+        summed = add_shares(per_client_shares)
+        total = reconstruct_vector(summed, self._config.threshold)
+        _, unravel = tree_ravel(template)
+        import jax.numpy as jnp
+
+        return unravel(
+            jnp.asarray(total.astype(np.float64) / (1 << self._config.frac_bits), jnp.float32)
+        )
+
+
+# ---------------------------------------------------------------------------------------
+# AES-GCM transport encryption
+# ---------------------------------------------------------------------------------------
+
+
+class TransportBox:
+    """Authenticated encryption for update payloads on the wire.
+
+    The honest role of the reference's AES-GCM layer (``secure.py:221-247``): confidentiality
+    + integrity between one client and the server, NOT aggregate privacy (that is the
+    masking/Shamir layer's job).
+    """
+
+    def __init__(self, key: bytes | None = None) -> None:
+        self._key = key if key is not None else AESGCM.generate_key(bit_length=256)
+
+    @property
+    def key(self) -> bytes:
+        return self._key
+
+    def encrypt(self, payload: bytes, associated_data: bytes = b"") -> bytes:
+        nonce = os.urandom(12)
+        return nonce + AESGCM(self._key).encrypt(nonce, payload, associated_data)
+
+    def decrypt(self, blob: bytes, associated_data: bytes = b"") -> bytes:
+        return AESGCM(self._key).decrypt(blob[:12], blob[12:], associated_data)

@@ -1,0 +1,85 @@
+"""RSA-PSS signing of model updates (host path).
+
+Capability parity with ``SecurityManager`` (``nanofed/server/validation.py:138-212``):
+sign/verify a params pytree with RSA-PSS/SHA-256.  Signing is inherently a host-side,
+cross-trust-domain concern — it lives outside jit on the transport path.
+
+The canonical byte encoding improves on the reference's ``key + raw tobytes`` concatenation
+(``validation.py:160-164``), which is ambiguous under dtype/shape changes: here every leaf
+contributes ``name:dtype:shape:bytes`` in sorted-name order, so a reshaped or recast leaf
+cannot collide with the original.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from cryptography.hazmat.primitives.asymmetric.rsa import RSAPublicKey
+
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.utils.logger import Logger
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+
+def canonical_bytes(params: Params) -> bytes:
+    """Deterministic byte serialization of a params pytree for signing."""
+    named, _ = tree_flatten_with_names(params)
+    out = bytearray()
+    for name, leaf in sorted(named, key=lambda kv: kv[0]):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        header = f"{name}:{arr.dtype.str}:{arr.shape}:".encode()
+        out += header + arr.tobytes()
+    return bytes(out)
+
+
+_PSS = padding.PSS(mgf=padding.MGF1(hashes.SHA256()), salt_length=padding.PSS.MAX_LENGTH)
+
+
+def verify_signature(params: Params, signature: bytes, public_key: bytes) -> bool:
+    """Verify ``signature`` over ``params`` against a PEM public key
+    (parity: ``nanofed/server/validation.py:179-212``).
+
+    Module-level so verifiers (the server checking N clients) never pay the RSA keypair
+    generation that constructing a ``SecurityManager`` implies.
+    """
+    try:
+        key = serialization.load_pem_public_key(public_key)
+        if not isinstance(key, RSAPublicKey):
+            Logger().error("Unsupported public key type.")
+            return False
+        key.verify(signature, canonical_bytes(params), _PSS, hashes.SHA256())
+        return True
+    except InvalidSignature:
+        return False
+    except Exception as e:  # corrupt PEM, etc. — verification fails closed
+        Logger().error(f"Signature verification failed: {e}")
+        return False
+
+
+class SecurityManager:
+    """Holds this party's RSA keypair; signs outgoing and verifies incoming updates.
+
+    Parity: ``nanofed/server/validation.py:138-212``.
+    """
+
+    def __init__(self, key_size: int = 2048) -> None:
+        self._private_key = rsa.generate_private_key(public_exponent=65537, key_size=key_size)
+        self._public_key = self._private_key.public_key()
+        self._logger = Logger()
+
+    def get_public_key(self) -> bytes:
+        """PEM-encoded public key for distribution to verifiers."""
+        return self._public_key.public_bytes(
+            encoding=serialization.Encoding.PEM,
+            format=serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    def sign_params(self, params: Params) -> bytes:
+        """Sign a params pytree (parity: ``sign_update``, ``validation.py:155-177``)."""
+        return self._private_key.sign(canonical_bytes(params), _PSS, hashes.SHA256())
+
+    def verify_signature(self, params: Params, signature: bytes, public_key: bytes) -> bool:
+        """Instance-method convenience over the module-level ``verify_signature``."""
+        return verify_signature(params, signature, public_key)

@@ -1,0 +1,190 @@
+"""In-mesh validation: a poisoned client must be dropped from the reduce with weight 0,
+leaving the aggregate identical to a round without that client."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.models import get_model
+from nanofed_tpu.parallel import (
+    build_round_step,
+    init_server_state,
+    make_mesh,
+    shard_client_data,
+)
+from nanofed_tpu.security import ValidationConfig
+from nanofed_tpu.trainer import TrainingConfig, stack_rngs
+
+
+def _make_setup(devices, local_fit):
+    mesh = make_mesh(devices)
+    model = get_model("linear", in_features=4, num_classes=3)
+    c, n = 8, 16
+    rng = np.random.default_rng(0)
+    data = ClientData(
+        x=jnp.asarray(rng.normal(size=(c, n, 4)), jnp.float32),
+        y=jnp.asarray(rng.integers(0, 3, size=(c, n))),
+        mask=jnp.ones((c, n), jnp.float32),
+    )
+    data = shard_client_data(data, mesh)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    strategy = fedavg_strategy()
+    step = build_round_step(
+        model.apply, training, mesh, strategy, local_fit=local_fit,
+        validation=ValidationConfig(max_norm=100.0, min_clients_for_stats=100),
+    )
+    params = model.init(jax.random.key(0))
+    return mesh, model, data, strategy, step, params
+
+
+def test_nan_client_dropped(devices):
+    from nanofed_tpu.trainer.local import make_local_fit
+
+    model = get_model("linear", in_features=4, num_classes=3)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    base = make_local_fit(model.apply, training)
+
+    def nan_fit(gp, data, rng):
+        res = base(gp, data, rng)
+        # Poison via a data sentinel: a diverged client produces NaN params AND NaN
+        # metrics, so both the param reduce and the metric reduce must survive it.
+        poisoned = data.x[0, 0] > 1e5
+        params = jax.tree.map(
+            lambda p: jnp.where(poisoned, jnp.nan, p), res.params
+        )
+        metrics = jax.tree.map(
+            lambda m: jnp.where(poisoned, jnp.nan, m), res.metrics
+        )
+        return res._replace(params=params, metrics=metrics)
+
+    mesh, model, data, strategy, step, params = _make_setup(devices, nan_fit)
+    sos = init_server_state(strategy, params)
+    rngs = stack_rngs(jax.random.key(0), 8)
+    weights = compute_weights(data.num_samples)
+
+    # Clean run (no poisoning sentinel present).
+    clean = step(params, sos, data, weights, rngs)
+    assert not any(
+        np.isnan(np.asarray(x)).any() for x in jax.tree.leaves(clean.params)
+    )
+    assert int(clean.metrics["valid_clients"]) == 8
+
+    # Poison client 3 via the sentinel: it must be excluded, result stays finite.
+    x = np.array(data.x)
+    x[3, 0] = 1e6
+    data_p = data._replace(x=jax.device_put(jnp.asarray(x), data.x.sharding))
+    poisoned = step(params, sos, data_p, weights, rngs)
+    assert not any(
+        np.isnan(np.asarray(p)).any() for p in jax.tree.leaves(poisoned.params)
+    )
+    assert int(poisoned.metrics["valid_clients"]) == 7
+    # The rejected client is visible: participation counts the pre-validation cohort.
+    assert int(poisoned.metrics["participating_clients"]) == 8
+    # Round-level metrics stay finite even though the dropped client reported NaN loss.
+    assert np.isfinite(float(poisoned.metrics["loss"]))
+    assert np.isfinite(float(poisoned.metrics["accuracy"]))
+
+
+def test_nan_majority_does_not_skew_cohort_stats(devices):
+    """Clients that failed finiteness must be excluded from the z-score cohort: with 4 of
+    8 clients NaN-poisoned, the honest half must all remain valid."""
+    from nanofed_tpu.trainer.local import make_local_fit
+
+    model = get_model("linear", in_features=4, num_classes=3)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    base = make_local_fit(model.apply, training)
+
+    def nan_fit(gp, data, rng):
+        res = base(gp, data, rng)
+        poisoned = data.x[0, 0] > 1e5
+        return res._replace(
+            params=jax.tree.map(lambda p: jnp.where(poisoned, jnp.nan, p), res.params)
+        )
+
+    mesh = make_mesh(devices)
+    c, n = 8, 16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(c, n, 4)).astype(np.float32)
+    for i in (0, 2, 4, 6):
+        x[i, 0] = 1e6
+    data = shard_client_data(
+        ClientData(
+            x=jnp.asarray(x),
+            y=jnp.asarray(rng.integers(0, 3, size=(c, n))),
+            mask=jnp.ones((c, n), jnp.float32),
+        ),
+        mesh,
+    )
+    step = build_round_step(
+        model.apply, training, mesh, fedavg_strategy(), local_fit=nan_fit,
+        # High z threshold: this test isolates NaN-exclusion from cohort stats; the
+        # tightly-clustered honest norms would make any LOO z-score sensitive.
+        validation=ValidationConfig(
+            max_norm=100.0, min_clients_for_stats=3, z_score_threshold=10.0
+        ),
+    )
+    params = model.init(jax.random.key(0))
+    sos = init_server_state(fedavg_strategy(), params)
+    result = step(
+        params, sos, data, compute_weights(data.num_samples), stack_rngs(jax.random.key(0), c)
+    )
+    assert int(result.metrics["valid_clients"]) == 4
+    assert not any(np.isnan(np.asarray(p)).any() for p in jax.tree.leaves(result.params))
+
+
+def test_zscore_outlier_dropped(devices):
+    from nanofed_tpu.trainer.local import make_local_fit
+
+    model = get_model("linear", in_features=4, num_classes=3)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    base = make_local_fit(model.apply, training)
+
+    def scaling_fit(gp, data, rng):
+        res = base(gp, data, rng)
+        # Sentinel-marked client returns a 1000x-scaled delta (model poisoning).
+        factor = jnp.where(data.x[0, 0] > 1e5, 1000.0, 1.0)
+        params = jax.tree.map(
+            lambda p, g: g + factor * (p - g), res.params, gp
+        )
+        return res._replace(params=params)
+
+    mesh = make_mesh(devices)
+    c, n = 8, 16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(c, n, 4)).astype(np.float32)
+    x[5, 0] = 1e6  # client 5 is the attacker
+    data = shard_client_data(
+        ClientData(
+            x=jnp.asarray(x),
+            y=jnp.asarray(rng.integers(0, 3, size=(c, n))),
+            mask=jnp.ones((c, n), jnp.float32),
+        ),
+        mesh,
+    )
+    step = build_round_step(
+        model.apply,
+        training,
+        mesh,
+        fedavg_strategy(),
+        local_fit=scaling_fit,
+        validation=ValidationConfig(
+            max_norm=1e9, min_clients_for_stats=5, z_score_threshold=2.0
+        ),
+    )
+    params = model.init(jax.random.key(0))
+    sos = init_server_state(fedavg_strategy(), params)
+    result = step(params, sos, data, compute_weights(data.num_samples), stack_rngs(jax.random.key(0), c))
+    assert int(result.metrics["valid_clients"]) == 7
+    assert int(result.metrics["participating_clients"]) == 8
+    # The update applied must be small — the 1000x delta was excluded.
+    delta_norm = float(
+        jnp.sqrt(
+            sum(
+                jnp.sum(jnp.square(a - b))
+                for a, b in zip(jax.tree.leaves(result.params), jax.tree.leaves(params))
+            )
+        )
+    )
+    assert delta_norm < 10.0

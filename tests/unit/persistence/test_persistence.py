@@ -131,6 +131,22 @@ class TestFileStateStore:
         rounds = [m.round_number for m in store.list_checkpoints()]
         assert rounds == [3, 4]
 
+    def test_prune_protects_last_completed(self, params, tmp_path):
+        # FAILED rounds filling the keep budget must not evict the only recovery point.
+        store = FileStateStore(tmp_path, keep_last=2)
+        store.checkpoint(0, params, status="COMPLETED")
+        store.checkpoint(1, params, status="FAILED")
+        store.checkpoint(2, params, status="FAILED")
+        assert store.restore_latest() is not None
+        assert store.restore_latest().round_number == 0
+        # A newer COMPLETED checkpoint releases the old one for pruning.
+        store.checkpoint(3, params, status="COMPLETED")
+        store.checkpoint(4, params, status="FAILED")
+        store.checkpoint(5, params, status="FAILED")
+        rounds = [m.round_number for m in store.list_checkpoints()]
+        assert 3 in rounds and 0 not in rounds
+        assert store.restore_latest().round_number == 3
+
     def test_metadata_round_trip(self):
         m = CheckpointMetadata(round_number=7, status="FAILED", timestamp="t", metrics={"a": 1})
         assert CheckpointMetadata.from_dict(m.to_dict()) == m

@@ -1,0 +1,33 @@
+"""The core round engine must import on a base install (no `cryptography`): the security
+package's crypto-backed modules are lazy exports, only the validation path is eager."""
+
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import sys
+
+class _Block:
+    def find_module(self, name, path=None):
+        if name == "cryptography" or name.startswith("cryptography."):
+            return self
+    def load_module(self, name):
+        raise ImportError(f"blocked: {name}")
+
+sys.meta_path.insert(0, _Block())
+import nanofed_tpu.parallel.round_step  # noqa: F401  (pulls security.validation)
+from nanofed_tpu.security import ValidationConfig  # noqa: F401
+print("OK")
+"""
+
+
+def test_round_engine_imports_without_cryptography():
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout

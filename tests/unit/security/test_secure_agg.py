@@ -1,0 +1,162 @@
+"""Secure aggregation tests — the capability surface of the reference's
+``tests/unit/server/aggregator/test_secure.py:55-272`` (round-trips, tamper detection,
+min-client enforcement) against the honest constructions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from cryptography.exceptions import InvalidTag
+
+from nanofed_tpu.core.exceptions import AggregationError
+from nanofed_tpu.security import (
+    ClientKeyPair,
+    SecureAggregationConfig,
+    ThresholdSecureAggregator,
+    TransportBox,
+    dequantize,
+    mask_update,
+    quantize,
+    reconstruct_vector,
+    share_vector,
+    unmask_sum,
+)
+
+
+def _client_params(seed, scale=1.0):
+    k = jax.random.key(seed)
+    k1, k2 = jax.random.split(k)
+    return {
+        "dense": {
+            "w": jax.random.normal(k1, (4, 3)) * scale,
+            "b": jax.random.normal(k2, (3,)) * scale,
+        }
+    }
+
+
+def _tree_allclose(a, b, atol):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+class TestQuantization:
+    def test_roundtrip(self):
+        v = np.array([-3.25, 0.0, 1.5, 0.0001, -200.0])
+        out = dequantize(quantize(v, 16), 16)
+        np.testing.assert_allclose(out, v, atol=2**-16)
+
+    def test_modular_sum_is_exact(self):
+        # (q(a) + q(b)) mod 2^32 dequantizes to a+b even when one addend is negative.
+        a, b = np.array([-1.5]), np.array([2.25])
+        total = quantize(a, 16) + quantize(b, 16)
+        np.testing.assert_allclose(dequantize(total, 16), a + b, atol=2**-15)
+
+
+class TestPairwiseMasking:
+    def test_masks_cancel_to_weighted_mean(self):
+        cfg = SecureAggregationConfig(min_clients=3, frac_bits=16)
+        params = [_client_params(i) for i in range(3)]
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        weights = np.array([1.0, 2.0, 1.0])
+        rel = weights / weights.sum()
+        masked = [
+            mask_update(params[i], i, keys[i], pks, round_number=0, config=cfg, weight=rel[i])
+            for i in range(3)
+        ]
+        out = unmask_sum(masked, params[0], cfg)
+        expected = jax.tree.map(
+            lambda *xs: sum(w * x for w, x in zip(rel, xs)), *params
+        )
+        _tree_allclose(out, expected, atol=3 * 2**-15)
+
+    def test_masked_vector_hides_plaintext(self):
+        cfg = SecureAggregationConfig(min_clients=3, frac_bits=16)
+        p = _client_params(0)
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        masked = mask_update(p, 0, keys[0], pks, round_number=0, config=cfg)
+        plain = quantize(np.asarray(jax.flatten_util.ravel_pytree(p)[0], np.float64), 16)
+        # A uniformly-masked vector should share (essentially) no entries with plaintext.
+        assert np.mean(masked == plain) < 0.01
+
+    def test_round_context_changes_masks(self):
+        cfg = SecureAggregationConfig(min_clients=3)
+        p = _client_params(0)
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        m0 = mask_update(p, 0, keys[0], pks, round_number=0, config=cfg)
+        m1 = mask_update(p, 0, keys[0], pks, round_number=1, config=cfg)
+        assert np.mean(m0 == m1) < 0.01
+
+    def test_min_clients_enforced(self):
+        cfg = SecureAggregationConfig(min_clients=3)
+        keys = [ClientKeyPair.generate() for _ in range(2)]
+        pks = [k.public_bytes() for k in keys]
+        with pytest.raises(AggregationError):
+            mask_update(_client_params(0), 0, keys[0], pks, 0, cfg)
+        with pytest.raises(AggregationError):
+            unmask_sum([np.zeros(5, np.uint32)] * 2, _client_params(0), cfg)
+
+
+class TestShamir:
+    def test_share_reconstruct_exact(self):
+        secret = np.array([123456, -98765, 0, 1], np.int64)
+        shares = share_vector(secret, num_shares=5, threshold=3, rng=np.random.default_rng(0))
+        # Any 3 of 5 reconstruct exactly — including a non-prefix subset.
+        np.testing.assert_array_equal(reconstruct_vector(shares[2:], 3), secret)
+        np.testing.assert_array_equal(
+            reconstruct_vector([shares[0], shares[2], shares[4]], 3), secret
+        )
+
+    def test_below_threshold_fails(self):
+        shares = share_vector(np.array([42], np.int64), 4, 3)
+        with pytest.raises(AggregationError):
+            reconstruct_vector(shares[:2], 3)
+
+    def test_single_share_reveals_nothing(self):
+        # Same secret, two sharings: an individual share is (overwhelmingly) different.
+        s1 = share_vector(np.arange(100, dtype=np.int64), 3, 2, np.random.default_rng(1))
+        s2 = share_vector(np.arange(100, dtype=np.int64), 3, 2, np.random.default_rng(2))
+        assert np.mean(s1[0].values == s2[0].values) < 0.05
+
+    def test_threshold_aggregator_sums_updates(self):
+        cfg = SecureAggregationConfig(min_clients=2, threshold=2, frac_bits=16)
+        agg = ThresholdSecureAggregator(num_parties=3, config=cfg)
+        params = [_client_params(i) for i in range(3)]
+        shares = [agg.share_update(p, weight=1.0 / 3) for p in params]
+        out = agg.aggregate(shares, params[0])
+        expected = jax.tree.map(lambda *xs: sum(xs) / 3, *params)
+        _tree_allclose(out, expected, atol=3 * 2**-15)
+
+    def test_aggregator_min_clients(self):
+        cfg = SecureAggregationConfig(min_clients=3, threshold=2)
+        agg = ThresholdSecureAggregator(num_parties=3, config=cfg)
+        shares = [agg.share_update(_client_params(0))]
+        with pytest.raises(AggregationError):
+            agg.aggregate(shares, _client_params(0))
+
+
+class TestTransportBox:
+    def test_roundtrip(self):
+        box = TransportBox()
+        blob = box.encrypt(b"payload", b"round:3")
+        assert box.decrypt(blob, b"round:3") == b"payload"
+
+    def test_tamper_detected(self):
+        box = TransportBox()
+        blob = bytearray(box.encrypt(b"payload"))
+        blob[-1] ^= 0xFF
+        with pytest.raises(InvalidTag):
+            box.decrypt(bytes(blob))
+
+    def test_wrong_aad_detected(self):
+        box = TransportBox()
+        blob = box.encrypt(b"payload", b"round:3")
+        with pytest.raises(InvalidTag):
+            box.decrypt(blob, b"round:4")
+
+    def test_shared_key(self):
+        a = TransportBox()
+        b = TransportBox(key=a.key)
+        assert b.decrypt(a.encrypt(b"x")) == b"x"

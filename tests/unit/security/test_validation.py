@@ -1,0 +1,151 @@
+"""Validation tests — behavior parity with ``tests/unit/server/test_validation.py:62-166``
+(shape/range/statistics verdicts) plus the SPMD stacked-axis path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate
+from nanofed_tpu.security import (
+    ValidationConfig,
+    ValidationResult,
+    apply_validation_mask,
+    reference_shapes,
+    validate_client_updates,
+    validate_range,
+    validate_shape,
+    validate_statistics,
+)
+
+
+def _stacked_updates(client_vectors):
+    c = len(client_vectors)
+    params = {"w": jnp.stack([jnp.asarray(v, jnp.float32) for v in client_vectors])}
+    return ClientUpdates(
+        params=params,
+        weights=jnp.ones((c,), jnp.float32),
+        metrics=ClientMetrics(
+            loss=jnp.zeros((c,)), accuracy=jnp.zeros((c,)), samples=jnp.ones((c,))
+        ),
+    )
+
+
+def _host_update(vec, client_id="c0"):
+    return ModelUpdate(
+        client_id=client_id,
+        round_number=0,
+        params={"w": jnp.asarray(vec, jnp.float32)},
+        metrics={},
+        timestamp="2026-01-01T00:00:00",
+    )
+
+
+class TestStackedValidation:
+    def test_all_valid(self):
+        ups = _stacked_updates([[0.1, 0.2], [0.2, 0.1], [0.15, 0.15]])
+        report = validate_client_updates(ups, ValidationConfig(min_clients_for_stats=5))
+        assert report.num_valid() == 3
+        assert bool(np.all(np.asarray(report.finite)))
+        assert bool(np.all(np.asarray(report.range_ok)))
+
+    def test_nonfinite_client_flagged(self):
+        ups = _stacked_updates([[0.1, 0.2], [np.nan, 0.1], [0.15, np.inf]])
+        report = validate_client_updates(ups)
+        np.testing.assert_array_equal(np.asarray(report.finite), [True, False, False])
+        np.testing.assert_array_equal(np.asarray(report.valid), [True, False, False])
+
+    def test_norm_bound(self):
+        ups = _stacked_updates([[0.1, 0.0], [100.0, 0.0]])
+        report = validate_client_updates(ups, ValidationConfig(max_norm=10.0))
+        np.testing.assert_array_equal(np.asarray(report.range_ok), [True, False])
+
+    def test_zscore_anomaly(self):
+        # Five near-identical clients + one far outlier: outlier is anomalous.
+        vecs = [[1.0, 1.0]] * 5 + [[9.0, 9.0]]
+        report = validate_client_updates(
+            _stacked_updates(vecs),
+            ValidationConfig(max_norm=100.0, min_clients_for_stats=5, z_score_threshold=2.0),
+        )
+        assert np.asarray(report.anomalous).tolist() == [False] * 5 + [True]
+        assert report.num_valid() == 5
+
+    def test_zscore_fires_at_min_cohort(self):
+        # Self-inclusive z with ddof=1 caps at (n-1)/sqrt(n) = 1.79 for n=5, so a plain
+        # z-score could NEVER flag an attacker at the default min cohort — leave-one-out
+        # statistics must.
+        vecs = [[1.0, 1.0], [1.01, 1.0], [0.99, 1.0], [1.0, 1.02]] + [[9.0, 9.0]]
+        report = validate_client_updates(
+            _stacked_updates(vecs),
+            ValidationConfig(max_norm=100.0, min_clients_for_stats=5, z_score_threshold=2.0),
+        )
+        assert np.asarray(report.anomalous).tolist() == [False] * 4 + [True]
+
+    def test_nan_clients_excluded_from_cohort_stats(self):
+        # 4 NaN clients get norm 0 after sanitization; they must not drag the cohort mean
+        # toward 0 and get the honest clients flagged.
+        vecs = [[np.nan, 0.0]] * 4 + [[1.0, 1.0], [1.2, 1.0], [0.9, 1.0], [1.0, 1.3]]
+        report = validate_client_updates(
+            _stacked_updates(vecs),
+            ValidationConfig(max_norm=100.0, min_clients_for_stats=3, z_score_threshold=2.0),
+        )
+        assert np.asarray(report.valid).tolist() == [False] * 4 + [True] * 4
+
+    def test_stats_skipped_below_min_cohort(self):
+        vecs = [[1.0, 1.0], [9.0, 9.0]]
+        report = validate_client_updates(
+            _stacked_updates(vecs), ValidationConfig(max_norm=100.0, min_clients_for_stats=5)
+        )
+        assert not np.any(np.asarray(report.anomalous))
+
+    def test_mask_application_zeroes_invalid_weights(self):
+        ups = _stacked_updates([[0.1, 0.2], [np.nan, 0.1], [0.2, 0.2]])
+        report = validate_client_updates(ups)
+        w = apply_validation_mask(jnp.asarray([2.0, 3.0, 4.0]), report)
+        np.testing.assert_allclose(np.asarray(w), [2.0, 0.0, 4.0])
+
+    def test_jit_compatible(self):
+        # The whole report must be producible inside jit (fixed shapes, no host sync).
+        ups = _stacked_updates([[0.1, 0.2], [0.2, 0.1], [0.3, 0.3]])
+
+        @jax.jit
+        def f(u):
+            return validate_client_updates(u).valid
+
+        assert np.asarray(f(ups)).shape == (3,)
+
+
+class TestHostPathParity:
+    def test_shape_valid_and_mismatch(self):
+        ref = reference_shapes({"w": jnp.zeros((2,))})
+        assert validate_shape(_host_update([0.1, 0.2]), ref) is ValidationResult.VALID
+        assert (
+            validate_shape(_host_update([0.1, 0.2, 0.3]), ref)
+            is ValidationResult.INVALID_SHAPE
+        )
+        assert (
+            validate_shape(_host_update([0.1, 0.2]), {"other": (2,)})
+            is ValidationResult.INVALID_SHAPE
+        )
+
+    def test_range(self):
+        cfg = ValidationConfig(max_norm=1.0)
+        assert validate_range(_host_update([0.1, 0.2]), cfg) is ValidationResult.VALID
+        assert validate_range(_host_update([5.0, 0.0]), cfg) is ValidationResult.INVALID_RANGE
+        assert (
+            validate_range(_host_update([np.nan, 0.0]), cfg) is ValidationResult.INVALID_RANGE
+        )
+
+    def test_statistics(self):
+        cfg = ValidationConfig(min_clients_for_stats=3, z_score_threshold=2.0)
+        cohort = [_host_update([1.0, 1.0], f"c{i}") for i in range(5)]
+        # Cohort of identical norms: identical update is fine, outlier is anomalous.
+        assert validate_statistics(_host_update([1.0, 1.0]), cohort, cfg) is (
+            ValidationResult.VALID
+        )
+        assert validate_statistics(_host_update([50.0, 50.0]), cohort, cfg) is (
+            ValidationResult.ANOMALOUS
+        )
+        # Below the min cohort size statistics are skipped entirely.
+        assert validate_statistics(_host_update([50.0, 50.0]), cohort[:2], cfg) is (
+            ValidationResult.VALID
+        )
