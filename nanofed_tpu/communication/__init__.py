@@ -1,0 +1,40 @@
+"""Real-network transport (stage 9 of SURVEY.md §7).
+
+Replaces ``nanofed/communication/http/`` with binary-payload HTTP federation.  The SPMD
+simulator never imports this package; it exists for true cross-device runs.  Requires the
+``[net]`` extra (aiohttp); the codec itself is dependency-free.
+"""
+
+from nanofed_tpu.communication.codec import decode_params, encode_params
+
+_NET_EXPORTS = {
+    "HTTPServer": "http_server",
+    "ServerEndpoints": "http_server",
+    "HTTPClient": "http_client",
+    "ClientEndpoints": "http_client",
+    "NetworkCoordinator": "network_coordinator",
+    "NetworkRoundConfig": "network_coordinator",
+    "stack_model_updates": "network_coordinator",
+}
+
+
+def __getattr__(name: str):
+    if name in _NET_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f"nanofed_tpu.communication.{_NET_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "ClientEndpoints",
+    "HTTPClient",
+    "HTTPServer",
+    "NetworkCoordinator",
+    "NetworkRoundConfig",
+    "ServerEndpoints",
+    "decode_params",
+    "encode_params",
+    "stack_model_updates",
+]

@@ -1,0 +1,130 @@
+"""HTTP federation client (real-network mode).
+
+Capability parity with ``HTTPClient`` (``nanofed/communication/http/client.py:33-242``):
+an async context manager that fetches the global model, submits local updates, and polls
+server status until termination — with binary npz payloads instead of JSON float lists.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Any
+
+import aiohttp
+
+from nanofed_tpu.communication.codec import decode_params, encode_params
+from nanofed_tpu.communication.http_server import (
+    HEADER_CLIENT,
+    HEADER_METRICS,
+    HEADER_ROUND,
+    HEADER_STATUS,
+)
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.utils.logger import Logger
+
+
+@dataclass(frozen=True)
+class ClientEndpoints:
+    """Parity: ``ClientEndpoints`` (``client.py:24-30``)."""
+
+    model: str = "/model"
+    update: str = "/update"
+    status: str = "/status"
+
+
+class HTTPClient:
+    """One federated client's connection to the server.
+
+    Usage parity with ``client.py:83-98``::
+
+        async with HTTPClient(url, "client_1") as client:
+            params, rnd, active = await client.fetch_global_model(template)
+            ...train...
+            await client.submit_update(params, metrics)
+    """
+
+    def __init__(
+        self,
+        server_url: str,
+        client_id: str,
+        endpoints: ClientEndpoints | None = None,
+        timeout_s: float = 300.0,
+    ) -> None:
+        self.server_url = server_url.rstrip("/")
+        self.client_id = client_id
+        self.endpoints = endpoints or ClientEndpoints()
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self._session: aiohttp.ClientSession | None = None
+        self._log = Logger()
+        self.current_round = 0
+
+    async def __aenter__(self) -> "HTTPClient":
+        self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _require_session(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            raise NanoFedError("HTTPClient must be used as an async context manager")
+        return self._session
+
+    async def fetch_global_model(
+        self, like: Params | None = None
+    ) -> tuple[Params | None, int, bool]:
+        """GET the current global model.
+
+        Returns ``(params, round_number, training_active)``; params is None when the
+        server has terminated training (parity: ``client.py:104-145``).
+        """
+        session = self._require_session()
+        url = self.server_url + self.endpoints.model
+        async with session.get(url) as resp:
+            if resp.status != 200:
+                raise NanoFedError(f"fetch_global_model: HTTP {resp.status}")
+            round_number = int(resp.headers.get(HEADER_ROUND, "0"))
+            self.current_round = round_number
+            if resp.headers.get(HEADER_STATUS) == "terminated":
+                return None, round_number, False
+            payload = await resp.read()
+        return decode_params(payload, like=like), round_number, True
+
+    async def submit_update(self, params: Params, metrics: dict[str, Any]) -> bool:
+        """POST local training results for the current round (parity:
+        ``client.py:158-211``)."""
+        session = self._require_session()
+        url = self.server_url + self.endpoints.update
+        headers = {
+            HEADER_CLIENT: self.client_id,
+            HEADER_ROUND: str(self.current_round),
+            HEADER_METRICS: json.dumps(metrics),
+        }
+        async with session.post(url, data=encode_params(params), headers=headers) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                self._log.warning("update rejected: %s", body.get("message"))
+                return False
+        return True
+
+    async def check_server_status(self) -> dict[str, Any]:
+        """GET /status (parity: ``client.py:213-229``)."""
+        session = self._require_session()
+        async with session.get(self.server_url + self.endpoints.status) as resp:
+            if resp.status != 200:
+                raise NanoFedError(f"check_server_status: HTTP {resp.status}")
+            return await resp.json()
+
+    async def wait_for_completion(self, poll_interval_s: float = 1.0) -> None:
+        """Poll status until the server stops training (parity: ``client.py:234-242``,
+        which polls at 10 s)."""
+        while True:
+            status = await self.check_server_status()
+            if not status.get("training_active", False):
+                return
+            await asyncio.sleep(poll_interval_s)

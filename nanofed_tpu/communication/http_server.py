@@ -1,0 +1,215 @@
+"""HTTP federation server (real-network mode).
+
+Capability parity with ``HTTPServer`` (``nanofed/communication/http/server.py:38-340``):
+``GET /model`` serves the current global parameters, ``POST /update`` buffers client
+updates for the current round (stale rounds are rejected with 400, ``server.py:260-272``),
+``GET /status`` exposes live round/update counts, and ``stop_training`` flips the
+termination flag clients poll (``server.py:313-317``).
+
+Differences by design (SURVEY.md §7 stage 9):
+* Payloads are binary npz (see ``codec``), not JSON float lists — ~9x smaller, no Python
+  per-element loops.
+* No ``set_coordinator`` back-pointer / private ``_updates`` reach-in (the reference's
+  circular-dependency workaround, ``server.py:123-125``, ``coordinator.py:218-293``): the
+  server owns the buffer and exposes ``num_updates`` / ``drain_updates``.
+* The simulator path (``nanofed_tpu.parallel``) never touches this module; it exists for
+  true cross-device federation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Any
+
+from aiohttp import web
+
+from nanofed_tpu.communication.codec import decode_params, encode_params
+from nanofed_tpu.core.types import ModelUpdate, Params
+from nanofed_tpu.utils.dates import get_current_time
+from nanofed_tpu.utils.logger import Logger
+
+MAX_REQUEST_SIZE = 100 * 1024 * 1024  # parity: 100 MB cap, server.py:72
+
+#: Metadata travels in headers; the body is pure npz bytes.
+HEADER_CLIENT = "X-NanoFed-Client"
+HEADER_ROUND = "X-NanoFed-Round"
+HEADER_METRICS = "X-NanoFed-Metrics"
+HEADER_STATUS = "X-NanoFed-Status"
+
+
+@dataclass(frozen=True)
+class ServerEndpoints:
+    """Parity: ``ServerEndpoints`` (``server.py:29-35``)."""
+
+    model: str = "/model"
+    update: str = "/update"
+    status: str = "/status"
+    test: str = "/test"
+
+
+class HTTPServer:
+    """Serves the global model and buffers client updates for the round engine."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        endpoints: ServerEndpoints | None = None,
+        max_request_size: int = MAX_REQUEST_SIZE,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.endpoints = endpoints or ServerEndpoints()
+        self._log = Logger()
+        self._lock = asyncio.Lock()
+        self._updates: dict[str, ModelUpdate] = {}
+        self._params: Params | None = None
+        self._params_bytes: bytes | None = None
+        self._round = 0
+        self._training_active = True
+        self._app = web.Application(client_max_size=max_request_size)
+        self._app.router.add_get(self.endpoints.model, self._handle_get_model)
+        self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
+        self._app.router.add_get(self.endpoints.status, self._handle_status)
+        self._app.router.add_get(self.endpoints.test, self._handle_test)
+        self._runner: web.AppRunner | None = None
+
+    # ------------------------------------------------------------------
+    # Round-engine API (what the reference's coordinator did via _updates reach-in)
+    # ------------------------------------------------------------------
+
+    async def publish_model(self, params: Params, round_number: int) -> None:
+        """Set the global params served to clients and advance the round."""
+        payload = encode_params(params)
+        async with self._lock:
+            self._params = params
+            self._params_bytes = payload
+            self._round = round_number
+            self._updates.clear()
+
+    def num_updates(self) -> int:
+        return len(self._updates)
+
+    async def drain_updates(self) -> list[ModelUpdate]:
+        """Atomically take the buffered updates for aggregation."""
+        async with self._lock:
+            updates = list(self._updates.values())
+            self._updates.clear()
+        return updates
+
+    def stop_training(self) -> None:
+        """Signal clients to stop polling (parity: ``server.py:313-317``)."""
+        self._training_active = False
+
+    @property
+    def current_round(self) -> int:
+        return self._round
+
+    # ------------------------------------------------------------------
+    # Handlers
+    # ------------------------------------------------------------------
+
+    async def _handle_get_model(self, request: web.Request) -> web.StreamResponse:
+        if not self._training_active:
+            return web.Response(
+                status=200,
+                headers={HEADER_STATUS: "terminated", HEADER_ROUND: str(self._round)},
+            )
+        if self._params_bytes is None:
+            return web.json_response(
+                {"status": "error", "message": "no model published"}, status=503
+            )
+        return web.Response(
+            body=self._params_bytes,
+            content_type="application/octet-stream",
+            headers={HEADER_STATUS: "training", HEADER_ROUND: str(self._round)},
+        )
+
+    async def _handle_submit_update(self, request: web.Request) -> web.StreamResponse:
+        client_id = request.headers.get(HEADER_CLIENT)
+        round_header = request.headers.get(HEADER_ROUND)
+        if not client_id or round_header is None:
+            return web.json_response(
+                {"status": "error", "message": "missing client/round headers"}, status=400
+            )
+        try:
+            round_number = int(round_header)
+        except ValueError:
+            return web.json_response(
+                {"status": "error", "message": f"bad round: {round_header!r}"}, status=400
+            )
+        try:
+            metrics: dict[str, Any] = json.loads(request.headers.get(HEADER_METRICS, "{}"))
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"status": "error", "message": "bad metrics header"}, status=400
+            )
+        if self._params is None:
+            # No template yet: decode_params(like=None) would skip shape/structure
+            # validation entirely and buffer an arbitrary payload for round 0.
+            return web.json_response(
+                {"status": "error", "message": "no model published"}, status=503
+            )
+        body = await request.read()
+        try:
+            params = decode_params(body, like=self._params)
+        except Exception as e:
+            return web.json_response(
+                {"status": "error", "message": f"bad payload: {e}"}, status=400
+            )
+        async with self._lock:
+            # Stale-round rejection (parity: server.py:260-272).
+            if round_number != self._round:
+                return web.json_response(
+                    {
+                        "status": "error",
+                        "message": (
+                            f"update for round {round_number}, server is on {self._round}"
+                        ),
+                    },
+                    status=400,
+                )
+            self._updates[client_id] = ModelUpdate(
+                client_id=client_id,
+                round_number=round_number,
+                params=params,
+                metrics=metrics,
+                timestamp=get_current_time().isoformat(),
+            )
+            accepted = len(self._updates)
+        self._log.info("update from %s (round %d, %d buffered)", client_id, round_number,
+                       accepted)
+        return web.json_response(
+            {"status": "success", "message": "update accepted", "update_id": client_id}
+        )
+
+    async def _handle_status(self, request: web.Request) -> web.StreamResponse:
+        return web.json_response(
+            {
+                "status": "success",
+                "round": self._round,
+                "num_updates": len(self._updates),
+                "training_active": self._training_active,
+            }
+        )
+
+    async def _handle_test(self, request: web.Request) -> web.StreamResponse:
+        return web.json_response({"status": "success", "message": "server is running"})
+
+    # ------------------------------------------------------------------
+    # Lifecycle (parity: server.py:319-340)
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._log.info("HTTP server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

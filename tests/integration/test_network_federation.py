@@ -1,0 +1,148 @@
+"""Real-network federation: an aiohttp server on localhost + HTTPClient coroutines doing
+real local training — parity with ``tests/integration/
+test_client_server_communication.py:17-75``, but over binary payloads and with a real
+aggregation round."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.communication import (
+    HTTPClient,
+    HTTPServer,
+    NetworkCoordinator,
+    NetworkRoundConfig,
+    decode_params,
+    encode_params,
+)
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.models import get_model
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.trainer.local import make_local_fit
+
+PORT = 18432
+
+
+def test_codec_roundtrip():
+    params = {"a": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+              "b": jnp.ones((4,), jnp.bfloat16)}
+    out = decode_params(encode_params(params), like=params)
+    for x, y in zip(jax.tree.leaves(params), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(x).astype(np.float32),
+                                      np.asarray(y).astype(np.float32))
+
+
+def test_codec_template_mismatch():
+    from nanofed_tpu.core.exceptions import NanoFedError
+
+    payload = encode_params({"w": jnp.zeros((2,))})
+    with pytest.raises(NanoFedError):
+        decode_params(payload, like={"w": jnp.zeros((3,))})
+    with pytest.raises(NanoFedError):
+        decode_params(payload, like={"other": jnp.zeros((2,))})
+
+
+async def _run_client(client_id: str, model, local_fit, data: ClientData, port: int):
+    async with HTTPClient(f"http://127.0.0.1:{port}", client_id, timeout_s=30) as client:
+        while True:
+            params, rnd, active = await client.fetch_global_model(
+                like=model.init(jax.random.key(0))
+            )
+            if not active:
+                return
+            result = local_fit(jax.tree.map(jnp.asarray, params), data,
+                               jax.random.key(hash(client_id) % 2**31))
+            await client.submit_update(
+                result.params,
+                {
+                    "loss": float(result.metrics.loss),
+                    "accuracy": float(result.metrics.accuracy),
+                    "num_samples": float(result.metrics.samples),
+                },
+            )
+            # Wait for the next round (or termination).
+            status = await client.check_server_status()
+            while status["training_active"] and status["round"] == rnd:
+                await asyncio.sleep(0.05)
+                status = await client.check_server_status()
+            if not status["training_active"]:
+                return
+
+
+def test_full_network_federation_two_rounds():
+    model = get_model("linear", in_features=8, num_classes=2)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    local_fit = jax.jit(make_local_fit(model.apply, training))
+    rng = np.random.default_rng(0)
+
+    def client_data(seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(16, 8)).astype(np.float32)
+        w = r.normal(size=(8,))
+        y = (x @ w > 0).astype(np.int32)
+        return ClientData(x=jnp.asarray(x), y=jnp.asarray(y), mask=jnp.ones((16,)))
+
+    async def main():
+        server = HTTPServer(port=PORT)
+        await server.start()
+        try:
+            init = model.init(jax.random.key(0))
+            coordinator = NetworkCoordinator(
+                server, init,
+                NetworkRoundConfig(num_rounds=2, min_clients=3, round_timeout_s=30),
+            )
+            results = await asyncio.gather(
+                coordinator.run(),
+                _run_client("c1", model, local_fit, client_data(1), PORT),
+                _run_client("c2", model, local_fit, client_data(2), PORT),
+                _run_client("c3", model, local_fit, client_data(3), PORT),
+            )
+            return results[0], init, coordinator
+        finally:
+            await server.stop()
+
+    history, init, coordinator = asyncio.run(main())
+    assert [h["status"] for h in history] == ["COMPLETED", "COMPLETED"]
+    assert all(h["num_clients"] == 3 for h in history)
+    # The aggregate actually moved.
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(init), jax.tree.leaves(coordinator.params))
+    )
+    assert moved
+
+
+def test_stale_round_rejected_and_status():
+    model = get_model("linear", in_features=4, num_classes=2)
+    params = model.init(jax.random.key(0))
+
+    async def main():
+        server = HTTPServer(port=PORT + 1)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=5)
+            async with HTTPClient(f"http://127.0.0.1:{PORT + 1}", "c1", timeout_s=10) as c:
+                status = await c.check_server_status()
+                assert status["round"] == 5 and status["training_active"]
+                fetched, rnd, active = await c.fetch_global_model(like=params)
+                assert rnd == 5 and active
+                # Submitting against a stale round number must be rejected.
+                c.current_round = 3
+                ok = await c.submit_update(fetched, {"loss": 0.1})
+                assert not ok
+                assert server.num_updates() == 0
+                # Correct round is accepted.
+                c.current_round = 5
+                ok = await c.submit_update(fetched, {"loss": 0.1})
+                assert ok and server.num_updates() == 1
+                # Termination propagates to fetch.
+                server.stop_training()
+                none_params, _, active = await c.fetch_global_model(like=params)
+                assert none_params is None and not active
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
