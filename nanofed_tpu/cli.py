@@ -55,6 +55,22 @@ def _cmd_run(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_bench(args: argparse.Namespace) -> int:
+    from nanofed_tpu.benchmarks import BENCHMARKS, run_benchmark
+
+    if args.list:
+        print(json.dumps(sorted(BENCHMARKS), indent=2))
+        return 0
+    overrides = {}
+    if args.train_size is not None:
+        overrides["train_size"] = args.train_size
+    if args.rounds is not None:
+        overrides["num_rounds"] = args.rounds
+    summary = run_benchmark(args.name, out_dir=args.out_dir, **overrides)
+    print(json.dumps(summary, indent=2, default=str))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="nanofed-tpu", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -78,9 +94,18 @@ def main(argv: list[str] | None = None) -> int:
         help="cap the (synthetic) training set size; default = full dataset",
     )
 
+    bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
+    bench.add_argument("name", nargs="?", default="mnist_iid")
+    bench.add_argument("--list", action="store_true", help="list benchmark names")
+    bench.add_argument("--rounds", type=int, default=None)
+    bench.add_argument("--train-size", type=int, default=None)
+    bench.add_argument("--out-dir", default="runs/bench")
+
     args = parser.parse_args(argv)
     if args.cmd == "info":
         return _cmd_info(args)
+    if args.cmd == "bench":
+        return _cmd_bench(args)
     return _cmd_run(args)
 
 

@@ -3,87 +3,45 @@
 The reference ships weights as nested Python float lists in JSON — ~9x size inflation and
 an O(params) Python encode/decode loop per client per round (``nanofed/communication/http/
 server.py:140-149``, ``client.py:147-156``, SURVEY.md §5).  Here the wire format is an
-in-memory ``.npz`` archive keyed by '/'-joined pytree paths: binary, compressed, zero-copy
-into numpy on receive, and identical to the checkpoint format so a captured payload IS a
-loadable checkpoint.
+in-memory ``.npz`` archive in the exact checkpoint layout ('/'-joined pytree paths,
+dtype-tagged bfloat16/ml_dtypes leaves — see ``persistence.serialization``): binary,
+compressed, and a captured payload IS a loadable checkpoint.
+
+With a template, decoding validates leaf names, shapes, AND dtypes — this is the
+server's structural-validation barrier for incoming updates.
 """
 
 from __future__ import annotations
 
 import io
-from typing import Any
 
 import numpy as np
 
-from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.exceptions import CheckpointError, NanoFedError
 from nanofed_tpu.core.types import Params
-from nanofed_tpu.utils.trees import tree_flatten_with_names
-
-
-#: Separator tagging leaves whose dtype npz cannot represent natively (bfloat16 and the
-#: other ml_dtypes register as numpy void kinds and would silently degrade to raw bytes).
-_DTYPE_TAG = "::dtype::"
-
-
-def _to_storable(name: str, arr: np.ndarray) -> tuple[str, np.ndarray]:
-    if arr.dtype.kind == "V":  # ml_dtypes (bfloat16, fp8, ...)
-        raw = np.frombuffer(arr.tobytes(), dtype=np.uint8).reshape(
-            arr.shape + (arr.dtype.itemsize,)
-        )
-        return f"{name}{_DTYPE_TAG}{arr.dtype.name}", raw
-    return name, arr
-
-
-def _from_storable(name: str, arr: np.ndarray) -> tuple[str, np.ndarray]:
-    if _DTYPE_TAG in name:
-        name, dtype_name = name.split(_DTYPE_TAG, 1)
-        import ml_dtypes  # noqa: F401  (registers the named dtypes with numpy)
-
-        dtype = np.dtype(dtype_name)
-        arr = np.frombuffer(arr.tobytes(), dtype=dtype).reshape(arr.shape[:-1])
-    return name, arr
+from nanofed_tpu.persistence.serialization import (
+    flatten_to_arrays,
+    from_storable,
+    unflatten_from_arrays,
+)
 
 
 def encode_params(params: Params) -> bytes:
     """Params pytree -> compressed npz bytes."""
-    named, _ = tree_flatten_with_names(params)
-    arrays = dict(_to_storable(name, np.asarray(leaf)) for name, leaf in named)
-    if len(arrays) != len(named):
-        raise NanoFedError("pytree has duplicate leaf path names; cannot encode")
+    try:
+        arrays = flatten_to_arrays(params)
+    except CheckpointError as e:
+        raise NanoFedError(str(e)) from e
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
     return buf.getvalue()
 
 
 def decode_params(payload: bytes, like: Params | None = None) -> Params:
-    """npz bytes -> params pytree (template-structured when ``like`` is given)."""
-    import jax
-
+    """npz bytes -> params pytree (template-structured + validated when ``like`` given)."""
     with np.load(io.BytesIO(payload)) as data:
-        arrays = dict(_from_storable(name, data[name]) for name in data.files)
-    if like is None:
-        return _nest(arrays)
-    named, treedef = tree_flatten_with_names(like)
-    leaves = []
-    for name, leaf in named:
-        if name not in arrays:
-            raise NanoFedError(f"payload is missing leaf '{name}' for the given template")
-        arr = arrays[name]
-        if tuple(arr.shape) != tuple(np.shape(leaf)):
-            raise NanoFedError(
-                f"shape mismatch for '{name}': payload {arr.shape} vs template "
-                f"{np.shape(leaf)}"
-            )
-        leaves.append(arr)
-    return jax.tree.unflatten(treedef, leaves)
-
-
-def _nest(flat: dict[str, np.ndarray]) -> dict[str, Any]:
-    out: dict[str, Any] = {}
-    for name, arr in flat.items():
-        node = out
-        parts = name.split("/")
-        for part in parts[:-1]:
-            node = node.setdefault(part, {})
-        node[parts[-1]] = arr
-    return out
+        arrays = dict(from_storable(name, data[name]) for name in data.files)
+    try:
+        return unflatten_from_arrays(arrays, like, source="payload")
+    except CheckpointError as e:
+        raise NanoFedError(str(e)) from e

@@ -106,9 +106,13 @@ class HTTPClient:
             HEADER_METRICS: json.dumps(metrics),
         }
         async with session.post(url, data=encode_params(params), headers=headers) as resp:
-            body = await resp.json()
             if resp.status != 200:
-                self._log.warning("update rejected: %s", body.get("message"))
+                # Framework error pages (413 too-large, 500) are text, not JSON.
+                try:
+                    message = (await resp.json()).get("message")
+                except Exception:
+                    message = (await resp.text())[:200]
+                self._log.warning("update rejected (HTTP %d): %s", resp.status, message)
                 return False
         return True
 

@@ -152,6 +152,16 @@ class HTTPServer:
             return web.json_response(
                 {"status": "error", "message": "no model published"}, status=503
             )
+        # Cheap stale-round rejection BEFORE reading/decompressing up to 100 MB; the
+        # authoritative check re-runs under the lock below.
+        if round_number != self._round:
+            return web.json_response(
+                {
+                    "status": "error",
+                    "message": f"update for round {round_number}, server is on {self._round}",
+                },
+                status=400,
+            )
         body = await request.read()
         try:
             params = decode_params(body, like=self._params)

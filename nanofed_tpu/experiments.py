@@ -15,26 +15,11 @@ from nanofed_tpu.trainer import TrainingConfig
 from nanofed_tpu.utils.logger import Logger
 
 
-def run_experiment(
-    model: str = "mnist_cnn",
-    num_clients: int = 10,
-    num_rounds: int = 2,
-    local_epochs: int = 2,
-    batch_size: int = 64,
-    learning_rate: float = 0.1,
-    scheme: str = "iid",
-    participation: float = 1.0,
-    data_dir: str | None = None,
-    out_dir: str | Path = "runs",
-    seed: int = 0,
-    prox_mu: float = 0.0,
-    eval_every: int = 0,
-    train_size: int | None = None,
-    **scheme_kwargs: Any,
-) -> dict[str, Any]:
-    """Run a full federated experiment; returns a summary dict."""
-    log = Logger()
-    mdl = get_model(model)
+def load_datasets_for(
+    mdl: Any, data_dir: str | None, train_size: int | None, seed: int = 0
+) -> tuple[Any, Any]:
+    """Pick train/test datasets matching a model's input shape (MNIST-shaped, CIFAR-shaped,
+    or synthetic for anything else)."""
     test_size = (train_size or 0) // 6 or None
     if mdl.input_shape == (28, 28, 1):
         train = load_mnist("train", data_dir, synthetic_size=train_size)
@@ -52,6 +37,35 @@ def run_experiment(
         test = synthetic_classification(
             test_size or 1024, mdl.num_classes, mdl.input_shape, seed=seed + 1
         )
+    return train, test
+
+
+def run_experiment(
+    model: str = "mnist_cnn",
+    num_clients: int = 10,
+    num_rounds: int = 2,
+    local_epochs: int = 2,
+    batch_size: int = 64,
+    learning_rate: float = 0.1,
+    scheme: str = "iid",
+    participation: float = 1.0,
+    data_dir: str | None = None,
+    out_dir: str | Path = "runs",
+    seed: int = 0,
+    prox_mu: float = 0.0,
+    eval_every: int = 0,
+    train_size: int | None = None,
+    central_privacy: Any = None,
+    **scheme_kwargs: Any,
+) -> dict[str, Any]:
+    """Run a full federated experiment; returns a summary dict.
+
+    ``central_privacy`` (a ``PrivacyAwareAggregationConfig``) turns the reduce into
+    DP-FedAvg — clipping + Gaussian noise at the aggregation step.
+    """
+    log = Logger()
+    mdl = get_model(model)
+    train, test = load_datasets_for(mdl, data_dir, train_size, seed)
     log.info("dataset %s: %d train / %d test samples", train.name, len(train), len(test))
 
     client_data = federate(
@@ -75,6 +89,7 @@ def run_experiment(
             prox_mu=prox_mu,
         ),
         eval_data=pack_eval(test, batch_size=256),
+        central_privacy=central_privacy,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()

@@ -32,12 +32,46 @@ def tree_to_numpy(tree: PyTree) -> PyTree:
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
-def save_pytree_npz(path: str | Path, tree: PyTree) -> None:
-    """Save a pytree of arrays as a compressed ``.npz`` keyed by leaf path names."""
+#: Key suffix tagging leaves whose dtype the npy format cannot represent natively
+#: (bfloat16 and the other ml_dtypes register as numpy void kinds and would silently
+#: degrade to raw bytes on save).  Shared by checkpoints and the wire codec so a captured
+#: network payload IS a loadable checkpoint.
+DTYPE_TAG = "::dtype::"
+
+
+def to_storable(name: str, arr: np.ndarray) -> tuple[str, np.ndarray]:
+    """Rewrite an (name, array) pair into an npz-safe form (uint8 view + dtype tag)."""
+    if arr.dtype.kind == "V":  # ml_dtypes (bfloat16, fp8, ...)
+        raw = np.frombuffer(arr.tobytes(), dtype=np.uint8).reshape(
+            arr.shape + (arr.dtype.itemsize,)
+        )
+        return f"{name}{DTYPE_TAG}{arr.dtype.name}", raw
+    return name, arr
+
+
+def from_storable(name: str, arr: np.ndarray) -> tuple[str, np.ndarray]:
+    """Invert :func:`to_storable`."""
+    if DTYPE_TAG in name:
+        name, dtype_name = name.split(DTYPE_TAG, 1)
+        import ml_dtypes  # noqa: F401  (registers the named dtypes with numpy)
+
+        dtype = np.dtype(dtype_name)
+        arr = np.frombuffer(arr.tobytes(), dtype=dtype).reshape(arr.shape[:-1])
+    return name, arr
+
+
+def flatten_to_arrays(tree: PyTree) -> dict[str, np.ndarray]:
+    """Pytree -> {storable_name: array} for npz serialization."""
     named, _ = tree_flatten_with_names(tree)
-    arrays = {name: np.asarray(leaf) for name, leaf in named}
+    arrays = dict(to_storable(name, np.asarray(leaf)) for name, leaf in named)
     if len(arrays) != len(named):
         raise CheckpointError("pytree has duplicate leaf path names; cannot serialize")
+    return arrays
+
+
+def save_pytree_npz(path: str | Path, tree: PyTree) -> None:
+    """Save a pytree of arrays as a compressed ``.npz`` keyed by leaf path names."""
+    arrays = flatten_to_arrays(tree)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(path.suffix + ".tmp")
@@ -56,22 +90,35 @@ def load_pytree_npz(path: str | Path, like: PyTree | None = None) -> PyTree:
     if not path.exists():
         raise CheckpointError(f"checkpoint not found: {path}")
     with np.load(path) as data:
-        arrays = {name: data[name] for name in data.files}
+        arrays = dict(from_storable(name, data[name]) for name in data.files)
+    return unflatten_from_arrays(arrays, like, source=str(path))
+
+
+def unflatten_from_arrays(
+    arrays: dict[str, np.ndarray], like: PyTree | None, source: str = "payload"
+) -> PyTree:
+    """{name: array} -> pytree; template-structured (with name/shape/dtype validation)
+    when ``like`` is given, nested dict otherwise."""
     if like is None:
         return _nest(arrays)
     named, treedef = tree_flatten_with_names(like)
     missing = [name for name, _ in named if name not in arrays]
     if missing:
         raise CheckpointError(
-            f"checkpoint {path} is missing leaves {missing[:5]} for the given template"
+            f"{source} is missing leaves {missing[:5]} for the given template"
         )
     leaves = []
     for name, leaf in named:
         arr = arrays[name]
         if tuple(arr.shape) != tuple(np.shape(leaf)):
             raise CheckpointError(
-                f"shape mismatch for '{name}': checkpoint {arr.shape} vs template "
+                f"shape mismatch for '{name}': {source} {arr.shape} vs template "
                 f"{np.shape(leaf)}"
+            )
+        want = np.asarray(leaf).dtype
+        if arr.dtype != want:
+            raise CheckpointError(
+                f"dtype mismatch for '{name}': {source} {arr.dtype} vs template {want}"
             )
         leaves.append(arr)
     return jax.tree.unflatten(treedef, leaves)

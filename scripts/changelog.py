@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Conventional-commit changelog generator.
+
+Capability parity with the reference's release tooling (``scripts/changelog.py`` in
+camille-004/nanofed): groups commits since the last tag (or a given range) by
+conventional-commit type and emits a markdown section ready to paste into CHANGELOG.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+from collections import defaultdict
+from datetime import date
+
+SECTIONS = {
+    "feat": "Features",
+    "fix": "Bug Fixes",
+    "perf": "Performance",
+    "refactor": "Refactoring",
+    "docs": "Documentation",
+    "test": "Tests",
+    "build": "Build",
+    "ci": "CI",
+    "chore": "Chores",
+}
+_PATTERN = re.compile(
+    r"^(?P<type>[a-z]+)(?:\((?P<scope>[^)]*)\))?(?P<bang>!)?:\s*(?P<desc>.+)$"
+)
+
+
+def git_log(rev_range: str | None) -> list[tuple[str, str]]:
+    cmd = ["git", "log", "--pretty=format:%h%x00%s"]
+    if rev_range:
+        cmd.append(rev_range)
+    out = subprocess.run(cmd, capture_output=True, text=True, check=True).stdout
+    return [tuple(line.split("\x00", 1)) for line in out.splitlines() if "\x00" in line]
+
+
+def last_tag() -> str | None:
+    proc = subprocess.run(
+        ["git", "describe", "--tags", "--abbrev=0"], capture_output=True, text=True
+    )
+    return proc.stdout.strip() or None
+
+
+def build_changelog(version: str, rev_range: str | None) -> str:
+    grouped: dict[str, list[str]] = defaultdict(list)
+    breaking: list[str] = []
+    for sha, subject in git_log(rev_range):
+        m = _PATTERN.match(subject)
+        if not m:
+            grouped["other"].append(f"- {subject} ({sha})")
+            continue
+        scope = f"**{m['scope']}**: " if m["scope"] else ""
+        entry = f"- {scope}{m['desc']} ({sha})"
+        if m["bang"]:
+            breaking.append(entry)
+        grouped[m["type"]].append(entry)
+
+    lines = [f"## {version} ({date.today().isoformat()})", ""]
+    if breaking:
+        lines += ["### BREAKING CHANGES", "", *breaking, ""]
+    for key, title in SECTIONS.items():
+        if grouped.get(key):
+            lines += [f"### {title}", "", *grouped[key], ""]
+    if grouped.get("other"):
+        lines += ["### Other", "", *grouped["other"], ""]
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("version", help="version heading, e.g. v0.2.0")
+    parser.add_argument(
+        "--since", default=None,
+        help="start ref (default: last tag; full history if none)",
+    )
+    args = parser.parse_args()
+    since = args.since if args.since is not None else last_tag()
+    rev_range = f"{since}..HEAD" if since else None
+    print(build_changelog(args.version, rev_range))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Tag-and-release helper (parity with the reference's scripts/release.sh): bumps the
+# version in pyproject.toml + package __init__, regenerates the changelog section, commits
+# and tags. Push is left to the operator.
+set -euo pipefail
+
+VERSION="${1:-}"
+if [[ -z "$VERSION" ]]; then
+    echo "usage: scripts/release.sh <version>   (e.g. 0.2.0)" >&2
+    exit 1
+fi
+
+if [[ -n "$(git status --porcelain)" ]]; then
+    echo "working tree not clean; commit or stash first" >&2
+    exit 1
+fi
+
+sed -i "s/^version = \".*\"/version = \"$VERSION\"/" pyproject.toml
+sed -i "s/^__version__ = \".*\"/__version__ = \"$VERSION\"/" nanofed_tpu/__init__.py
+
+python scripts/changelog.py "v$VERSION" > /tmp/changelog_section.md
+if [[ -f CHANGELOG.md ]]; then
+    cat /tmp/changelog_section.md CHANGELOG.md > /tmp/changelog_full.md
+    mv /tmp/changelog_full.md CHANGELOG.md
+else
+    mv /tmp/changelog_section.md CHANGELOG.md
+fi
+
+git add pyproject.toml nanofed_tpu/__init__.py CHANGELOG.md
+git commit -m "chore: release v$VERSION"
+git tag -a "v$VERSION" -m "v$VERSION"
+echo "tagged v$VERSION — push with: git push && git push --tags"

@@ -1,0 +1,47 @@
+"""Benchmark-suite smoke tests: every named BASELINE.json config must run end-to-end on
+the CPU mesh with tiny synthetic data.
+
+CNN/ResNet XLA compiles take minutes on the single-core CPU mesh, so the routine smoke
+runs override the model with a small MLP — it exercises the harness plumbing (schemes,
+participation, DP path, metrics), while the true benchmark models are covered by unit
+forward tests and run on real hardware via ``nanofed-tpu bench``. Set NANOFED_RUN_SLOW=1
+to smoke the real models here too."""
+
+import os
+
+import pytest
+
+from nanofed_tpu.benchmarks import BENCHMARKS, run_benchmark
+
+_REAL_MODELS = bool(os.environ.get("NANOFED_RUN_SLOW"))
+
+# Tiny overrides per benchmark: enough samples for every client to get a shard.
+_SMOKE = {
+    "mnist_iid": dict(train_size=640, num_rounds=2),
+    "mnist_labelskew": dict(train_size=1600, num_rounds=2, num_clients=16),
+    "fedprox_cifar10": dict(train_size=512, num_rounds=1, num_clients=8),
+    "dp_fedavg_mnist": dict(train_size=640, num_rounds=2),
+    "cross_silo": dict(train_size=256, num_rounds=1),
+}
+
+
+def test_benchmark_names_covered():
+    assert set(_SMOKE) == set(BENCHMARKS)
+
+
+@pytest.mark.parametrize("name", sorted(_SMOKE))
+def test_benchmark_smoke(name, tmp_path):
+    overrides = dict(_SMOKE[name])
+    if not _REAL_MODELS:
+        overrides["model"] = "mlp"
+    summary = run_benchmark(name, out_dir=str(tmp_path), **overrides)
+    assert summary["benchmark"] == name
+    assert summary["rounds_failed"] == 0
+    assert summary["rounds_completed"] >= 1
+    assert "accuracy" in summary["final_eval_metrics"]
+    assert summary["rounds_per_sec"] > 0
+
+
+def test_unknown_benchmark_raises():
+    with pytest.raises(KeyError):
+        run_benchmark("nope")
