@@ -52,6 +52,9 @@ def main() -> None:
     data = pad_clients(data, padded)
     data = shard_client_data(data, mesh)
 
+    # fp32 compute: the reference number was measured in fp32 torch, and vs_baseline
+    # claims the SAME logical workload — bf16 mixed precision (compute_dtype="bfloat16")
+    # is a further ~1.1x on this workload but would not be apples-to-apples.
     training = TrainingConfig(batch_size=batch, local_epochs=epochs, learning_rate=0.1)
     strategy = fedavg_strategy()
     step = build_round_step(model.apply, training, mesh, strategy, donate=True)

@@ -101,6 +101,7 @@ class Coordinator:
         validation=None,
         central_privacy=None,
         local_fit: Callable | None = None,
+        client_chunk: int | None = None,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
     ) -> None:
         self.model = model
@@ -125,7 +126,7 @@ class Coordinator:
         self._round_step = build_round_step(
             model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
             local_fit=local_fit, central_privacy=central_privacy,
-            validation=validation, donate=True,
+            validation=validation, client_chunk=client_chunk, donate=True,
         )
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None

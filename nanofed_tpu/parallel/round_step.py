@@ -60,6 +60,7 @@ def build_round_step(
     local_fit: Callable | None = None,
     central_privacy: PrivacyAwareAggregationConfig | None = None,
     validation: ValidationConfig | None = None,
+    client_chunk: int | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
 ) -> RoundStepFn:
@@ -89,6 +90,13 @@ def build_round_step(
     rejection without data-dependent shapes.  The validity count is reported as
     ``metrics["valid_clients"]``.
 
+    ``client_chunk`` bounds HBM when clients-per-device is large (SURVEY.md §7 "clients ≫
+    chips"): a full ``vmap`` over N clients materializes N copies of every local-training
+    activation at once; with ``client_chunk=k`` the per-device client batch is processed
+    as a sequential ``lax.map`` over N/k chunks of a k-wide vmap, so activation memory
+    scales with k while the MXU still sees k-client-wide batched matmuls.  Must divide the
+    per-device client count.
+
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
     and keep only the returned arrays, as ``Coordinator`` does.
@@ -106,7 +114,26 @@ def build_round_step(
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
         gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
-        result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
+        c_local = rngs.shape[0]
+        if client_chunk is not None and client_chunk < c_local:
+            if c_local % client_chunk != 0:
+                raise ValueError(
+                    f"client_chunk {client_chunk} must divide per-device client count "
+                    f"{c_local}"
+                )
+            n_chunks = c_local // client_chunk
+            chunked = jax.tree.map(
+                lambda x: x.reshape(n_chunks, client_chunk, *x.shape[1:]), (data, rngs)
+            )
+            result = lax.map(
+                lambda args: jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, *args),
+                chunked,
+            )
+            result = jax.tree.map(
+                lambda x: x.reshape(c_local, *x.shape[2:]), result
+            )
+        else:
+            result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
         delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
 
         if validation is not None:

@@ -20,6 +20,9 @@ class TrainingConfig:
     round's starting point (new capability; required by BASELINE.json config #3).
     ``collect_batch_metrics`` returns per-step loss curves for host-side batch callbacks
     (parity with ``MetricsLogger.on_batch_end``, ``nanofed/trainer/callback.py:38-53``).
+    ``compute_dtype="bfloat16"`` runs forward/backward in bf16 on the MXU while params,
+    gradients, and the optimizer update stay float32 (mixed precision; loss and metrics
+    are reduced in float32).
     """
 
     batch_size: int = 64
@@ -30,6 +33,7 @@ class TrainingConfig:
     max_batches: int | None = None
     prox_mu: float = 0.0
     collect_batch_metrics: bool = False
+    compute_dtype: str | None = None  # e.g. "bfloat16"; None = params' native dtype
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
@@ -42,3 +46,12 @@ class TrainingConfig:
             raise ValueError("max_batches must be >= 1 when set")
         if self.prox_mu < 0:
             raise ValueError("prox_mu must be >= 0")
+        if self.compute_dtype is not None:
+            import numpy as np
+
+            try:
+                import ml_dtypes  # noqa: F401  (registers bfloat16 etc. with numpy)
+
+                np.dtype(self.compute_dtype)
+            except TypeError as e:
+                raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}") from e

@@ -47,17 +47,27 @@ class LocalFitResult(NamedTuple):
     batch_loss: jax.Array  # [E, S] per-step mean loss (zeros unless collect_batch_metrics)
 
 
-def make_grad_fn(apply_fn: Callable[..., jax.Array]) -> GradFn:
+def make_grad_fn(
+    apply_fn: Callable[..., jax.Array], compute_dtype: str | None = None
+) -> GradFn:
     """Standard masked NLL gradient.
 
     ``apply_fn`` returns log-probabilities (all zoo models end in log_softmax, parity with
     ``nanofed/models/mnist.py:28``); the loss is the masked mean negative log-likelihood —
     what the reference computes with ``F.cross_entropy`` on logits
     (``nanofed/trainer/torch.py:10-14``).
+
+    ``compute_dtype`` enables mixed precision: params and activations are cast (inside
+    the differentiated function, so gradients flow back to the float32 masters) and the
+    loss/metric reductions stay float32.
     """
+    cdt = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def loss_fn(params, xb, yb, mb, rng):
-        logp = apply_fn(params, xb, train=True, rng=rng)
+        if cdt is not None:
+            params = jax.tree.map(lambda p: p.astype(cdt), params)
+            xb = xb.astype(cdt)
+        logp = apply_fn(params, xb, train=True, rng=rng).astype(jnp.float32)
         nll = -jnp.take_along_axis(logp, yb[:, None], axis=-1)[:, 0]
         count = mb.sum()
         loss = (nll * mb).sum() / jnp.maximum(count, 1.0)
@@ -95,7 +105,16 @@ def make_local_fit(
     vmap-compatible over stacked clients.  FedProx: with ``config.prox_mu > 0`` the
     proximal gradient ``mu * (w - w_global)`` is added analytically each step.
     """
-    grad_fn = grad_fn or make_grad_fn(apply_fn)
+    if grad_fn is not None and config.compute_dtype is not None:
+        # A custom grad_fn owns its own casts; silently ignoring the config would let a
+        # user believe bf16 is active when it is not.  make_dp_grad_fn/
+        # make_private_local_fit accept compute_dtype directly.
+        raise ValueError(
+            "compute_dtype is set but a custom grad_fn was supplied; bake the dtype "
+            "into the grad_fn (e.g. make_dp_grad_fn(..., compute_dtype=...)) and leave "
+            "TrainingConfig.compute_dtype unset"
+        )
+    grad_fn = grad_fn or make_grad_fn(apply_fn, compute_dtype=config.compute_dtype)
     tx = optimizer or make_optimizer(config)
     bsz = config.batch_size
 

@@ -34,7 +34,11 @@ from nanofed_tpu.trainer.local import GradFn, StepStats, make_local_fit
 from nanofed_tpu.utils.trees import tree_sq_norm
 
 
-def make_dp_grad_fn(apply_fn: Callable[..., jax.Array], privacy: PrivacyConfig) -> GradFn:
+def make_dp_grad_fn(
+    apply_fn: Callable[..., jax.Array],
+    privacy: PrivacyConfig,
+    compute_dtype: str | None = None,
+) -> GradFn:
     """Per-example clip + noise gradient for ``make_local_fit``.
 
     For each real example i: g_i = ∇ nll_i, clipped to ``privacy.max_gradient_norm`` (C);
@@ -46,9 +50,13 @@ def make_dp_grad_fn(apply_fn: Callable[..., jax.Array], privacy: PrivacyConfig) 
     noise_gen = get_noise_generator(privacy.noise_type)
     C = privacy.max_gradient_norm
     sigma = privacy.noise_multiplier
+    cdt = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def example_loss(params, x, y, rng):
-        logp = apply_fn(params, x[None], train=True, rng=rng)[0]
+        if cdt is not None:  # mixed precision; grads flow back to fp32 masters
+            params = jax.tree.map(lambda p: p.astype(cdt), params)
+            x = x.astype(cdt)
+        logp = apply_fn(params, x[None], train=True, rng=rng)[0].astype(jnp.float32)
         nll = -logp[y]
         return nll, (logp,)
 
@@ -90,8 +98,15 @@ def make_private_local_fit(
     Identical signature/semantics to the non-private fit — drop-in for
     ``build_round_step`` — but every gradient step is privatized.
     """
+    import dataclasses
+
     return make_local_fit(
-        apply_fn, config, grad_fn=make_dp_grad_fn(apply_fn, privacy), optimizer=optimizer
+        apply_fn,
+        # The dtype is baked into the DP grad fn; clear it on the config so
+        # make_local_fit's custom-grad_fn guard doesn't trip.
+        dataclasses.replace(config, compute_dtype=None),
+        grad_fn=make_dp_grad_fn(apply_fn, privacy, compute_dtype=config.compute_dtype),
+        optimizer=optimizer,
     )
 
 
