@@ -1,0 +1,30 @@
+"""Pallas TPU kernels for the framework's hot data-movement ops.
+
+The compute-heavy path (conv/matmul forward+backward in local training) is left to XLA —
+its conv kernels already schedule the MXU well.  Pallas is applied where fusion or
+hardware PRNG buys something XLA's pattern library doesn't express:
+
+* ``ops.reduce``    — the FedAvg weighted reduce over the stacked client axis as one
+                      MXU contraction per tile ([C, P] x [C] -> [P]).
+* ``ops.quantize``  — fixed-point uint32 quantize / dequantize and seeded additive
+                      masking (the SecAgg inner loop) with the on-core PRNG, so masking
+                      never round-trips to the host.
+
+Every op takes ``interpret=None`` (auto: real kernels on TPU, interpreter elsewhere) so
+the same code paths are exercised by the CPU-mesh test suite.
+"""
+
+from nanofed_tpu.ops.quantize import (
+    add_mask,
+    dequantize_u32,
+    quantize_u32,
+)
+from nanofed_tpu.ops.reduce import weighted_mean_flat, weighted_mean_tree
+
+__all__ = [
+    "add_mask",
+    "dequantize_u32",
+    "quantize_u32",
+    "weighted_mean_flat",
+    "weighted_mean_tree",
+]

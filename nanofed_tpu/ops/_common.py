@@ -1,0 +1,13 @@
+"""Shared helpers for the Pallas ops."""
+
+from __future__ import annotations
+
+import jax
+
+
+def auto_interpret(interpret: bool | None) -> bool:
+    """Resolve the interpret flag: real kernels on TPU, Pallas interpreter elsewhere
+    (so the CPU-mesh test suite exercises the same code paths)."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,131 @@
+"""Fixed-point quantization + seeded masking kernels (the SecAgg inner loop on-device).
+
+The host-path secure aggregation (``security.secure_agg``) quantizes updates to uint32
+fixed point and adds PRG masks with numpy — fine for small models, but a 100 M-param
+update means several 400 MB host passes per client per round.  These kernels run the same
+arithmetic on-chip: int32 round-to-nearest (values are bounded well inside +/-2^31 by the
+SecAgg config contract), bitcast to uint32 for exact modular arithmetic, and mask
+generation from the on-core PRNG (``pltpu.prng_seed``/``prng_random_bits``) so masks are
+never materialized in host memory.  Arrays are processed as a grid of
+``[_BLOCK_ROWS, _LANES]`` VMEM tiles, so operand size is bounded by the tile, not VMEM.
+
+NOTE: the on-core PRNG stream differs from the host path's Philox stream, so TPU-masked
+updates unmask only against TPU-generated masks (all parties use the same kernel) — the
+two paths are deliberately not wire-compatible.  Parity tests pin quantize/dequantize
+round-trips and exact mask cancellation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nanofed_tpu.ops._common import auto_interpret
+
+_LANES = 512
+_BLOCK_ROWS = 256  # 256 x 512 x 4B = 512 KB per operand block in VMEM
+
+
+def _pad_grid(x: jax.Array) -> tuple[jax.Array, int, int]:
+    """Flat vector -> [rows, _LANES] padded so rows divide _BLOCK_ROWS; returns
+    (2-D array, real length, grid size)."""
+    n = x.shape[0]
+    lane_pad = (-n) % _LANES
+    x2 = jnp.pad(x, (0, lane_pad)).reshape(-1, _LANES)
+    rows = x2.shape[0]
+    row_pad = (-rows) % _BLOCK_ROWS
+    x2 = jnp.pad(x2, ((0, row_pad), (0, 0)))
+    return x2, n, x2.shape[0] // _BLOCK_ROWS
+
+
+def _block_spec():
+    return pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _quantize_kernel(scale_ref, x_ref, out_ref):
+    scaled = jnp.round(x_ref[:] * scale_ref[0]).astype(jnp.int32)
+    out_ref[:] = pltpu.bitcast(scaled, jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("frac_bits", "interpret"))
+def quantize_u32(
+    x: jax.Array, frac_bits: int = 16, interpret: bool | None = None
+) -> jax.Array:
+    """Flat f32 vector -> uint32 fixed point (two's complement encodes sign)."""
+    x2, n, grid = _pad_grid(x.astype(jnp.float32))
+    scale = jnp.float32(1 << frac_bits)[None]
+    out = pl.pallas_call(
+        _quantize_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), _block_spec()],
+        out_specs=_block_spec(),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, jnp.uint32),
+        interpret=auto_interpret(interpret),
+    )(scale, x2)
+    return out.reshape(-1)[:n]
+
+
+def _dequantize_kernel(inv_scale_ref, q_ref, out_ref):
+    centered = pltpu.bitcast(q_ref[:], jnp.int32)  # uint32 -> signed two's complement
+    out_ref[:] = centered.astype(jnp.float32) * inv_scale_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("frac_bits", "interpret"))
+def dequantize_u32(
+    q: jax.Array, frac_bits: int = 16, interpret: bool | None = None
+) -> jax.Array:
+    """uint32 fixed point -> f32 (centered / signed interpretation)."""
+    q2, n, grid = _pad_grid(q)
+    inv = jnp.float32(1.0 / (1 << frac_bits))[None]
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), _block_spec()],
+        out_specs=_block_spec(),
+        out_shape=jax.ShapeDtypeStruct(q2.shape, jnp.float32),
+        interpret=auto_interpret(interpret),
+    )(inv, q2)
+    return out.reshape(-1)[:n]
+
+
+def _mask_kernel(seed_ref, sign_ref, q_ref, out_ref):
+    # Per-block stream: seed with (caller seed, block index) so every block draws an
+    # independent deterministic stream — identical for both parties of a pair.
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.bitcast(pltpu.prng_random_bits(q_ref.shape), jnp.uint32)
+    # sign +1: add mask; sign -1: subtract (uint32 wraps mod 2^32 either way).
+    out_ref[:] = jnp.where(sign_ref[0] > 0, q_ref[:] + bits, q_ref[:] - bits)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def add_mask(
+    q: jax.Array, seed: jax.Array, sign: jax.Array, interpret: bool | None = None
+) -> jax.Array:
+    """Add (+1) or subtract (-1) the PRG mask expanded from ``seed`` (int32 scalar).
+
+    Two parties calling with the same seed and opposite signs produce masks that cancel
+    exactly in the uint32 sum — the pairwise SecAgg invariant, on-chip.  On non-TPU
+    backends the mask comes from ``jax.random`` instead of the core PRNG (the interpreter
+    has no ``prng_seed``); either way the stream is deterministic per seed *per backend*.
+    """
+    if auto_interpret(interpret):
+        mask = jax.random.bits(jax.random.key(seed.astype(jnp.uint32)), q.shape, jnp.uint32)
+        return jnp.where(sign > 0, q + mask, q - mask)
+    q2, n, grid = _pad_grid(q)
+    out = pl.pallas_call(
+        _mask_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _block_spec(),
+        ],
+        out_specs=_block_spec(),
+        out_shape=jax.ShapeDtypeStruct(q2.shape, jnp.uint32),
+        interpret=False,
+    )(jnp.asarray(seed, jnp.int32)[None], jnp.asarray(sign, jnp.int32)[None], q2)
+    return out.reshape(-1)[:n]
