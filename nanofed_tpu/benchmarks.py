@@ -9,6 +9,8 @@ workloads named in BASELINE.json:
 3. ``fedprox_cifar10``  — FedProx (proximal local loss) on CIFAR-10 ResNet-8, 100 clients.
 4. ``dp_fedavg_mnist``  — DP-FedAvg: per-client update clipping + Gaussian noise.
 5. ``cross_silo``       — 8 clients, ResNet-18 on CIFAR-100, full participation.
+6. ``mnist_1000``       — the north-star flagship: 1000 clients >> chips, MNIST CNN,
+   sequential ``client_chunk`` training per device, bf16 compute on the MXU.
 
 ``run_benchmark`` returns the experiment summary augmented with rounds/sec — the
 north-star metric (1000-client MNIST round < 1 s on v5e-8).
@@ -43,6 +45,14 @@ BENCHMARKS: dict[str, dict[str, Any]] = {
     "cross_silo": dict(
         model="resnet18", num_clients=8, num_rounds=2, local_epochs=1,
         batch_size=32, learning_rate=0.05, scheme="iid", participation=1.0,
+    ),
+    # Flagship clients>>chips configuration (BASELINE.md north star: 1000-client MNIST
+    # FedAvg round < 1 s).  60k MNIST / 1000 clients = 60 samples each; client_chunk
+    # bounds per-device live memory while vmap batches the resident clients.
+    "mnist_1000": dict(
+        model="mnist_cnn", num_clients=1000, num_rounds=3, local_epochs=2,
+        batch_size=64, learning_rate=0.1, scheme="iid", participation=1.0,
+        client_chunk=125, compute_dtype="bfloat16",
     ),
 }
 

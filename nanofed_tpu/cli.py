@@ -50,6 +50,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         out_dir=args.out_dir,
         seed=args.seed,
         train_size=args.train_size,
+        client_chunk=args.client_chunk,
+        compute_dtype=args.dtype,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -66,6 +68,10 @@ def _cmd_bench(args: argparse.Namespace) -> int:
         overrides["train_size"] = args.train_size
     if args.rounds is not None:
         overrides["num_rounds"] = args.rounds
+    if args.client_chunk is not None:
+        overrides["client_chunk"] = args.client_chunk
+    if args.dtype is not None:
+        overrides["compute_dtype"] = args.dtype
     summary = run_benchmark(args.name, out_dir=args.out_dir, **overrides)
     print(json.dumps(summary, indent=2, default=str))
     return 0
@@ -93,12 +99,23 @@ def main(argv: list[str] | None = None) -> int:
         "--train-size", type=int, default=None,
         help="cap the (synthetic) training set size; default = full dataset",
     )
+    run.add_argument(
+        "--client-chunk", type=int, default=None,
+        help="train each device's resident clients in sequential chunks of this many "
+        "(memory bound for clients >> chips)",
+    )
+    run.add_argument(
+        "--dtype", default=None, choices=["bfloat16", "float32"],
+        help="local-training compute dtype (mixed precision when bfloat16)",
+    )
 
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
     bench.add_argument("--list", action="store_true", help="list benchmark names")
     bench.add_argument("--rounds", type=int, default=None)
     bench.add_argument("--train-size", type=int, default=None)
+    bench.add_argument("--client-chunk", type=int, default=None)
+    bench.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     bench.add_argument("--out-dir", default="runs/bench")
 
     args = parser.parse_args(argv)

@@ -19,6 +19,7 @@ from nanofed_tpu.communication.http_server import (
     HEADER_CLIENT,
     HEADER_METRICS,
     HEADER_ROUND,
+    HEADER_SIGNATURE,
     HEADER_STATUS,
 )
 from nanofed_tpu.core.exceptions import NanoFedError
@@ -52,10 +53,15 @@ class HTTPClient:
         client_id: str,
         endpoints: ClientEndpoints | None = None,
         timeout_s: float = 300.0,
+        security_manager: Any | None = None,
     ) -> None:
+        """``security_manager`` (a ``nanofed_tpu.security.SecurityManager``) makes every
+        submitted update carry an RSA-PSS signature header; pair it with a server
+        configured with ``require_signatures=True`` and this client's public key."""
         self.server_url = server_url.rstrip("/")
         self.client_id = client_id
         self.endpoints = endpoints or ClientEndpoints()
+        self.security_manager = security_manager
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self._session: aiohttp.ClientSession | None = None
         self._log = Logger()
@@ -105,6 +111,16 @@ class HTTPClient:
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
         }
+        if self.security_manager is not None:
+            import base64
+
+            # Sign the exact wire context (client, round, verbatim metrics header) plus
+            # the params, so a captured update cannot be replayed into a later round or
+            # have its metrics (aggregation weight) rewritten.
+            signature = self.security_manager.sign_update(
+                params, self.client_id, self.current_round, headers[HEADER_METRICS]
+            )
+            headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
         async with session.post(url, data=encode_params(params), headers=headers) as resp:
             if resp.status != 200:
                 # Framework error pages (413 too-large, 500) are text, not JSON.

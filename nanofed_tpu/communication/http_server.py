@@ -37,6 +37,7 @@ HEADER_CLIENT = "X-NanoFed-Client"
 HEADER_ROUND = "X-NanoFed-Round"
 HEADER_METRICS = "X-NanoFed-Metrics"
 HEADER_STATUS = "X-NanoFed-Status"
+HEADER_SIGNATURE = "X-NanoFed-Signature"  # base64 RSA-PSS signature of the npz params
 
 
 @dataclass(frozen=True)
@@ -58,10 +59,19 @@ class HTTPServer:
         port: int = 8080,
         endpoints: ServerEndpoints | None = None,
         max_request_size: int = MAX_REQUEST_SIZE,
+        client_keys: dict[str, bytes] | None = None,
+        require_signatures: bool = False,
     ) -> None:
+        """``client_keys`` maps client_id -> PEM public key.  With
+        ``require_signatures=True`` every update must carry a valid RSA-PSS signature
+        (``HEADER_SIGNATURE``) from a registered client or it is rejected with 403 —
+        this is where the signing capability (``nanofed_tpu.security.signing``, parity
+        ``nanofed/server/validation.py:138-212``) is enforced on the wire."""
         self.host = host
         self.port = port
         self.endpoints = endpoints or ServerEndpoints()
+        self.client_keys = dict(client_keys or {})
+        self.require_signatures = require_signatures
         self._log = Logger()
         self._lock = asyncio.Lock()
         self._updates: dict[str, ModelUpdate] = {}
@@ -90,6 +100,8 @@ class HTTPServer:
             self._updates.clear()
 
     def num_updates(self) -> int:
+        # Lock-free read is safe: len() is atomic under the GIL and all mutation happens
+        # on this event loop; the round engine re-checks via drain_updates() anyway.
         return len(self._updates)
 
     async def drain_updates(self) -> list[ModelUpdate]:
@@ -164,11 +176,19 @@ class HTTPServer:
             )
         body = await request.read()
         try:
-            params = decode_params(body, like=self._params)
+            # Offload the CPU-bound decode (up to 100 MB decompress + structure checks)
+            # so concurrent /model and /status requests aren't stalled behind it.
+            params = await asyncio.to_thread(decode_params, body, like=self._params)
         except Exception as e:
             return web.json_response(
                 {"status": "error", "message": f"bad payload: {e}"}, status=400
             )
+        if self.require_signatures:
+            verdict = await asyncio.to_thread(
+                self._verify_update_signature, client_id, round_number, request, params
+            )
+            if verdict is not None:
+                return verdict
         async with self._lock:
             # Stale-round rejection (parity: server.py:260-272).
             if round_number != self._round:
@@ -194,6 +214,43 @@ class HTTPServer:
         return web.json_response(
             {"status": "success", "message": "update accepted", "update_id": client_id}
         )
+
+    def _verify_update_signature(
+        self, client_id: str, round_number: int, request: web.Request, params: Params
+    ) -> web.StreamResponse | None:
+        """Return an error response when the update's signature is missing/invalid,
+        None when it verifies (INVALID_SIGNATURE parity:
+        ``nanofed/server/validation.py:179-212``).
+
+        The signature covers the update's full wire context — client id, round number,
+        the verbatim metrics header, and the params — so a captured signed update cannot
+        be replayed into a later round or have its metrics rewritten.
+
+        CPU-bound (canonical serialization + RSA verify): callers run it via
+        ``asyncio.to_thread`` to keep the event loop responsive.
+        """
+        import base64
+
+        from nanofed_tpu.security.signing import verify_update_signature
+
+        pem = self.client_keys.get(client_id)
+        if pem is None:
+            return web.json_response(
+                {"status": "error", "message": f"unknown client {client_id!r}"}, status=403
+            )
+        try:
+            signature = base64.b64decode(request.headers.get(HEADER_SIGNATURE, ""))
+        except Exception:
+            signature = b""
+        metrics_json = request.headers.get(HEADER_METRICS, "{}")
+        if not signature or not verify_update_signature(
+            params, client_id, round_number, metrics_json, signature, pem
+        ):
+            self._log.warning("invalid signature from %s", client_id)
+            return web.json_response(
+                {"status": "error", "message": "invalid signature"}, status=403
+            )
+        return None
 
     async def _handle_status(self, request: web.Request) -> web.StreamResponse:
         return web.json_response(

@@ -40,18 +40,41 @@ class NetworkRoundConfig:
     poll_interval_s: float = 0.05
 
 
+def _metric(
+    metrics: dict, key: str, default: float, *alt_keys: str, positive: bool = False
+) -> float:
+    """Defensive float coercion of a client-supplied metric value.
+
+    Clients control the metrics JSON: the server validates the params payload strictly
+    but metrics only as parseable JSON, so a single client sending ``"loss": "oops"``
+    must not raise inside ``train_round`` and kill the round for everyone.  Non-numeric
+    or non-finite values fall back to ``default``; ``positive=True`` additionally
+    rejects values <= 0 (a negative ``num_samples`` could zero the cohort's weight sum
+    and blow up the weighted mean).
+    """
+    for k in (key, *alt_keys):
+        if k in metrics:
+            try:
+                v = float(metrics[k])
+            except (TypeError, ValueError):
+                continue
+            if math.isfinite(v) and not (positive and v <= 0):
+                return v
+    return default
+
+
 def stack_model_updates(updates: list[ModelUpdate]) -> ClientUpdates:
     """Stack host-path ``ModelUpdate`` records into one device batch for aggregation."""
     params = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
                           *[u.params for u in updates])
     weights = jnp.asarray(
-        [float(u.metrics.get("num_samples", u.metrics.get("samples_processed", 1.0)))
+        [_metric(u.metrics, "num_samples", 1.0, "samples_processed", positive=True)
          for u in updates],
         jnp.float32,
     )
     metrics = ClientMetrics(
-        loss=jnp.asarray([float(u.metrics.get("loss", 0.0)) for u in updates]),
-        accuracy=jnp.asarray([float(u.metrics.get("accuracy", 0.0)) for u in updates]),
+        loss=jnp.asarray([_metric(u.metrics, "loss", 0.0) for u in updates]),
+        accuracy=jnp.asarray([_metric(u.metrics, "accuracy", 0.0) for u in updates]),
         samples=weights,
     )
     return ClientUpdates(params=params, weights=weights, metrics=metrics)

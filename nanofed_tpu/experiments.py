@@ -56,12 +56,20 @@ def run_experiment(
     eval_every: int = 0,
     train_size: int | None = None,
     central_privacy: Any = None,
+    client_chunk: int | None = None,
+    compute_dtype: str | None = None,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
 
     ``central_privacy`` (a ``PrivacyAwareAggregationConfig``) turns the reduce into
     DP-FedAvg — clipping + Gaussian noise at the aggregation step.
+
+    ``client_chunk`` bounds per-device memory when clients >> chips: each device trains
+    its resident clients in sequential chunks of this many (``lax.map`` over ``vmap``)
+    instead of one giant vmap — the production configuration at 1000-client scale.
+    ``compute_dtype="bfloat16"`` runs local forward/backward in bf16 on the MXU (mixed
+    precision; params/updates stay float32).
     """
     log = Logger()
     mdl = get_model(model)
@@ -87,9 +95,11 @@ def run_experiment(
             local_epochs=local_epochs,
             learning_rate=learning_rate,
             prox_mu=prox_mu,
+            compute_dtype=compute_dtype,
         ),
         eval_data=pack_eval(test, batch_size=256),
         central_privacy=central_privacy,
+        client_chunk=client_chunk,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()

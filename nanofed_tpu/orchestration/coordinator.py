@@ -114,6 +114,16 @@ class Coordinator:
         self.on_round_end = on_round_end
         self._log = Logger()
 
+        # Central DP is applied inside the round step; the coordinator owns the matching
+        # accountant so the configured (ε, δ) budget is actually tracked and reported
+        # (the noise itself would otherwise be spent but never accounted anywhere).
+        self.central_privacy = central_privacy
+        self.privacy_accountant = None
+        if central_privacy is not None:
+            from nanofed_tpu.privacy.accounting import GaussianAccountant
+
+            self.privacy_accountant = GaussianAccountant()
+
         self.num_clients = int(train_data.x.shape[0])
         n_dev = len(self.mesh.devices.flat)
         padded = pad_client_count(self.num_clients, n_dev)
@@ -250,6 +260,16 @@ class Coordinator:
             if count_key in agg:
                 agg[count_key] = int(agg[count_key])
 
+        if self.privacy_accountant is not None:
+            from nanofed_tpu.aggregation.privacy import record_central_privacy
+
+            record_central_privacy(self.privacy_accountant, self.central_privacy)
+            spent = self.privacy_accountant.get_privacy_spent(
+                self.central_privacy.privacy.delta
+            )
+            agg["privacy_epsilon"] = spent.epsilon_spent
+            agg["privacy_delta"] = spent.delta_spent
+
         eval_metrics: dict[str, float] = {}
         if (
             self._evaluator is not None
@@ -313,6 +333,13 @@ class Coordinator:
             failed_rounds=len(failed),
             global_metrics=global_metrics,
         )
+
+    @property
+    def privacy_spent(self):
+        """Cumulative central-DP spend (``PrivacySpent``), or None without central DP."""
+        if self.privacy_accountant is None:
+            return None
+        return self.privacy_accountant.get_privacy_spent(self.central_privacy.privacy.delta)
 
     def evaluate(self) -> dict[str, float]:
         if self._evaluator is None:

@@ -116,9 +116,15 @@ def _pair_seed(my_key: ClientKeyPair, peer_public: bytes, round_context: bytes) 
 
 
 def _prg_uint32(seed: bytes, size: int) -> np.ndarray:
-    """Expand a 32-byte seed into ``size`` uniform uint32 words (Philox counter PRG)."""
-    words = np.frombuffer(seed[:16], dtype=np.uint64)
-    return np.random.Generator(np.random.Philox(key=words)).integers(
+    """Expand a 32-byte seed into ``size`` uniform uint32 words (Philox counter PRG).
+
+    numpy's Philox key is 2x uint64 (128 bits), so the 256-bit HKDF seed is XOR-folded
+    onto it; the parse is explicitly little-endian so two parties on different-endian
+    hosts expand identical pairwise mask streams (the ± cancellation depends on it).
+    """
+    words = np.frombuffer(seed, dtype="<u8")  # 4 little-endian words from all 32 bytes
+    key = words[:2] ^ words[2:]
+    return np.random.Generator(np.random.Philox(key=key)).integers(
         0, 1 << 32, size=size, dtype=np.uint32
     )
 

@@ -34,7 +34,38 @@ def canonical_bytes(params: Params) -> bytes:
     return bytes(out)
 
 
+def update_signing_bytes(
+    params: Params, client_id: str, round_number: int, metrics_json: str
+) -> bytes:
+    """Byte string a federated update signature covers: params PLUS the update's
+    context (client id, round number, the exact metrics-header string).
+
+    Signing params alone would allow replay: a captured signed update could be re-posted
+    for a later round, or with rewritten metrics (e.g. an inflated ``num_samples``
+    forging its aggregation weight).  Binding the context makes signature verification
+    reject any such splice.  ``metrics_json`` must be the verbatim wire string — both
+    ends use the raw header, never a re-serialization.
+    """
+    context = f"client={client_id}&round={round_number}&metrics={metrics_json}&params="
+    return context.encode() + canonical_bytes(params)
+
+
 _PSS = padding.PSS(mgf=padding.MGF1(hashes.SHA256()), salt_length=padding.PSS.MAX_LENGTH)
+
+
+def _verify_bytes(data: bytes, signature: bytes, public_key: bytes) -> bool:
+    try:
+        key = serialization.load_pem_public_key(public_key)
+        if not isinstance(key, RSAPublicKey):
+            Logger().error("Unsupported public key type.")
+            return False
+        key.verify(signature, data, _PSS, hashes.SHA256())
+        return True
+    except InvalidSignature:
+        return False
+    except Exception as e:  # corrupt PEM, etc. — verification fails closed
+        Logger().error(f"Signature verification failed: {e}")
+        return False
 
 
 def verify_signature(params: Params, signature: bytes, public_key: bytes) -> bool:
@@ -42,20 +73,25 @@ def verify_signature(params: Params, signature: bytes, public_key: bytes) -> boo
     (parity: ``nanofed/server/validation.py:179-212``).
 
     Module-level so verifiers (the server checking N clients) never pay the RSA keypair
-    generation that constructing a ``SecurityManager`` implies.
+    generation that constructing a ``SecurityManager`` implies.  For federated updates
+    on the wire prefer :func:`verify_update_signature`, which also binds the update's
+    context against replay.
     """
-    try:
-        key = serialization.load_pem_public_key(public_key)
-        if not isinstance(key, RSAPublicKey):
-            Logger().error("Unsupported public key type.")
-            return False
-        key.verify(signature, canonical_bytes(params), _PSS, hashes.SHA256())
-        return True
-    except InvalidSignature:
-        return False
-    except Exception as e:  # corrupt PEM, etc. — verification fails closed
-        Logger().error(f"Signature verification failed: {e}")
-        return False
+    return _verify_bytes(canonical_bytes(params), signature, public_key)
+
+
+def verify_update_signature(
+    params: Params,
+    client_id: str,
+    round_number: int,
+    metrics_json: str,
+    signature: bytes,
+    public_key: bytes,
+) -> bool:
+    """Verify a federated update's signature including its replay-protection context
+    (see :func:`update_signing_bytes`)."""
+    data = update_signing_bytes(params, client_id, round_number, metrics_json)
+    return _verify_bytes(data, signature, public_key)
 
 
 class SecurityManager:
@@ -79,6 +115,14 @@ class SecurityManager:
     def sign_params(self, params: Params) -> bytes:
         """Sign a params pytree (parity: ``sign_update``, ``validation.py:155-177``)."""
         return self._private_key.sign(canonical_bytes(params), _PSS, hashes.SHA256())
+
+    def sign_update(
+        self, params: Params, client_id: str, round_number: int, metrics_json: str
+    ) -> bytes:
+        """Sign a federated update with its replay-protection context
+        (see :func:`update_signing_bytes`)."""
+        data = update_signing_bytes(params, client_id, round_number, metrics_json)
+        return self._private_key.sign(data, _PSS, hashes.SHA256())
 
     def verify_signature(self, params: Params, signature: bytes, public_key: bytes) -> bool:
         """Instance-method convenience over the module-level ``verify_signature``."""

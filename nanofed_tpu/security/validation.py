@@ -43,13 +43,19 @@ class ValidationConfig:
     ``max_norm`` bounds each parameter leaf's L2 norm; ``z_score_threshold`` flags clients
     whose *global* update norm deviates from the cohort; statistics are skipped below
     ``min_clients_for_stats`` participants.
+
+    ``signature_required`` is advisory metadata here: signatures are a transport-layer
+    concern, enforced by ``HTTPServer(require_signatures=True, client_keys=...)`` +
+    ``HTTPClient(security_manager=...)`` — the statistical checks in this module operate
+    on already-decoded stacked arrays where no signature exists.  It defaults to False so
+    a config constructed for the in-mesh simulator (no wire, nothing to sign) is honest.
     """
 
     max_norm: float = 10.0
     max_update_size: int = 1024 * 1024 * 100
     min_clients_for_stats: int = 5
     z_score_threshold: float = 2.0
-    signature_required: bool = True
+    signature_required: bool = False
 
 
 class ValidationReport(NamedTuple):

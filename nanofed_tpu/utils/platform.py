@@ -1,0 +1,130 @@
+"""Platform bring-up helpers: CPU-mesh forcing and watchdogged backend init.
+
+Two hazards motivate this module (both observed in driver runs):
+
+1. The environment may pre-select an out-of-tree accelerator platform (e.g.
+   ``JAX_PLATFORMS=axon``) via a sitecustomize that imports jax at interpreter startup.
+   Setting ``JAX_PLATFORMS=cpu`` in the *environment* of a fresh process is then too late —
+   the config value was already bound at import.  :func:`force_cpu_mesh` forces the CPU
+   platform correctly: config update + unregistering the accelerator plugin factory,
+   before the first backend initialization.
+
+2. A TPU process killed mid-run can wedge the device tunnel: every later backend init
+   hangs *forever* inside ``jax.devices()`` with no Python-level timeout available.
+   :func:`deadline` / :func:`init_devices_or_die` bound such hangs with a watchdog thread
+   that prints a diagnostic (and an optional machine-readable JSON error line) and
+   hard-exits, so callers fail fast with evidence instead of a silent rc=124.
+
+The reference framework has no analogue (it never touches an accelerator); this is
+TPU-runtime hardening that SURVEY.md §5 "failure detection" implies for the TPU build.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+import sys
+import threading
+import time
+from typing import Iterator
+
+
+def log_stage(msg: str, *, t0: float | None = None) -> None:
+    """Timestamped progress line on stderr (flushed), so a killed process leaves a
+    diagnostic tail showing the last stage reached."""
+    stamp = time.strftime("%H:%M:%S")
+    rel = f" +{time.time() - t0:7.1f}s" if t0 is not None else ""
+    print(f"[{stamp}{rel}] {msg}", file=sys.stderr, flush=True)
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Force a virtual ``n_devices``-device CPU mesh, overriding any preset accelerator
+    platform.  Safe to call whether or not jax is already imported; must be called before
+    the first backend initialization (``jax.devices()`` etc.)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        # Replace a pre-set count (it may differ from n_devices) rather than skip.
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = f"{flags} {flag}".strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # Unregister the out-of-tree accelerator plugin a sitecustomize may have registered:
+    # its client init dials real hardware and can hang if the tunnel is busy/wedged.
+    # Only the plugin is removed — built-in platform names must stay registered or MLIR
+    # lowering-rule registration rejects them as unknown platforms.
+    from jax._src import xla_bridge as _xb
+
+    for plugin in ("axon",):
+        _xb._backend_factories.pop(plugin, None)
+
+
+@contextlib.contextmanager
+def deadline(
+    stage: str, timeout_s: float, *, error_json: dict | None = None, exit_code: int = 3
+) -> Iterator[None]:
+    """Bound a stage that may hang in native code (backend init, first compile).
+
+    A daemon watchdog thread fires after ``timeout_s``: prints a diagnostic to stderr,
+    optionally a machine-readable JSON line to stdout, then ``os._exit`` — the only way
+    out when the main thread is stuck inside a C++ call that never returns.
+    """
+    done = threading.Event()
+
+    def watchdog() -> None:
+        if done.wait(timeout_s):
+            return
+        print(
+            f"[watchdog] stage '{stage}' exceeded {timeout_s:.0f}s — "
+            "accelerator backend likely wedged; aborting with diagnostic instead of hanging",
+            file=sys.stderr,
+            flush=True,
+        )
+        if error_json is not None:
+            payload = dict(error_json)
+            payload.setdefault("error", f"{stage} timed out after {timeout_s:.0f}s")
+            print(json.dumps(payload), flush=True)
+        os._exit(exit_code)
+
+    t = threading.Thread(target=watchdog, name=f"deadline-{stage}", daemon=True)
+    t.start()
+    try:
+        yield
+    finally:
+        done.set()
+
+
+def init_devices_or_die(
+    timeout_s: float = 120.0, *, error_json: dict | None = None
+) -> list:
+    """``jax.devices()`` with a watchdog (see :func:`deadline`)."""
+    import jax
+
+    with deadline("jax backend init", timeout_s, error_json=error_json):
+        return jax.devices()
+
+
+def enable_compilation_cache(cache_dir: str | os.PathLike | None = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    ``$NANOFED_CACHE_DIR`` or ``./.jax_cache`` in the working tree — NOT the package
+    install location, which may be read-only site-packages) so repeated driver/bench runs
+    skip recompilation.  Returns the cache dir used."""
+    import jax
+
+    path = str(
+        cache_dir
+        or os.environ.get("NANOFED_CACHE_DIR")
+        or os.path.join(os.getcwd(), ".jax_cache")
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path

@@ -22,6 +22,10 @@ _SMOKE = {
     "fedprox_cifar10": dict(train_size=512, num_rounds=1, num_clients=8),
     "dp_fedavg_mnist": dict(train_size=640, num_rounds=2),
     "cross_silo": dict(train_size=256, num_rounds=1),
+    # 32 clients >> 8 devices with client_chunk=2: exercises the sequential-chunk path
+    # and bf16 mixed precision through the PUBLIC harness (the flagship configuration,
+    # scaled down for the 1-core CPU mesh).
+    "mnist_1000": dict(train_size=640, num_rounds=2, num_clients=32, client_chunk=2),
 }
 
 

@@ -145,3 +145,43 @@ def test_run_experiment_cli_engine(tmp_path, devices):
     )
     assert out["rounds_completed"] == 2
     assert "accuracy" in out["final_eval_metrics"]
+
+
+def test_central_privacy_accounting_surfaces_epsilon(mlp, tmp_path, devices):
+    """The coordinator owns an accountant when central DP is configured: ε/δ spend shows
+    up in every completed round's metrics and accumulates monotonically."""
+    from nanofed_tpu.aggregation import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+
+    cd = federate(_data(n=256), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(num_rounds=3, base_dir=tmp_path),
+        training=TrainingConfig(batch_size=16),
+        central_privacy=PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        ),
+    )
+    rounds = coord.run()
+    eps = [r.agg_metrics["privacy_epsilon"] for r in rounds]
+    assert all(e > 0 for e in eps)
+    assert eps == sorted(eps) and eps[0] < eps[-1]  # cumulative across rounds
+    assert rounds[-1].agg_metrics["privacy_delta"] == 1e-5
+    assert coord.privacy_spent.epsilon_spent == pytest.approx(eps[-1])
+    # And it lands in the persisted per-round metrics JSON.
+    payload = json.loads((tmp_path / "metrics" / "metrics_round_2.json").read_text())
+    assert payload["agg_metrics"]["privacy_epsilon"] == pytest.approx(eps[-1])
+
+
+def test_no_privacy_no_accounting(mlp, tmp_path, devices):
+    cd = federate(_data(n=128), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(num_rounds=1, base_dir=tmp_path),
+        training=TrainingConfig(batch_size=16),
+    )
+    rounds = coord.run()
+    assert coord.privacy_spent is None
+    assert "privacy_epsilon" not in rounds[0].agg_metrics

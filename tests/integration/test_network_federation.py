@@ -146,3 +146,85 @@ def test_stale_round_rejected_and_status():
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_metrics_coercion_survives_malicious_values():
+    """A client sending non-numeric / non-finite metrics must not kill the round
+    (the server validates params strictly but metrics only as parseable JSON)."""
+    from nanofed_tpu.communication.network_coordinator import stack_model_updates
+    from nanofed_tpu.core.types import ModelUpdate
+
+    def upd(cid, metrics):
+        return ModelUpdate(
+            client_id=cid, round_number=0, params={"w": jnp.ones((2,))},
+            metrics=metrics, timestamp="t",
+        )
+
+    stacked = stack_model_updates([
+        upd("good", {"loss": 0.5, "accuracy": 0.9, "num_samples": 10}),
+        upd("evil", {"loss": "oops", "accuracy": None, "num_samples": "NaN"}),
+        upd("str-numeric", {"loss": "0.25", "num_samples": "4"}),
+    ])
+    np.testing.assert_allclose(np.asarray(stacked.weights), [10.0, 1.0, 4.0])
+    np.testing.assert_allclose(np.asarray(stacked.metrics.loss), [0.5, 0.0, 0.25])
+    np.testing.assert_allclose(np.asarray(stacked.metrics.accuracy), [0.9, 0.0, 0.0])
+
+
+def test_signature_enforcement_end_to_end():
+    """require_signatures: unsigned and wrong-key updates are rejected with 403, a
+    properly signed update is buffered (INVALID_SIGNATURE wire parity)."""
+    from nanofed_tpu.security import SecurityManager
+
+    model = get_model("linear", in_features=4, num_classes=2)
+    params = model.init(jax.random.key(0))
+    signer = SecurityManager(key_size=2048)
+    impostor = SecurityManager(key_size=2048)
+    port = PORT + 2
+
+    async def main():
+        server = HTTPServer(
+            port=port,
+            client_keys={"c1": signer.get_public_key()},
+            require_signatures=True,
+        )
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            url = f"http://127.0.0.1:{port}"
+            # Unsigned update from a registered client: rejected.
+            async with HTTPClient(url, "c1", timeout_s=10) as c:
+                assert not await c.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 0
+            # Signed with the WRONG key: rejected.
+            async with HTTPClient(url, "c1", timeout_s=10,
+                                  security_manager=impostor) as c:
+                assert not await c.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 0
+            # Unregistered client id: rejected even with a signature.
+            async with HTTPClient(url, "mallory", timeout_s=10,
+                                  security_manager=signer) as c:
+                assert not await c.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 0
+            # Correctly signed: accepted.
+            async with HTTPClient(url, "c1", timeout_s=10,
+                                  security_manager=signer) as c:
+                assert await c.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_negative_num_samples_rejected():
+    """A negative num_samples could zero the cohort weight sum and blow up the mean —
+    coercion must fall back to the default weight."""
+    from nanofed_tpu.communication.network_coordinator import stack_model_updates
+    from nanofed_tpu.core.types import ModelUpdate
+
+    def upd(cid, n):
+        return ModelUpdate(client_id=cid, round_number=0, params={"w": jnp.ones((2,))},
+                           metrics={"num_samples": n}, timestamp="t")
+
+    stacked = stack_model_updates([upd("good", 10), upd("evil", -10), upd("zero", 0)])
+    np.testing.assert_allclose(np.asarray(stacked.weights), [10.0, 1.0, 1.0])

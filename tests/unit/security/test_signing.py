@@ -38,3 +38,31 @@ def test_canonical_bytes_distinguishes_shape_and_dtype():
     b = canonical_bytes({"w": jnp.zeros((3, 2))})
     c = canonical_bytes({"w": jnp.zeros((2, 3), jnp.bfloat16)})
     assert a != b and a != c
+
+
+class TestUpdateSignatureBinding:
+    """A signed update is bound to (client, round, metrics, params): changing ANY
+    component must invalidate the signature (replay/splice protection)."""
+
+    def test_context_binding(self):
+        from nanofed_tpu.security.signing import SecurityManager, verify_update_signature
+
+        sm = SecurityManager(key_size=2048)
+        import numpy as np
+
+        params = {"w": np.arange(4, dtype=np.float32)}
+        metrics = '{"loss": 0.5, "num_samples": 10}'
+        sig = sm.sign_update(params, "c1", 3, metrics)
+        pk = sm.get_public_key()
+
+        assert verify_update_signature(params, "c1", 3, metrics, sig, pk)
+        # Replay into a later round.
+        assert not verify_update_signature(params, "c1", 4, metrics, sig, pk)
+        # Splice onto another client id.
+        assert not verify_update_signature(params, "c2", 3, metrics, sig, pk)
+        # Rewritten metrics (forged aggregation weight).
+        forged = '{"loss": 0.5, "num_samples": 1000000.0}'
+        assert not verify_update_signature(params, "c1", 3, forged, sig, pk)
+        # Tampered params.
+        other = {"w": np.zeros(4, dtype=np.float32)}
+        assert not verify_update_signature(other, "c1", 3, metrics, sig, pk)
