@@ -5,6 +5,7 @@ from nanofed_tpu.data.batching import federate, pack_clients, pack_eval
 from nanofed_tpu.data.datasets import (
     Dataset,
     load_cifar,
+    load_digits_dataset,
     load_mnist,
     synthetic_classification,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "iid_partition",
     "label_skew_partition",
     "load_cifar",
+    "load_digits_dataset",
     "load_mnist",
     "pack_clients",
     "pack_eval",

@@ -139,6 +139,39 @@ def load_mnist(
 
 
 # ---------------------------------------------------------------------------
+# Handwritten digits (sklearn, bundled offline — REAL image data)
+# ---------------------------------------------------------------------------
+
+
+def load_digits_dataset(split: str = "train", test_fraction: float = 0.2) -> Dataset:
+    """The scikit-learn handwritten-digits dataset (1,797 real 8x8 grayscale digit
+    images, UCI optdigits): the one real image dataset guaranteed available offline.
+
+    Serves as the real-data accuracy evidence in environments where MNIST cannot be
+    downloaded (see ``scripts/fetch_mnist.py`` for the MNIST acquisition path).  The
+    split is deterministic (seeded shuffle, last ``test_fraction`` held out).
+    """
+    try:
+        from sklearn.datasets import load_digits
+    except ImportError as e:  # pragma: no cover - sklearn is an optional dependency
+        raise FileNotFoundError(
+            "sklearn is not installed; the bundled digits dataset is unavailable"
+        ) from e
+
+    x, y = load_digits(return_X_y=True)
+    x = (x.reshape(-1, 8, 8, 1) / 16.0).astype(np.float32)  # pixels are 0..16
+    y = y.astype(np.int32)
+    order = np.random.default_rng(0).permutation(len(y))
+    x, y = x[order], y[order]
+    cut = int(len(y) * (1.0 - test_fraction))
+    if split == "train":
+        x, y = x[:cut], y[:cut]
+    else:
+        x, y = x[cut:], y[cut:]
+    return Dataset(x=x, y=y, num_classes=10, name="digits")
+
+
+# ---------------------------------------------------------------------------
 # CIFAR (python pickle format)
 # ---------------------------------------------------------------------------
 

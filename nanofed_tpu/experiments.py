@@ -24,6 +24,11 @@ def load_datasets_for(
     if mdl.input_shape == (28, 28, 1):
         train = load_mnist("train", data_dir, synthetic_size=train_size)
         test = load_mnist("test", data_dir, synthetic_size=test_size)
+    elif mdl.input_shape == (8, 8, 1):
+        from nanofed_tpu.data import load_digits_dataset
+
+        train = load_digits_dataset("train")
+        test = load_digits_dataset("test")
     elif mdl.input_shape == (32, 32, 3):
         nc = mdl.num_classes
         train = load_cifar("train", data_dir, num_classes=nc, synthetic_size=train_size)

@@ -44,3 +44,13 @@ def mlp(in_features: int = 784, hidden: int = 128, num_classes: int = 10) -> Mod
     return Model(
         name="mlp", init=init, apply=apply, input_shape=(in_features,), num_classes=num_classes
     )
+
+
+@register_model("digits_mlp")
+def digits_mlp(hidden: int = 64) -> Model:
+    """MLP for the bundled sklearn handwritten-digits dataset (real 8x8 images) — the
+    offline real-data accuracy benchmark (see ``data.load_digits_dataset``)."""
+    m = mlp(in_features=64, hidden=hidden, num_classes=10)
+    return Model(
+        name="digits_mlp", init=m.init, apply=m.apply, input_shape=(8, 8, 1), num_classes=10
+    )

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Fetch the real MNIST IDX files (the reference gets them via torchvision,
+``nanofed/data/mnist.py:9-40``; this framework reads the IDX files directly —
+``nanofed_tpu.data.load_mnist``).
+
+Downloads the four gzip'd IDX files from the first reachable mirror, validates their
+STRUCTURE (IDX magic numbers, record counts, 28x28 dims — verifiable offline, unlike
+embedded hashes), records each file's SHA-256 into ``checksums.json`` next to the data
+for reproducibility, and leaves them where ``load_mnist(data_dir=...)`` expects them.
+
+Usage:
+    python scripts/fetch_mnist.py --out data/mnist
+    python scripts/fetch_mnist.py --out data/mnist --verify-only   # re-check existing
+
+Zero-egress environments: this script cannot run there (it reports the failure
+clearly); use pre-placed IDX/npz files instead, or the bundled sklearn digits dataset
+(``nanofed_tpu.data.load_digits_dataset``) as the offline real-data benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import hashlib
+import json
+import struct
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+MIRRORS = [
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+]
+
+# file name -> (idx magic, record count)
+FILES = {
+    "train-images-idx3-ubyte.gz": (2051, 60_000),
+    "train-labels-idx1-ubyte.gz": (2049, 60_000),
+    "t10k-images-idx3-ubyte.gz": (2051, 10_000),
+    "t10k-labels-idx1-ubyte.gz": (2049, 10_000),
+}
+
+
+def validate_idx(path: Path, expect_magic: int, expect_count: int) -> None:
+    """Structural validation of a gzip'd IDX file; raises ValueError on mismatch."""
+    with gzip.open(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        if magic != expect_magic:
+            raise ValueError(f"{path.name}: bad IDX magic {magic} (want {expect_magic})")
+        count = struct.unpack(">I", f.read(4))[0]
+        if count != expect_count:
+            raise ValueError(f"{path.name}: {count} records (want {expect_count})")
+        if expect_magic == 2051:  # images: check 28x28 dims and payload size
+            rows, cols = struct.unpack(">II", f.read(8))
+            if (rows, cols) != (28, 28):
+                raise ValueError(f"{path.name}: {rows}x{cols} images (want 28x28)")
+            payload = f.read()
+            if len(payload) != count * 28 * 28:
+                raise ValueError(f"{path.name}: truncated payload")
+        else:
+            payload = f.read()
+            if len(payload) != count:
+                raise ValueError(f"{path.name}: truncated payload")
+
+
+def sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def fetch(name: str, out: Path) -> None:
+    last_err: Exception | None = None
+    for mirror in MIRRORS:
+        url = mirror + name
+        try:
+            print(f"  {url} ...", flush=True)
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                out.write_bytes(resp.read())
+            return
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            last_err = e
+            print(f"    failed: {e}", file=sys.stderr)
+    raise SystemExit(
+        f"could not download {name} from any mirror (zero-egress environment?): {last_err}"
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="data/mnist", help="target directory for IDX files")
+    ap.add_argument("--verify-only", action="store_true", help="only validate existing files")
+    args = ap.parse_args()
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    sums: dict[str, str] = {}
+    for name, (magic, count) in FILES.items():
+        path = out / name
+        if not path.exists():
+            if args.verify_only:
+                print(f"MISSING {path}")
+                return 1
+            fetch(name, path)
+        validate_idx(path, magic, count)
+        sums[name] = sha256(path)
+        print(f"  ok {name}  sha256={sums[name][:16]}…  ({count} records)")
+    (out / "checksums.json").write_text(json.dumps(sums, indent=2))
+    print(f"MNIST ready under {out} (checksums.json written)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
