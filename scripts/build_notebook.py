@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Author + execute the tutorial notebook (parity: ``examples/mnist/tutorial.ipynb`` in
+the reference, a 20-cell executed walkthrough whose cell outputs are the source of the
+published baseline numbers).
+
+Builds ``examples/mnist/tutorial.ipynb`` from the cell specs below with nbformat, then
+executes it with nbconvert so the committed notebook carries REAL outputs.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import nbformat as nbf
+
+REPO = Path(__file__).resolve().parent.parent
+
+MD = [
+    # 0
+    """# NanoFed-TPU tutorial: federated learning as one SPMD program
+
+This is the TPU-native re-telling of the reference tutorial
+(`examples/mnist/tutorial.ipynb` in camille-004/nanofed). The reference runs an aiohttp
+server plus client coroutines that exchange weights as JSON over localhost; every round
+is a distributed-systems dance of polling, serialization and Python loops. Here the same
+federated round is **one jitted XLA program over a device mesh**:
+
+```
+round = jit( shard_map( vmap(local_fit) ; psum-weighted-mean ) )
+```
+
+- every **client** is a slot on a named `clients` mesh axis (vmapped within a device,
+  sharded across devices),
+- **local training** is a `lax.scan` over batches inside `vmap` — no Python per-batch loop,
+- **aggregation** (FedAvg) is a `psum` weighted mean across the mesh — the "network"
+  is the TPU interconnect (ICI),
+- the coordinator's wait-barrier disappears: SPMD lockstep *is* the barrier.
+""",
+    # 1
+    """## 1. Platform setup
+
+On a TPU host this cell is unnecessary — JAX finds the chips. For a portable tutorial we
+force the **virtual 8-device CPU mesh** (the same trick `tests/conftest.py` uses), so
+every `shard_map`/collective path below runs exactly as it would across 8 real chips.
+
+> Skip this cell on a real TPU slice.""",
+    # 2
+    """## 2. Data: real images, federated
+
+We use a real dataset that ships offline (scikit-learn's 1,797 handwritten 8×8 digit
+images; swap in MNIST IDX files via `load_mnist(data_dir=...)` after running
+`scripts/fetch_mnist.py`). `federate` partitions it into per-client shards and packs
+them into ONE `ClientData` batch — a pytree of `[clients, samples, ...]` arrays with a
+padding mask, because SPMD wants equal shapes, not ragged Python lists.""",
+    # 3
+    """## 3. Model: a pure `(init, apply)` pair
+
+No `nn.Module`s: a model is a named pair of pure functions over an explicit parameter
+pytree — the property that lets a whole federated round jit into one program.""",
+    # 4
+    """## 4. Train: the coordinator drives jitted SPMD rounds
+
+`Coordinator` is the round engine (the reference's `Coordinator.train_round` polls an
+HTTP buffer at 1 Hz; ours calls the compiled round step). Round 0 pays the XLA compile;
+every later round is sub-millisecond-to-milliseconds at this scale.""",
+    # 5
+    """### Inspect the metrics artifacts
+
+Per-round metrics land in `metrics/metrics_round_N.json` with per-client detail —
+format parity with the reference's artifacts (its `coordinator.py:247-280`).""",
+    # 6
+    """## 5. Evaluation trajectory
+
+`eval_every` evaluates the global model on held-out data inside the round loop; the
+history lets us plot accuracy over rounds.""",
+    # 7
+    """## 6. Differential privacy in one argument
+
+`central_privacy` turns the reduce into DP-FedAvg: per-client update clipping + Gaussian
+noise INSIDE the jitted aggregation, and the coordinator accounts the (ε, δ) spend per
+round (`privacy_epsilon` in the metrics).""",
+    # 8
+    """## 7. Checkpoint & resume
+
+`FileStateStore` checkpoints round state; a new `Coordinator` with the same store picks
+up at the next round — resume is integrated into the engine (the reference ships a
+recovery module but never wires it in).""",
+    # 9
+    """## Where to go next
+
+- **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
+  (`nanofed-tpu bench mnist_1000`); `compute_dtype="bfloat16"` engages the MXU.
+- **Real networks**: `nanofed_tpu.communication` has a binary-payload HTTP server/client
+  with RSA-PSS-signed updates for true cross-device federation.
+- **Secure aggregation**: `nanofed_tpu.security.secure_agg` implements honest Bonawitz
+  pairwise masking (X25519 + HKDF + Shamir).
+- **Benchmarks**: `nanofed-tpu bench --list`; accuracy evidence in
+  `runs/accuracy_digits_r02.json`.""",
+]
+
+CODE = [
+    # A (after MD 1)
+    """import os
+from nanofed_tpu.utils.platform import force_cpu_mesh
+force_cpu_mesh(8)   # portable tutorial: 8 virtual devices; skip on a real TPU slice
+
+import jax
+print(f"{len(jax.devices())} devices:", jax.devices()[:2], "...")""",
+    # B (after MD 2)
+    """from nanofed_tpu.data import federate, load_digits_dataset, pack_eval
+
+train, test = load_digits_dataset("train"), load_digits_dataset("test")
+print(f"train {train.x.shape}, test {test.x.shape}  (real 8x8 digit images)")
+
+client_data = federate(train, num_clients=8, scheme="iid", batch_size=16, seed=0)
+print("federated:", jax.tree.map(lambda a: a.shape, client_data))""",
+    # C (after MD 3)
+    """from nanofed_tpu.models import get_model, list_models
+from nanofed_tpu.trainer import TrainingConfig
+
+print("model zoo:", list_models())
+model = get_model("digits_mlp", hidden=96)
+training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
+params = model.init(jax.random.key(0))
+print("params:", jax.tree.map(lambda a: a.shape, params))""",
+    # D (after MD 4)
+    """import time
+from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+
+coord = Coordinator(
+    model=model,
+    train_data=client_data,
+    config=CoordinatorConfig(num_rounds=10, seed=0, base_dir="runs/tutorial",
+                             eval_every=2),
+    training=training,
+    eval_data=pack_eval(test, batch_size=128),
+)
+t0 = time.time()
+history = coord.run()
+print(f"{len(history)} rounds in {time.time()-t0:.2f}s "
+      f"(round 0 includes the XLA compile)")
+for m in history[-3:]:
+    print(f"  round {m.round_id}: loss={m.agg_metrics['loss']:.4f} "
+          f"acc={m.agg_metrics['accuracy']:.4f} ({m.duration_s*1e3:.1f} ms)")""",
+    # E (after MD 5)
+    """import json, pathlib
+artifact = json.loads(pathlib.Path("runs/tutorial/metrics/metrics_round_9.json").read_text())
+print(json.dumps({k: v for k, v in artifact.items() if k != "clients"}, indent=2))
+print("per-client weights:", [round(w, 3) for w in artifact["clients"]["weights"]])""",
+    # F (after MD 6)
+    """final = coord.evaluate()
+print("final held-out:", final)
+accs = [(m.round_id, m.eval_metrics["accuracy"]) for m in history if m.eval_metrics]
+for r, a in accs:
+    print(f"  round {r}: test acc {a:.4f} " + "#" * int(a * 40))""",
+    # G (after MD 7)
+    """from nanofed_tpu.aggregation import PrivacyAwareAggregationConfig
+from nanofed_tpu.privacy import PrivacyConfig
+
+dp_coord = Coordinator(
+    model=model,
+    train_data=client_data,
+    config=CoordinatorConfig(num_rounds=3, seed=0, base_dir="runs/tutorial_dp"),
+    training=training,
+    central_privacy=PrivacyAwareAggregationConfig(
+        privacy=PrivacyConfig(epsilon=8.0, delta=1e-5,
+                              max_gradient_norm=1.0, noise_multiplier=0.7),
+    ),
+)
+dp_history = dp_coord.run()
+for m in dp_history:
+    print(f"round {m.round_id}: acc={m.agg_metrics['accuracy']:.4f} "
+          f"ε spent={m.agg_metrics['privacy_epsilon']:.3f} "
+          f"(δ={m.agg_metrics['privacy_delta']:.0e})")""",
+    # H (after MD 8)
+    """from nanofed_tpu.persistence import FileStateStore
+
+store = FileStateStore("runs/tutorial_ckpt")
+c1 = Coordinator(model=model, train_data=client_data,
+                 config=CoordinatorConfig(num_rounds=2, seed=0,
+                                          base_dir="runs/tutorial_ckpt"),
+                 training=training, state_store=store)
+c1.run()
+print("trained rounds 0-1; store has round", store.restore_latest().round_number)
+
+c2 = Coordinator(model=model, train_data=client_data,
+                 config=CoordinatorConfig(num_rounds=4, seed=0,
+                                          base_dir="runs/tutorial_ckpt"),
+                 training=training, state_store=FileStateStore("runs/tutorial_ckpt"))
+resumed = c2.run()
+print("resumed coordinator ran rounds:", [m.round_id for m in resumed])""",
+]
+
+
+def build() -> nbf.NotebookNode:
+    nb = nbf.v4.new_notebook()
+    nb.metadata["kernelspec"] = {"name": "python3", "display_name": "Python 3",
+                                 "language": "python"}
+    cells = [nbf.v4.new_markdown_cell(MD[0])]
+    pairs = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4), (6, 5), (7, 6), (8, 7)]
+    for md_i, code_i in pairs:
+        cells.append(nbf.v4.new_markdown_cell(MD[md_i]))
+        cells.append(nbf.v4.new_code_cell(CODE[code_i]))
+    cells.append(nbf.v4.new_markdown_cell(MD[9]))
+    nb.cells = cells
+    return nb
+
+
+def main() -> int:
+    out = REPO / "examples" / "mnist" / "tutorial.ipynb"
+    nb = build()
+    nbf.write(nb, out)
+    print(f"wrote {out} ({len(nb.cells)} cells); executing...")
+
+    from nbclient import NotebookClient
+
+    client = NotebookClient(nb, timeout=600, kernel_name="python3",
+                            resources={"metadata": {"path": str(REPO)}})
+    client.execute()
+    nbf.write(nb, out)
+    print("executed + saved with outputs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
