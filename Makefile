@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint bench example dryrun clean
+.PHONY: test test-fast lint bench example dryrun api-docs notebook accuracy clean
 
 test:
 	python -m pytest tests/ -q
@@ -19,6 +19,15 @@ example:
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+api-docs:
+	python scripts/gen_api_docs.py
+
+notebook:
+	python scripts/build_notebook.py
+
+accuracy:
+	python scripts/record_accuracy.py
 
 clean:
 	rm -rf runs/ .pytest_cache/ $$(find . -name __pycache__ -type d)

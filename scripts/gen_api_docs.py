@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Generate the API reference (``docs/api/*.md``) from the package's docstrings.
+
+The reference publishes a Sphinx API site via readthedocs; this repo keeps docs in
+markdown, so the reference pages are generated straight from ``inspect`` — every public
+module, class, function and dataclass with its signature and docstring.  Regenerate with
+``make api-docs`` (or ``python scripts/gen_api_docs.py``) after API changes; CI treats a
+dirty regeneration as a failure the same way formatters are treated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+MODULES = [
+    ("core", ["nanofed_tpu.core.types", "nanofed_tpu.core.interfaces",
+              "nanofed_tpu.core.exceptions"]),
+    ("data", ["nanofed_tpu.data.datasets", "nanofed_tpu.data.partition",
+              "nanofed_tpu.data.batching"]),
+    ("models", ["nanofed_tpu.models.base", "nanofed_tpu.models.linear",
+                "nanofed_tpu.models.mnist", "nanofed_tpu.models.resnet",
+                "nanofed_tpu.nn"]),
+    ("trainer", ["nanofed_tpu.trainer.config", "nanofed_tpu.trainer.local",
+                 "nanofed_tpu.trainer.private", "nanofed_tpu.trainer.callbacks",
+                 "nanofed_tpu.trainer.api"]),
+    ("aggregation", ["nanofed_tpu.aggregation.base", "nanofed_tpu.aggregation.fedavg",
+                     "nanofed_tpu.aggregation.privacy"]),
+    ("parallel", ["nanofed_tpu.parallel.mesh", "nanofed_tpu.parallel.round_step"]),
+    ("privacy", ["nanofed_tpu.privacy.config", "nanofed_tpu.privacy.noise",
+                 "nanofed_tpu.privacy.accounting", "nanofed_tpu.privacy.mechanisms"]),
+    ("security", ["nanofed_tpu.security.validation", "nanofed_tpu.security.signing",
+                  "nanofed_tpu.security.secure_agg"]),
+    ("persistence", ["nanofed_tpu.persistence.serialization",
+                     "nanofed_tpu.persistence.model_manager",
+                     "nanofed_tpu.persistence.state_store"]),
+    ("orchestration", ["nanofed_tpu.orchestration.types",
+                       "nanofed_tpu.orchestration.coordinator"]),
+    ("communication", ["nanofed_tpu.communication.codec",
+                       "nanofed_tpu.communication.http_server",
+                       "nanofed_tpu.communication.http_client",
+                       "nanofed_tpu.communication.network_coordinator"]),
+    ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.quantize"]),
+    ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.trees",
+               "nanofed_tpu.utils.platform", "nanofed_tpu.utils.dates"]),
+    ("top-level", ["nanofed_tpu.experiments", "nanofed_tpu.benchmarks",
+                   "nanofed_tpu.cli"]),
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj)
+    return d.strip() if d else "*(undocumented)*"
+
+
+def _is_public(name: str) -> bool:
+    return not name.startswith("_")
+
+
+def document_module(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    lines = [f"## `{modname}`", "", _doc(mod), ""]
+    members = []
+    for name, obj in vars(mod).items():
+        if not _is_public(name):
+            continue
+        if inspect.isclass(obj) or inspect.isfunction(obj):
+            if getattr(obj, "__module__", None) != modname:
+                continue  # re-exports documented at their home module
+            members.append((name, obj))
+    for name, obj in members:
+        if inspect.isclass(obj):
+            kind = "dataclass" if dataclasses.is_dataclass(obj) else "class"
+            lines += [f"### {kind} `{name}{_sig(obj)}`", "", _doc(obj), ""]
+            if dataclasses.is_dataclass(obj):
+                rows = [
+                    f"| `{f.name}` | `{getattr(f.type, '__name__', f.type)}` | "
+                    f"`{f.default if f.default is not dataclasses.MISSING else '—'}` |"
+                    for f in dataclasses.fields(obj)
+                ]
+                lines += ["| field | type | default |", "|---|---|---|", *rows, ""]
+            for mname, meth in vars(obj).items():
+                if not _is_public(mname):
+                    continue
+                func = meth.__func__ if isinstance(meth, (classmethod, staticmethod)) else meth
+                if inspect.isfunction(func) and inspect.getdoc(func):
+                    lines += [f"- **`{mname}{_sig(func)}`** — {_doc(func).splitlines()[0]}"]
+            lines += [""]
+        else:
+            lines += [f"### `{name}{_sig(obj)}`", "", _doc(obj), ""]
+    return "\n".join(lines)
+
+
+def main() -> int:
+    outdir = REPO / "docs" / "api"
+    outdir.mkdir(parents=True, exist_ok=True)
+    index = ["# API reference", "",
+             "Generated from docstrings by `scripts/gen_api_docs.py` — do not edit by",
+             "hand; run `make api-docs` after API changes.", ""]
+    for group, mods in MODULES:
+        fname = f"{group.replace('-', '_')}.md"
+        parts = [f"# `{group}` API", ""]
+        for m in mods:
+            parts.append(document_module(m))
+        (outdir / fname).write_text("\n".join(parts) + "\n")
+        index.append(f"- [{group}]({fname}): " + ", ".join(f"`{m}`" for m in mods))
+        print(f"  wrote docs/api/{fname}")
+    (outdir / "index.md").write_text("\n".join(index) + "\n")
+    print("wrote docs/api/index.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,3 +210,13 @@ class TestNoiseCalibration:
         s1 = noise_multiplier_for_budget(1.0, 1e-5, 0.01, 100)
         s2 = noise_multiplier_for_budget(5.0, 1e-5, 0.01, 100)
         assert s1 > s2
+
+
+def test_docs_worked_example_numbers():
+    """Pins the worked example in docs/concepts.md §12: 100 central-DP rounds at
+    sigma=1, q=1, delta=1e-5."""
+    g, r = GaussianAccountant(), RDPAccountant()
+    g.add_noise_event(1.0, 1.0, count=100)
+    r.add_noise_event(1.0, 1.0, count=100)
+    assert g.get_privacy_spent(1e-5).epsilon_spent == pytest.approx(571.7, abs=0.1)
+    assert r.get_privacy_spent(1e-5).epsilon_spent == pytest.approx(98.0, abs=0.1)
