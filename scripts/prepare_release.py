@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Prepare a release: bump the version everywhere, refresh the changelog, template the
+release notes, and sanity-check the tree.
+
+Capability parity with the reference's ``scripts/prepare_release.py`` (version bump +
+release-notes templating driven by the changelog), re-built for this repo's layout
+(pyproject.toml + ``nanofed_tpu.__version__`` + CHANGELOG.md + docs/releases/).
+
+Usage:
+    python scripts/prepare_release.py 0.2.0            # do it
+    python scripts/prepare_release.py 0.2.0 --dry-run  # show the plan only
+
+Then review, commit, and run ``scripts/release.sh`` to tag and push (the tag triggers
+``.github/workflows/release.yml``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+from datetime import date
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+VERSION_RE = re.compile(r"^\d+\.\d+\.\d+(?:[a-z]+\d*)?$")
+
+FILES = {
+    REPO / "pyproject.toml": re.compile(r'^(version = ")([^"]+)(")$', re.M),
+    REPO / "nanofed_tpu" / "__init__.py": re.compile(r'^(__version__ = ")([^"]+)(")$', re.M),
+}
+
+
+def current_version() -> str:
+    text = (REPO / "pyproject.toml").read_text()
+    m = FILES[REPO / "pyproject.toml"].search(text)
+    if not m:
+        raise SystemExit("could not find version in pyproject.toml")
+    return m.group(2)
+
+
+def bump(new: str, dry: bool) -> None:
+    for path, pattern in FILES.items():
+        text = path.read_text()
+        updated, n = pattern.subn(rf"\g<1>{new}\g<3>", text)
+        if n != 1:
+            raise SystemExit(f"{path}: expected exactly one version line, found {n}")
+        print(f"  {path.relative_to(REPO)}: -> {new}")
+        if not dry:
+            path.write_text(updated)
+
+
+def changelog_section(new: str) -> str:
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "changelog.py"), new],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    if out.returncode != 0:
+        print(f"  changelog generation failed: {out.stderr.strip()}", file=sys.stderr)
+        return f"## {new} ({date.today().isoformat()})\n\n_(no conventional commits found)_\n"
+    return out.stdout
+
+
+def update_changelog(section: str, dry: bool) -> None:
+    path = REPO / "CHANGELOG.md"
+    existing = path.read_text() if path.exists() else "# Changelog\n\n"
+    head, _, tail = existing.partition("\n## ")
+    body = head.rstrip() + "\n\n" + section.rstrip() + "\n"
+    if tail:
+        body += "\n## " + tail
+    print(f"  CHANGELOG.md: prepended {len(section.splitlines())} lines")
+    if not dry:
+        path.write_text(body)
+
+
+def release_notes(new: str, section: str, dry: bool) -> None:
+    notes_dir = REPO / "docs" / "releases"
+    notes = (
+        f"# nanofed-tpu {new}\n\nReleased {date.today().isoformat()}.\n\n"
+        + section
+        + "\n## Install\n\n```bash\npip install nanofed-tpu=="
+        + new
+        + "\n```\n"
+    )
+    print(f"  docs/releases/{new}.md: templated")
+    if not dry:
+        notes_dir.mkdir(parents=True, exist_ok=True)
+        (notes_dir / f"{new}.md").write_text(notes)
+
+
+def sanity_checks() -> None:
+    dirty = subprocess.run(["git", "status", "--porcelain"], capture_output=True,
+                           text=True, cwd=REPO).stdout.strip()
+    if dirty:
+        print("  WARNING: working tree is dirty — release commits should be clean")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("version", help="new semantic version, e.g. 0.2.0")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    if not VERSION_RE.match(args.version):
+        raise SystemExit(f"not a semantic version: {args.version!r}")
+
+    old = current_version()
+    print(f"prepare release {old} -> {args.version}" + (" (dry run)" if args.dry_run else ""))
+    sanity_checks()
+    bump(args.version, args.dry_run)
+    section = changelog_section(args.version)
+    update_changelog(section, args.dry_run)
+    release_notes(args.version, section, args.dry_run)
+    print("done. review, commit, then: scripts/release.sh")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

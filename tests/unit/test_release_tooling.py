@@ -1,0 +1,41 @@
+"""Release tooling: prepare_release dry-run safety + changelog generation
+(parity: the reference ships prepare_release.py + changelog.py + release.sh)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_prepare_release_dry_run_changes_nothing():
+    before = {
+        p: p.read_text()
+        for p in (REPO / "pyproject.toml", REPO / "nanofed_tpu" / "__init__.py")
+    }
+    out = subprocess.run(
+        [sys.executable, "scripts/prepare_release.py", "9.9.9", "--dry-run"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "-> 9.9.9" in out.stdout
+    for p, text in before.items():
+        assert p.read_text() == text, f"{p} modified by --dry-run"
+    assert not (REPO / "docs" / "releases" / "9.9.9.md").exists()
+
+
+def test_prepare_release_rejects_bad_version():
+    out = subprocess.run(
+        [sys.executable, "scripts/prepare_release.py", "not-a-version"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode != 0
+
+
+def test_changelog_generates_markdown():
+    out = subprocess.run(
+        [sys.executable, "scripts/changelog.py", "9.9.9", "--since", "HEAD~3"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.startswith("## 9.9.9")
