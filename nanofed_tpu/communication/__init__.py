@@ -15,6 +15,7 @@ _NET_EXPORTS = {
     "NetworkCoordinator": "network_coordinator",
     "NetworkRoundConfig": "network_coordinator",
     "stack_model_updates": "network_coordinator",
+    "SecAggRoster": "http_client",
 }
 
 
@@ -33,6 +34,7 @@ __all__ = [
     "HTTPServer",
     "NetworkCoordinator",
     "NetworkRoundConfig",
+    "SecAggRoster",
     "ServerEndpoints",
     "decode_params",
     "encode_params",

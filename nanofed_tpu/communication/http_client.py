@@ -19,6 +19,7 @@ from nanofed_tpu.communication.http_server import (
     HEADER_CLIENT,
     HEADER_METRICS,
     HEADER_ROUND,
+    HEADER_SECAGG,
     HEADER_SIGNATURE,
     HEADER_STATUS,
 )
@@ -29,11 +30,31 @@ from nanofed_tpu.utils.logger import Logger
 
 @dataclass(frozen=True)
 class ClientEndpoints:
-    """Parity: ``ClientEndpoints`` (``client.py:24-30``)."""
+    """Parity: ``ClientEndpoints`` (``client.py:24-30``) + secure-aggregation routes."""
 
     model: str = "/model"
     update: str = "/update"
     status: str = "/status"
+    secagg_register: str = "/secagg/register"
+    secagg_roster: str = "/secagg/roster"
+
+
+@dataclass(frozen=True)
+class SecAggRoster:
+    """The completed cohort roster a client needs to mask its update: canonical client
+    order (mask sign convention), everyone's X25519 public key, and this framework's
+    twist — server-computed NORMALIZED FedAvg weights, so the masked modular sum IS the
+    weighted mean and no per-client weight ever reaches the server next to a payload."""
+
+    client_order: list[str]
+    public_keys: dict[str, bytes]
+    weights: dict[str, float]
+
+    def index_of(self, client_id: str) -> int:
+        return self.client_order.index(client_id)
+
+    def ordered_keys(self) -> list[bytes]:
+        return [self.public_keys[c] for c in self.client_order]
 
 
 class HTTPClient:
@@ -129,6 +150,94 @@ class HTTPClient:
                 except Exception:
                     message = (await resp.text())[:200]
                 self._log.warning("update rejected (HTTP %d): %s", resp.status, message)
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    # Secure aggregation (Bonawitz pairwise masking over the wire)
+    # ------------------------------------------------------------------
+
+    async def register_secagg(self, public_key: bytes, num_samples: float) -> bool:
+        """Enroll in the secure-aggregation cohort with this client's X25519 public key
+        and its FedAvg sample count."""
+        import base64
+
+        session = self._require_session()
+        url = self.server_url + self.endpoints.secagg_register
+        async with session.post(
+            url,
+            json={"public_key": base64.b64encode(public_key).decode(),
+                  "num_samples": num_samples},
+            headers={HEADER_CLIENT: self.client_id},
+        ) as resp:
+            if resp.status != 200:
+                self._log.warning("secagg registration rejected (HTTP %d)", resp.status)
+                return False
+        return True
+
+    async def fetch_secagg_roster(
+        self, poll_interval_s: float = 0.05, timeout_s: float = 30.0
+    ) -> SecAggRoster:
+        """Poll the roster endpoint until the cohort is complete."""
+        import base64
+
+        session = self._require_session()
+        url = self.server_url + self.endpoints.secagg_roster
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while True:
+            async with session.get(url) as resp:
+                if resp.status != 200:
+                    raise NanoFedError(f"fetch_secagg_roster: HTTP {resp.status}")
+                payload = await resp.json()
+            if payload.get("complete"):
+                return SecAggRoster(
+                    client_order=list(payload["client_order"]),
+                    public_keys={c: base64.b64decode(k)
+                                 for c, k in payload["public_keys"].items()},
+                    weights={c: float(w) for c, w in payload["weights"].items()},
+                )
+            if asyncio.get_event_loop().time() > deadline:
+                raise NanoFedError(
+                    f"secagg roster incomplete after {timeout_s}s "
+                    f"({payload.get('enrolled')}/{payload.get('expected')})"
+                )
+            await asyncio.sleep(poll_interval_s)
+
+    async def submit_masked_update(
+        self, masked: Any, metrics: dict[str, Any]
+    ) -> bool:
+        """POST a pairwise-masked uint32 vector (see ``security.secure_agg.mask_update``)
+        for the current round.  The server can only ever recover the cohort SUM."""
+        import io
+
+        import numpy as np
+
+        session = self._require_session()
+        buf = io.BytesIO()
+        np.savez_compressed(buf, masked=np.asarray(masked, np.uint32))
+        body = buf.getvalue()
+        headers = {
+            HEADER_CLIENT: self.client_id,
+            HEADER_ROUND: str(self.current_round),
+            HEADER_METRICS: json.dumps(metrics),
+            HEADER_SECAGG: "masked",
+        }
+        if self.security_manager is not None:
+            import base64
+
+            signature = self.security_manager.sign_masked_update(
+                body, self.client_id, self.current_round, headers[HEADER_METRICS]
+            )
+            headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
+        url = self.server_url + self.endpoints.update
+        async with session.post(url, data=body, headers=headers) as resp:
+            if resp.status != 200:
+                try:
+                    message = (await resp.json()).get("message")
+                except Exception:
+                    message = (await resp.text())[:200]
+                self._log.warning("masked update rejected (HTTP %d): %s",
+                                  resp.status, message)
                 return False
         return True
 

@@ -23,6 +23,7 @@ import json
 from dataclasses import dataclass
 from typing import Any
 
+import jax
 from aiohttp import web
 
 from nanofed_tpu.communication.codec import decode_params, encode_params
@@ -38,16 +39,20 @@ HEADER_ROUND = "X-NanoFed-Round"
 HEADER_METRICS = "X-NanoFed-Metrics"
 HEADER_STATUS = "X-NanoFed-Status"
 HEADER_SIGNATURE = "X-NanoFed-Signature"  # base64 RSA-PSS signature of the npz params
+HEADER_SECAGG = "X-NanoFed-SecAgg"  # "masked" flags a pairwise-masked uint32 payload
 
 
 @dataclass(frozen=True)
 class ServerEndpoints:
-    """Parity: ``ServerEndpoints`` (``server.py:29-35``)."""
+    """Parity: ``ServerEndpoints`` (``server.py:29-35``), plus the secure-aggregation
+    roster endpoints (no reference equivalent — its SecAgg never touches the wire)."""
 
     model: str = "/model"
     update: str = "/update"
     status: str = "/status"
     test: str = "/test"
+    secagg_register: str = "/secagg/register"
+    secagg_roster: str = "/secagg/roster"
 
 
 class HTTPServer:
@@ -79,11 +84,19 @@ class HTTPServer:
         self._params_bytes: bytes | None = None
         self._round = 0
         self._training_active = True
+        # Secure-aggregation state: a roster of (X25519 public key, sample count) per
+        # client, opened by the round engine, and a separate buffer for masked payloads
+        # (they are uniform uint32 vectors, not decodable params).
+        self._secagg_expected: int | None = None
+        self._secagg_roster: dict[str, dict[str, Any]] = {}
+        self._masked_updates: dict[str, tuple[Any, dict[str, Any]]] = {}
         self._app = web.Application(client_max_size=max_request_size)
         self._app.router.add_get(self.endpoints.model, self._handle_get_model)
         self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
         self._app.router.add_get(self.endpoints.status, self._handle_status)
         self._app.router.add_get(self.endpoints.test, self._handle_test)
+        self._app.router.add_post(self.endpoints.secagg_register, self._handle_secagg_register)
+        self._app.router.add_get(self.endpoints.secagg_roster, self._handle_secagg_roster)
         self._runner: web.AppRunner | None = None
 
     # ------------------------------------------------------------------
@@ -98,6 +111,10 @@ class HTTPServer:
             self._params_bytes = payload
             self._round = round_number
             self._updates.clear()
+            # A straggler's masked vector from a FAILED secure round must never leak
+            # into the next round: its masks are bound to the OLD round number and
+            # would not cancel (unmask_sum would silently produce garbage).
+            self._masked_updates.clear()
 
     def num_updates(self) -> int:
         # Lock-free read is safe: len() is atomic under the GIL and all mutation happens
@@ -114,6 +131,41 @@ class HTTPServer:
     def stop_training(self) -> None:
         """Signal clients to stop polling (parity: ``server.py:313-317``)."""
         self._training_active = False
+
+    # ------------------------------------------------------------------
+    # Secure-aggregation round-engine API
+    # ------------------------------------------------------------------
+
+    def open_secagg(self, expected_clients: int) -> None:
+        """Open secure-aggregation enrollment for a cohort of exactly
+        ``expected_clients``.  Clients register their X25519 public key + sample count
+        via POST ``/secagg/register``; the roster endpoint reports ``complete`` once all
+        have.  The cohort is fixed for the whole training run (masks are re-derived per
+        round from the round number, so one enrollment covers every round)."""
+        self._secagg_expected = int(expected_clients)
+        self._secagg_roster.clear()
+        self._masked_updates.clear()
+
+    def secagg_roster_complete(self) -> bool:
+        return (
+            self._secagg_expected is not None
+            and len(self._secagg_roster) >= self._secagg_expected
+        )
+
+    def secagg_client_order(self) -> list[str]:
+        """Canonical cohort ordering (sorted ids) — mask sign convention depends on
+        every party agreeing on it."""
+        return sorted(self._secagg_roster)
+
+    def num_masked_updates(self) -> int:
+        return len(self._masked_updates)
+
+    async def drain_masked_updates(self) -> dict[str, Any]:
+        """Atomically take the buffered masked vectors (client_id -> uint32 array)."""
+        async with self._lock:
+            taken = {cid: vec for cid, (vec, _) in self._masked_updates.items()}
+            self._masked_updates.clear()
+        return taken
 
     @property
     def current_round(self) -> int:
@@ -174,6 +226,8 @@ class HTTPServer:
                 },
                 status=400,
             )
+        if request.headers.get(HEADER_SECAGG) == "masked":
+            return await self._handle_masked_update(request, client_id, round_number, metrics)
         body = await request.read()
         try:
             # Offload the CPU-bound decode (up to 100 MB decompress + structure checks)
@@ -251,6 +305,154 @@ class HTTPServer:
                 {"status": "error", "message": "invalid signature"}, status=403
             )
         return None
+
+    async def _handle_secagg_register(self, request: web.Request) -> web.StreamResponse:
+        """Enroll one client in the secure-aggregation cohort: X25519 public key (for
+        pairwise mask agreement) + sample count (for server-computed FedAvg weights)."""
+        import base64
+
+        client_id = request.headers.get(HEADER_CLIENT)
+        if not client_id:
+            return web.json_response(
+                {"status": "error", "message": "missing client header"}, status=400
+            )
+        if self._secagg_expected is None:
+            return web.json_response(
+                {"status": "error", "message": "secure aggregation not open"}, status=403
+            )
+        try:
+            body = await request.json()
+            public_key = base64.b64decode(body["public_key"])
+            num_samples = float(body["num_samples"])
+            if len(public_key) != 32 or not (num_samples > 0):
+                raise ValueError("bad key length or non-positive sample count")
+        except Exception as e:
+            return web.json_response(
+                {"status": "error", "message": f"bad registration: {e}"}, status=400
+            )
+        async with self._lock:
+            if (
+                client_id not in self._secagg_roster
+                and len(self._secagg_roster) >= self._secagg_expected
+            ):
+                return web.json_response(
+                    {"status": "error", "message": "cohort is full"}, status=403
+                )
+            self._secagg_roster[client_id] = {
+                "public_key": public_key, "num_samples": num_samples
+            }
+        self._log.info("secagg enrollment: %s (%d/%d)", client_id,
+                       len(self._secagg_roster), self._secagg_expected)
+        return web.json_response({"status": "success", "message": "enrolled"})
+
+    async def _handle_secagg_roster(self, request: web.Request) -> web.StreamResponse:
+        """The cohort roster every client needs before masking: canonical client order,
+        all public keys, and each client's NORMALIZED FedAvg weight.  Clients pre-scale
+        their update by their weight so the masked modular sum IS the weighted mean —
+        the server never needs (and never sees) any individual update."""
+        import base64
+
+        if self._secagg_expected is None:
+            return web.json_response(
+                {"status": "error", "message": "secure aggregation not open"}, status=403
+            )
+        complete = self.secagg_roster_complete()
+        payload: dict[str, Any] = {
+            "status": "success",
+            "complete": complete,
+            "expected": self._secagg_expected,
+            "enrolled": len(self._secagg_roster),
+        }
+        if complete:
+            order = self.secagg_client_order()
+            total = sum(self._secagg_roster[c]["num_samples"] for c in order)
+            payload.update(
+                client_order=order,
+                public_keys={
+                    c: base64.b64encode(self._secagg_roster[c]["public_key"]).decode()
+                    for c in order
+                },
+                weights={
+                    c: self._secagg_roster[c]["num_samples"] / total for c in order
+                },
+            )
+        return web.json_response(payload)
+
+    async def _handle_masked_update(
+        self, request: web.Request, client_id: str, round_number: int,
+        metrics: dict[str, Any],
+    ) -> web.StreamResponse:
+        """Buffer a pairwise-masked uint32 vector (flagged via ``HEADER_SECAGG``).
+
+        Masked payloads are indistinguishable from uniform noise, so the only possible
+        content validation is structural: enrollment, dtype, and exact length (= total
+        param count of the published model).  AUTHENTICITY is still enforced: with
+        ``require_signatures=True`` the masked body must carry a valid RSA-PSS
+        signature over the verbatim bytes + wire context, same policy as the plain
+        path (an unsigned forged vector would otherwise corrupt the unmasked sum)."""
+        import io
+
+        import numpy as np
+
+        if client_id not in self._secagg_roster:
+            return web.json_response(
+                {"status": "error", "message": f"{client_id!r} not enrolled"}, status=403
+            )
+        body = await request.read()
+        if self.require_signatures:
+            import base64
+
+            from nanofed_tpu.security.signing import verify_masked_signature
+
+            pem = self.client_keys.get(client_id)
+            if pem is None:
+                return web.json_response(
+                    {"status": "error", "message": f"unknown client {client_id!r}"},
+                    status=403,
+                )
+            try:
+                signature = base64.b64decode(request.headers.get(HEADER_SIGNATURE, ""))
+            except Exception:
+                signature = b""
+            metrics_json = request.headers.get(HEADER_METRICS, "{}")
+            ok = signature and await asyncio.to_thread(
+                verify_masked_signature, body, client_id, round_number, metrics_json,
+                signature, pem,
+            )
+            if not ok:
+                self._log.warning("invalid masked-update signature from %s", client_id)
+                return web.json_response(
+                    {"status": "error", "message": "invalid signature"}, status=403
+                )
+        try:
+            with np.load(io.BytesIO(body)) as z:
+                masked = z["masked"]
+            expected_size = int(
+                sum(np.prod(leaf.shape) for leaf in jax.tree.leaves(self._params))
+            )
+            if masked.dtype != np.uint32 or masked.shape != (expected_size,):
+                raise ValueError(
+                    f"expected uint32[{expected_size}], got {masked.dtype}{masked.shape}"
+                )
+        except Exception as e:
+            return web.json_response(
+                {"status": "error", "message": f"bad masked payload: {e}"}, status=400
+            )
+        async with self._lock:
+            if round_number != self._round:
+                return web.json_response(
+                    {"status": "error",
+                     "message": f"update for round {round_number}, server is on {self._round}"},
+                    status=400,
+                )
+            self._masked_updates[client_id] = (masked, metrics)
+            accepted = len(self._masked_updates)
+        self._log.info("masked update from %s (round %d, %d buffered)", client_id,
+                       round_number, accepted)
+        return web.json_response(
+            {"status": "success", "message": "masked update accepted",
+             "update_id": client_id}
+        )
 
     async def _handle_status(self, request: web.Request) -> web.StreamResponse:
         return web.json_response(

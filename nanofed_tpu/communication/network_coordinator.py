@@ -21,10 +21,21 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from nanofed_tpu.aggregation.fedavg import fedavg_combine
 from nanofed_tpu.communication.http_server import HTTPServer
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate, Params
+from nanofed_tpu.security.secure_agg import SecureAggregationConfig, unmask_sum
+from nanofed_tpu.security.validation import (
+    ValidationConfig,
+    ValidationResult,
+    loo_zscore,
+    reference_shapes,
+    update_flat_norm,
+    validate_range,
+    validate_shape,
+)
 from nanofed_tpu.utils.logger import Logger
 
 
@@ -81,12 +92,39 @@ def stack_model_updates(updates: list[ModelUpdate]) -> ClientUpdates:
 
 
 class NetworkCoordinator:
-    """Drives federated rounds over an ``HTTPServer``."""
+    """Drives federated rounds over an ``HTTPServer``.
 
-    def __init__(self, server: HTTPServer, params: Params, config: NetworkRoundConfig):
+    ``validation`` enables the host-path update checks on every drained update —
+    shape, finiteness/norm range, and cohort z-score anomaly detection (parity:
+    ``nanofed/server/validation.py:53-135``, which the reference implements but never
+    calls from its round loop).  Invalid clients are dropped from the round with a
+    logged reason; a NaN or oversized networked update cannot reach the aggregate.
+
+    ``secure`` switches the round to honest Bonawitz secure aggregation: clients
+    enroll (X25519 keys + sample counts) via ``/secagg/register``, pre-scale their
+    update by the server-published normalized weight, mask with pairwise PRG streams,
+    and the coordinator modular-sums + dequantizes — it only ever observes uniformly
+    masked vectors and the cohort's weighted mean.  This is the single-round
+    no-dropout SecAgg variant: every enrolled client must report or the round FAILS
+    (a missing client's pairwise masks would not cancel).  Per-update validation is
+    impossible by construction in this mode — masked vectors are indistinguishable
+    from noise; range enforcement must come from quantization bounds and DP clipping
+    client-side.
+    """
+
+    def __init__(
+        self,
+        server: HTTPServer,
+        params: Params,
+        config: NetworkRoundConfig,
+        validation: ValidationConfig | None = None,
+        secure: SecureAggregationConfig | None = None,
+    ):
         self.server = server
         self.params = params
         self.config = config
+        self.validation = validation
+        self.secure = secure
         self.history: list[dict[str, Any]] = []
         self._log = Logger()
 
@@ -100,16 +138,96 @@ class NetworkCoordinator:
             await asyncio.sleep(self.config.poll_interval_s)
         return self.server.num_updates() >= required
 
+    def _validate_updates(self, updates: list[ModelUpdate]) -> list[ModelUpdate]:
+        """Drop invalid updates (wrong shape / non-finite / norm cap / cohort anomaly)
+        before they can touch the aggregate; each rejection is logged with its reason."""
+        shapes = reference_shapes(self.params)
+        survivors = []
+        for u in updates:
+            verdict = validate_shape(u, shapes)
+            if verdict is ValidationResult.VALID:
+                verdict = validate_range(u, self.validation)
+            if verdict is not ValidationResult.VALID:
+                self._log.warning("rejecting update from %s: %s", u.client_id, verdict.name)
+                continue
+            survivors.append(u)
+        # Cohort anomaly detection over the range-valid survivors only (a NaN norm
+        # would poison the z-scores).  Same leave-one-out math as the in-mesh path
+        # (each norm computed ONCE — not the O(n^2) pairwise re-derivation the enum
+        # API would imply); loo_zscore itself gates on min_clients_for_stats.
+        if len(survivors) > 1:
+            norms = jnp.asarray([update_flat_norm(u) for u in survivors])
+            _, anomalous = loo_zscore(
+                norms,
+                jnp.ones_like(norms),
+                self.validation.z_score_threshold,
+                float(self.validation.min_clients_for_stats),
+            )
+            kept = []
+            for u, bad in zip(survivors, np.asarray(anomalous)):
+                if bad:
+                    self._log.warning("rejecting update from %s: ANOMALOUS", u.client_id)
+                else:
+                    kept.append(u)
+            survivors = kept
+        return survivors
+
+    async def _secure_round(self, round_number: int, required: int) -> dict[str, Any]:
+        """One masked round: wait for the FULL cohort, modular-sum, unmask."""
+        cohort = self.server.secagg_client_order()
+        expected = len(cohort)
+        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        while (
+            self.server.num_masked_updates() < expected
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(self.config.poll_interval_s)
+        masked = await self.server.drain_masked_updates()
+        if len(masked) < expected or expected < required:
+            # Any missing cohort member leaves uncancelled pairwise masks in the sum.
+            self._log.warning(
+                "secure round %d FAILED: %d/%d masked updates",
+                round_number, len(masked), expected,
+            )
+            record = {"round": round_number, "status": "FAILED",
+                      "num_clients": len(masked), "secure": True}
+            self.history.append(record)
+            return record
+        # Clients pre-scaled by their published normalized weight, so the masked
+        # modular sum IS the weighted mean once the pairwise masks cancel.
+        self.params = unmask_sum(
+            [masked[c] for c in cohort], self.params, self.secure
+        )
+        record = {
+            "round": round_number,
+            "status": "COMPLETED",
+            "num_clients": len(masked),
+            "secure": True,
+        }
+        self.history.append(record)
+        self._log.info("secure round %d: aggregated %d masked updates",
+                       round_number, len(masked))
+        return record
+
     async def train_round(self, round_number: int) -> dict[str, Any]:
         await self.server.publish_model(self.params, round_number)
         required = max(1, math.ceil(self.config.min_clients * self.config.min_completion_rate))
+        if self.secure is not None:
+            return await self._secure_round(round_number, required)
         ok = await self._wait_for_clients(required)
         updates = await self.server.drain_updates()
+        num_received = len(updates)
+        num_rejected = 0
+        if self.validation is not None and updates:
+            updates = self._validate_updates(updates)
+            num_rejected = num_received - len(updates)
         if not ok or len(updates) < required:
             self._log.warning(
-                "round %d FAILED: %d/%d updates", round_number, len(updates), required
+                "round %d FAILED: %d/%d updates (%d rejected)",
+                round_number, len(updates), required, num_rejected,
             )
-            record = {"round": round_number, "status": "FAILED", "num_clients": len(updates)}
+            record = {"round": round_number, "status": "FAILED",
+                      "num_clients": len(updates), "num_rejected": num_rejected}
             self.history.append(record)
             return record
         stacked = stack_model_updates(updates)
@@ -118,6 +236,7 @@ class NetworkCoordinator:
             "round": round_number,
             "status": "COMPLETED",
             "num_clients": len(updates),
+            "num_rejected": num_rejected,
             "metrics": {
                 "loss": float((stacked.metrics.loss * stacked.weights).sum()
                               / stacked.weights.sum()),
@@ -130,7 +249,24 @@ class NetworkCoordinator:
         return record
 
     async def run(self) -> list[dict[str, Any]]:
-        """All rounds, then signal termination to polling clients."""
+        """All rounds, then signal termination to polling clients.
+
+        In secure mode, opens secure-aggregation enrollment for ``min_clients`` and
+        waits for the cohort to complete before round 0.
+        """
+        if self.secure is not None:
+            self.server.open_secagg(self.config.min_clients)
+            deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+            while (
+                not self.server.secagg_roster_complete()
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(self.config.poll_interval_s)
+            if not self.server.secagg_roster_complete():
+                self.server.stop_training()
+                raise TimeoutError(
+                    "secure-aggregation cohort incomplete before round 0"
+                )
         for r in range(self.config.num_rounds):
             await self.train_round(r)
         self.server.stop_training()

@@ -94,6 +94,37 @@ def verify_update_signature(
     return _verify_bytes(data, signature, public_key)
 
 
+def masked_signing_bytes(
+    body: bytes, client_id: str, round_number: int, metrics_json: str
+) -> bytes:
+    """Byte string a MASKED (secure-aggregation) update signature covers.
+
+    A masked payload is an opaque uint32 vector — there is no params pytree to
+    canonicalize, so the signature binds the verbatim wire body plus the same
+    replay-protection context as :func:`update_signing_bytes`.  Without this, a server
+    enforcing signatures on the plain path would accept any forged masked vector from
+    anyone who knows an enrolled client id.
+    """
+    context = f"client={client_id}&round={round_number}&metrics={metrics_json}&masked="
+    return context.encode() + body
+
+
+def verify_masked_signature(
+    body: bytes,
+    client_id: str,
+    round_number: int,
+    metrics_json: str,
+    signature: bytes,
+    public_key: bytes,
+) -> bool:
+    """Verify a masked update's signature (see :func:`masked_signing_bytes`)."""
+    return _verify_bytes(
+        masked_signing_bytes(body, client_id, round_number, metrics_json),
+        signature,
+        public_key,
+    )
+
+
 class SecurityManager:
     """Holds this party's RSA keypair; signs outgoing and verifies incoming updates.
 
@@ -122,6 +153,14 @@ class SecurityManager:
         """Sign a federated update with its replay-protection context
         (see :func:`update_signing_bytes`)."""
         data = update_signing_bytes(params, client_id, round_number, metrics_json)
+        return self._private_key.sign(data, _PSS, hashes.SHA256())
+
+    def sign_masked_update(
+        self, body: bytes, client_id: str, round_number: int, metrics_json: str
+    ) -> bytes:
+        """Sign a masked (secure-aggregation) update body with its replay-protection
+        context (see :func:`masked_signing_bytes`)."""
+        data = masked_signing_bytes(body, client_id, round_number, metrics_json)
         return self._private_key.sign(data, _PSS, hashes.SHA256())
 
     def verify_signature(self, params: Params, signature: bytes, public_key: bytes) -> bool:

@@ -239,7 +239,9 @@ def validate_range(update: ModelUpdate, config: ValidationConfig) -> ValidationR
     return ValidationResult.VALID
 
 
-def _flat_norm(update: ModelUpdate) -> float:
+def update_flat_norm(update: ModelUpdate) -> float:
+    """Global L2 norm of one update's full parameter vector (the statistic the cohort
+    z-score runs on; compute once per update — it touches every leaf)."""
     vecs = [leaf.astype(np.float64).ravel() for _, leaf in _update_named_leaves(update)]
     return float(np.linalg.norm(np.concatenate(vecs)))
 
@@ -253,8 +255,8 @@ def validate_statistics(
     norm against the cohort's norms; VALID when the cohort is too small."""
     if len(reference_updates) < config.min_clients_for_stats:
         return ValidationResult.VALID
-    norms = np.array([_flat_norm(u) for u in reference_updates])
-    z = abs(_flat_norm(update) - norms.mean()) / (norms.std(ddof=1) + 1e-8)
+    norms = np.array([update_flat_norm(u) for u in reference_updates])
+    z = abs(update_flat_norm(update) - norms.mean()) / (norms.std(ddof=1) + 1e-8)
     if z > config.z_score_threshold:
         return ValidationResult.ANOMALOUS
     return ValidationResult.VALID
