@@ -171,6 +171,25 @@ def load_digits_dataset(split: str = "train", test_fraction: float = 0.2) -> Dat
     return Dataset(x=x, y=y, num_classes=10, name="digits")
 
 
+def resize_images(ds: Dataset, height: int, width: int) -> Dataset:
+    """Bilinearly resize an image dataset (``x`` [N, H, W, C]) to ``height x width``.
+
+    The real-data bridge for zero-egress environments: the bundled 8x8 digits upsampled
+    to 28x28 let the flagship MNIST CNN (``nanofed/models/mnist.py:6-28`` parity
+    architecture, fixed 28x28 input) train and be evaluated on REAL images when the
+    MNIST IDX files cannot be fetched.  Resizing is a deterministic host-side transform;
+    labels are untouched, so generalization claims remain about real data.
+    """
+    from scipy.ndimage import zoom
+
+    n, h, w, c = ds.x.shape
+    x = zoom(ds.x, (1, height / h, width / w, 1), order=1).astype(np.float32)
+    assert x.shape == (n, height, width, c)
+    return Dataset(
+        x=x, y=ds.y, num_classes=ds.num_classes, name=f"{ds.name}@{height}x{width}"
+    )
+
+
 # ---------------------------------------------------------------------------
 # CIFAR (python pickle format)
 # ---------------------------------------------------------------------------

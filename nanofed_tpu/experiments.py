@@ -109,7 +109,14 @@ def run_experiment(
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()
     completed = [r for r in rounds if r.status == RoundStatus.COMPLETED]
+    spent = coordinator.privacy_spent
+    privacy_summary = (
+        {"epsilon_spent": spent.epsilon_spent, "delta_spent": spent.delta_spent}
+        if spent is not None
+        else None
+    )
     return {
+        **({"privacy_spent": privacy_summary} if privacy_summary else {}),
         "model": model,
         "num_clients": num_clients,
         "rounds_completed": len(completed),

@@ -100,6 +100,7 @@ class Coordinator:
         grad_fn: GradFn | None = None,
         validation=None,
         central_privacy=None,
+        accountant=None,
         local_fit: Callable | None = None,
         client_chunk: int | None = None,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
@@ -117,12 +118,20 @@ class Coordinator:
         # Central DP is applied inside the round step; the coordinator owns the matching
         # accountant so the configured (ε, δ) budget is actually tracked and reported
         # (the noise itself would otherwise be spent but never accounted anywhere).
+        # RDP by default — the tight composition; pass ``accountant=`` to override
+        # (e.g. GaussianAccountant for the loose-but-simple linear bound).
         self.central_privacy = central_privacy
-        self.privacy_accountant = None
-        if central_privacy is not None:
-            from nanofed_tpu.privacy.accounting import GaussianAccountant
+        if accountant is not None and central_privacy is None:
+            raise ValueError(
+                "accountant= given without central_privacy=: the coordinator only "
+                "records spend for its own central-DP reduce (for DP-SGD clients, "
+                "account via the trainer — see trainer.private)"
+            )
+        self.privacy_accountant = accountant
+        if central_privacy is not None and accountant is None:
+            from nanofed_tpu.privacy.accounting import RDPAccountant
 
-            self.privacy_accountant = GaussianAccountant()
+            self.privacy_accountant = RDPAccountant()
 
         self.num_clients = int(train_data.x.shape[0])
         n_dev = len(self.mesh.devices.flat)

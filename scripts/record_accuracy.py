@@ -34,10 +34,15 @@ TARGET_ACC = 0.97
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data-dir", default=None, help="MNIST IDX dir (else bundled digits)")
-    ap.add_argument("--round-tag", default="r02")
+    ap.add_argument("--round-tag", default="r03")
     ap.add_argument("--platform", choices=["auto", "cpu"], default="auto")
     ap.add_argument("--max-rounds", type=int, default=60)
     ap.add_argument("--n-devices", type=int, default=8)
+    ap.add_argument(
+        "--model", choices=["mlp", "cnn"], default="cnn",
+        help="evidence model when MNIST is unavailable: digits_mlp on native 8x8, or "
+        "the flagship MNIST CNN on the real digits bilinearly upsampled to 28x28",
+    )
     args = ap.parse_args()
 
     from nanofed_tpu.utils.platform import (
@@ -74,6 +79,18 @@ def main() -> int:
         test = load_mnist("test", args.data_dir, synthetic_fallback=False)
         training = TrainingConfig(batch_size=64, local_epochs=2, learning_rate=0.1)
         num_clients, batch_eval = 10, 256
+    elif args.model == "cnn":
+        # Flagship-model evidence without MNIST: the REAL digits images upsampled to
+        # 28x28 so the parity CNN architecture itself (not a stand-in MLP) is what
+        # crosses the 97% bar on real data.
+        from nanofed_tpu.data.datasets import resize_images
+
+        dataset, model_name = "digits_cnn28", "mnist_cnn"
+        model = get_model(model_name)
+        train = resize_images(load_digits_dataset("train"), 28, 28)
+        test = resize_images(load_digits_dataset("test"), 28, 28)
+        training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.1)
+        num_clients, batch_eval = 8, 128
     else:
         dataset, model_name = "digits", "digits_mlp"
         model = get_model(model_name, hidden=96)
@@ -82,7 +99,7 @@ def main() -> int:
         training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
         num_clients, batch_eval = 8, 128
 
-    log_stage(f"dataset={dataset}: {len(train)} train / {len(test)} test (REAL data)")
+    log_stage(f"dataset={train.name}: {len(train)} train / {len(test)} test (REAL data)")
     cd = federate(train, num_clients=num_clients, scheme="iid",
                   batch_size=training.batch_size, seed=0)
     coord = Coordinator(
@@ -110,8 +127,16 @@ def main() -> int:
 
     artifact = {
         "artifact": f"accuracy_{dataset}_{args.round_tag}",
-        "dataset": dataset,
+        "dataset": train.name,
         "real_data": True,
+        "data_note": (
+            "sklearn digits: 1,797 REAL handwritten-digit images (UCI optdigits), "
+            "bilinearly upsampled 8x8 -> 28x28 so the flagship MNIST-CNN architecture "
+            "is the model under test; MNIST itself is unfetchable here (see "
+            "runs/mnist_fetch_attempt_*.log for the documented zero-egress attempt)"
+            if dataset == "digits_cnn28"
+            else "sklearn digits: 1,797 REAL handwritten-digit images (UCI optdigits)"
+        ) if dataset != "mnist" else "MNIST IDX files",
         "model": model_name,
         "num_clients": num_clients,
         "scheme": "iid",

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Record benchmark-evidence artifacts beyond the headline bench (VERDICT r2 items 6, 9).
+
+Three modes, each writing a ``runs/*_r{N}.json`` artifact:
+
+- ``dp``        — DP-FedAvg (central clip+noise at the reduce) on REAL digit images
+                  upsampled to the flagship CNN's 28x28 input: per-round (ε, δ) spend
+                  from the coordinator's accountant alongside the accuracy trajectory.
+                  Capability parity: the reference computes DP aggregation
+                  (``nanofed/server/aggregator/privacy.py:299-346``) but never records
+                  a spend-vs-accuracy artifact.
+- ``fedprox``   — FedProx vs FedAvg under severe Dirichlet non-IID skew (the thing
+                  FedProx is FOR, Li et al. 2020): multi-seed trajectories at
+                  μ ∈ {0, 0.05, 0.2} in a high-drift regime (16 local epochs, C=0.3).
+                  The reference has no FedProx at all; BASELINE.json config #3 names it.
+- ``labelskew`` — the 100-client label-skew C=0.1 benchmark config run end-to-end with
+                  round wall-clocks (synthetic MNIST-shaped data, clearly labeled —
+                  the real-data story lives in the digits artifacts).
+
+Usage:
+    python scripts/record_evidence.py dp [--round-tag r03]
+    python scripts/record_evidence.py fedprox
+    python scripts/record_evidence.py labelskew
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _trajectory(coord) -> list[dict]:
+    """Drain a coordinator, collecting per-round eval/train metrics."""
+    t0 = time.time()
+    out = []
+    for m in coord.start_training():
+        row = {"round": m.round_id, "elapsed_s": round(time.time() - t0, 2),
+               "duration_s": round(m.duration_s, 4)}
+        for k in ("privacy_epsilon", "privacy_delta"):
+            if k in m.agg_metrics:
+                row[k] = round(float(m.agg_metrics[k]), 6)
+        if m.eval_metrics.get("accuracy") is not None:
+            row["test_accuracy"] = round(float(m.eval_metrics["accuracy"]), 4)
+        out.append(row)
+    return out
+
+
+def _write(name: str, artifact: dict) -> Path:
+    out = REPO / "runs" / f"{name}.json"
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(artifact, indent=2))
+    print(f"\nartifact written to {out}")
+    return out
+
+
+def run_dp(tag: str) -> int:
+    import jax
+
+    from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+    from nanofed_tpu.data import federate, load_digits_dataset, pack_eval
+    from nanofed_tpu.data.datasets import resize_images
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+    from nanofed_tpu.trainer import TrainingConfig
+
+    from nanofed_tpu.privacy.accounting import noise_multiplier_for_budget
+
+    # Calibrate σ so that NUM_ROUNDS central-DP releases stay within the (ε=8, δ=1e-5)
+    # budget under tight RDP accounting — the reference makes users pick σ by hand and
+    # its dp benchmark config (σ=0.5) would blow through ε=8 within one round.
+    num_rounds = 20
+    budget_eps, budget_delta = 8.0, 1e-5
+    sigma = noise_multiplier_for_budget(
+        budget_eps, budget_delta, sampling_rate=1.0, num_events=num_rounds
+    )
+    print(f"calibrated sigma={sigma:.4f} for eps={budget_eps} over {num_rounds} rounds")
+    privacy = PrivacyConfig(epsilon=budget_eps, delta=budget_delta,
+                            max_gradient_norm=1.0, noise_multiplier=sigma)
+    train = resize_images(load_digits_dataset("train"), 28, 28)
+    test = resize_images(load_digits_dataset("test"), 28, 28)
+    coord = Coordinator(
+        model=get_model("mnist_cnn"),
+        train_data=federate(train, num_clients=10, scheme="iid", batch_size=16, seed=0),
+        config=CoordinatorConfig(num_rounds=num_rounds, seed=0, base_dir="runs/dp_run",
+                                 eval_every=1, save_metrics=False),
+        training=TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.1),
+        eval_data=pack_eval(test, batch_size=128),
+        central_privacy=PrivacyAwareAggregationConfig(privacy=privacy),
+    )
+    traj = _trajectory(coord)
+    spent = coord.privacy_spent
+    final_acc = next((r["test_accuracy"] for r in reversed(traj)
+                      if "test_accuracy" in r), None)
+    _write(f"dp_fedavg_{tag}", {
+        "artifact": f"dp_fedavg_{tag}",
+        "benchmark": "dp_fedavg_mnist (BASELINE.json config #4)",
+        "dataset": train.name,
+        "real_data": True,
+        "data_note": "REAL sklearn digits upsampled 8x8->28x28 (MNIST unfetchable here; "
+                     "see runs/mnist_fetch_attempt_*.log)",
+        "model": "mnist_cnn",
+        "mechanism": "central DP-FedAvg: per-update clip to C, uniform-weight mean, "
+                     "Gaussian noise sigma*C/K at the replicated aggregate",
+        "privacy_config": {"epsilon_budget": privacy.epsilon, "delta": privacy.delta,
+                           "clip_norm": privacy.max_gradient_norm,
+                           "noise_multiplier": round(sigma, 4),
+                           "calibration": "noise_multiplier_for_budget (RDP, q=1, "
+                                          f"{num_rounds} events)"},
+        "accounting": "RDPAccountant (tight composition; coordinator default)",
+        "epsilon_spent_total": round(spent.epsilon_spent, 4),
+        "delta_spent_total": spent.delta_spent,
+        "within_budget": bool(spent.epsilon_spent <= budget_eps),
+        "final_test_accuracy": final_acc,
+        "trajectory": traj,
+        "platform": str(jax.devices()[0].platform),
+    })
+    print(f"DP-FedAvg: final acc={final_acc} at epsilon={spent.epsilon_spent:.3f}")
+    return 0
+
+
+def run_fedprox(tag: str) -> int:
+    import jax
+    import numpy as np
+
+    from nanofed_tpu.data import federate, load_digits_dataset, pack_eval
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+    from nanofed_tpu.trainer import TrainingConfig
+
+    train = load_digits_dataset("train")
+    test = load_digits_dataset("test")
+    model = get_model("digits_mlp", hidden=96)
+    # High-drift regime: severe skew (Dirichlet alpha=0.05 — most clients see 1-2
+    # classes), 16 local epochs at lr=0.5, 30% participation.  This is where client
+    # updates diverge and the proximal term earns its keep.
+    regime = dict(alpha=0.05, local_epochs=16, learning_rate=0.5, clients=30,
+                  participation=0.3, rounds=25, batch_size=16)
+    arms = {}
+    for mu in (0.0, 0.05, 0.2):
+        per_seed = []
+        for seed in (0, 1, 2):
+            cd = federate(train, num_clients=regime["clients"], scheme="dirichlet",
+                          batch_size=regime["batch_size"], seed=seed,
+                          alpha=regime["alpha"])
+            coord = Coordinator(
+                model=model, train_data=cd,
+                config=CoordinatorConfig(num_rounds=regime["rounds"], seed=seed,
+                                         participation_rate=regime["participation"],
+                                         base_dir="runs/fedprox_run", eval_every=1,
+                                         save_metrics=False),
+                training=TrainingConfig(batch_size=regime["batch_size"],
+                                        local_epochs=regime["local_epochs"],
+                                        learning_rate=regime["learning_rate"],
+                                        prox_mu=mu),
+                eval_data=pack_eval(test, batch_size=128),
+            )
+            accs = [r["test_accuracy"] for r in _trajectory(coord)
+                    if "test_accuracy" in r]
+            per_seed.append(accs)
+            print(f"  mu={mu} seed={seed}: final={accs[-1]:.4f}", flush=True)
+        arr = np.asarray(per_seed)
+        arms[f"mu={mu}"] = {
+            "per_seed_trajectories": arr.round(4).tolist(),
+            "mean_trajectory": arr.mean(axis=0).round(4).tolist(),
+            "final_accuracy_mean": round(float(arr[:, -1].mean()), 4),
+            "last5_accuracy_mean": round(float(arr[:, -5:].mean()), 4),
+        }
+    fedavg = arms["mu=0.0"]["last5_accuracy_mean"]
+    best_prox = max(v["last5_accuracy_mean"] for k, v in arms.items() if k != "mu=0.0")
+    _write(f"noniid_fedprox_{tag}", {
+        "artifact": f"noniid_fedprox_{tag}",
+        "benchmark": "fedprox vs fedavg under Dirichlet non-IID "
+                     "(BASELINE.json config #3 capability)",
+        "dataset": "digits", "real_data": True, "model": "digits_mlp",
+        "regime": regime, "seeds": [0, 1, 2],
+        "arms": arms,
+        "fedprox_beats_fedavg": bool(best_prox > fedavg),
+        "summary": f"last-5-round mean accuracy: FedAvg {fedavg:.4f} vs best FedProx "
+                   f"{best_prox:.4f} (3 seeds)",
+        "platform": str(jax.devices()[0].platform),
+    })
+    print(f"FedAvg {fedavg:.4f} vs best FedProx {best_prox:.4f}")
+    return 0
+
+
+def run_labelskew(tag: str) -> int:
+    import jax
+
+    from nanofed_tpu.benchmarks import run_benchmark
+
+    summary = run_benchmark("mnist_labelskew", out_dir="runs/labelskew_run",
+                            eval_every=1, num_rounds=8)
+    _write(f"labelskew_{tag}", {
+        "artifact": f"labelskew_{tag}",
+        "benchmark": "mnist_labelskew (BASELINE.json config #2)",
+        "data_note": "synthetic MNIST-shaped data (class-prototype Gaussians) — "
+                     "MNIST unfetchable here; mechanics under test are the 100-client "
+                     "label-skew partition + C=0.1 participation at full scale",
+        "real_data": False,
+        "summary": {k: v for k, v in summary.items() if k != "devices"},
+        "platform": str(jax.devices()[0].platform),
+    })
+    print(json.dumps({k: summary[k] for k in ("rounds_completed", "rounds_per_sec")
+                      if k in summary}))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("mode", choices=["dp", "fedprox", "labelskew"])
+    ap.add_argument("--round-tag", default="r03")
+    args = ap.parse_args()
+    return {"dp": run_dp, "fedprox": run_fedprox, "labelskew": run_labelskew}[
+        args.mode
+    ](args.round_tag)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
