@@ -8,6 +8,7 @@ and ICI collectives are the transport (SURVEY.md §2, bottom rows).
 from nanofed_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_sharding,
+    initialize_distributed,
     make_mesh,
     pad_client_count,
     pad_clients,
@@ -26,6 +27,7 @@ __all__ = [
     "build_round_step",
     "client_sharding",
     "init_server_state",
+    "initialize_distributed",
     "make_mesh",
     "pad_client_count",
     "pad_clients",

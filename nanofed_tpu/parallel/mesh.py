@@ -4,11 +4,15 @@ The reference's "cluster" is an aiohttp server plus coroutine clients in one eve
 (``examples/mnist/run_experiment.py:126-131``).  Here the cluster is a
 ``jax.sharding.Mesh`` with a named ``clients`` axis: each device holds ``C / n_devices``
 clients, local training is vmapped within a device, and aggregation is a ``psum`` across
-it.  Multi-host TPU slices extend the same mesh over ICI/DCN with no code change — that is
-the entire distributed communication backend.
+it.  On a single host the mesh spans the local chips over ICI; on a multi-host slice the
+SAME program spans every host's chips (ICI within a slice, DCN across slices) after one
+extra step — ``initialize_distributed()`` before any JAX computation, so
+``jax.devices()`` enumerates the global device set instead of just the local ones.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
@@ -17,6 +21,54 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nanofed_tpu.core.types import ClientData
 
 CLIENT_AXIS = "clients"
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict[str, int]:
+    """Opt-in multi-host initialization: call ONCE, before any JAX computation, on every
+    process of a multi-host TPU slice (or GPU/CPU cluster).
+
+    Wraps ``jax.distributed.initialize``.  On TPU pods the three arguments are
+    auto-detected from the TPU metadata, so a bare ``initialize_distributed()`` works;
+    elsewhere pass them explicitly (or set ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``).  After it returns, ``jax.devices()``
+    is the GLOBAL device list and ``make_mesh()`` builds the pod-wide client mesh —
+    the round step is unchanged; XLA routes the psum over ICI within a slice and DCN
+    across slices.
+
+    Single-process no-op: when no coordinator address is configured anywhere and the
+    environment is not a multi-host TPU, this does nothing (so code paths shared
+    between laptop and pod can call it unconditionally).
+
+    Returns ``{"process_index": ..., "process_count": ...}`` for logging.
+
+    This is the explicit form of the distributed-backend row of SURVEY.md §2: the
+    reference's NCCL/MPI-shaped capability is jax.distributed (a gRPC coordination
+    service for process bring-up) + XLA collectives (the data plane).
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    multi_host_tpu = bool(os.environ.get("TPU_WORKER_HOSTNAMES", "").strip().count(","))
+    if coordinator_address is None and not multi_host_tpu:
+        # Single-process: nothing to coordinate.
+        return {"process_index": 0, "process_count": 1}
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }
 
 
 def make_mesh(devices: list[jax.Device] | None = None, axis_name: str = CLIENT_AXIS) -> Mesh:
