@@ -27,23 +27,31 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    force: bool = False,
 ) -> dict[str, int]:
     """Opt-in multi-host initialization: call ONCE, before any JAX computation, on every
     process of a multi-host TPU slice (or GPU/CPU cluster).
 
-    Wraps ``jax.distributed.initialize``.  On TPU pods the three arguments are
-    auto-detected from the TPU metadata, so a bare ``initialize_distributed()`` works;
-    elsewhere pass them explicitly (or set ``JAX_COORDINATOR_ADDRESS`` /
-    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``).  After it returns, ``jax.devices()``
-    is the GLOBAL device list and ``make_mesh()`` builds the pod-wide client mesh —
-    the round step is unchanged; XLA routes the psum over ICI within a slice and DCN
-    across slices.
+    Wraps ``jax.distributed.initialize``.  Three ways in:
 
-    Single-process no-op: when no coordinator address is configured anywhere and the
-    environment is not a multi-host TPU, this does nothing (so code paths shared
-    between laptop and pod can call it unconditionally).
+    * **Explicit**: pass ``coordinator_address`` (+ ``num_processes``/``process_id``
+      where the platform can't infer them), or set ``JAX_COORDINATOR_ADDRESS`` /
+      ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``.
+    * **TPU pods**: ``force=True`` calls ``jax.distributed.initialize()`` bare and lets
+      JAX auto-detect everything from the TPU metadata server (the right mode on plain
+      multi-host TPU VMs); GKE-style environments that set a multi-entry
+      ``TPU_WORKER_HOSTNAMES`` are detected without ``force``.
+    * **Single process** (laptops, CI, one-chip benchmarks): with none of the above,
+      the call is a documented no-op returning ``{"process_index": 0,
+      "process_count": 1}`` — shared code paths can call it unconditionally.
 
-    Returns ``{"process_index": ..., "process_count": ...}`` for logging.
+    Passing ``num_processes``/``process_id`` WITHOUT any coordinator address raises:
+    silently proceeding single-process would train N divergent models that each look
+    healthy.
+
+    After it returns, ``jax.devices()`` is the GLOBAL device list and ``make_mesh()``
+    builds the pod-wide client mesh — the round step is unchanged; XLA routes the psum
+    over ICI within a slice and DCN across slices.
 
     This is the explicit form of the distributed-backend row of SURVEY.md §2: the
     reference's NCCL/MPI-shaped capability is jax.distributed (a gRPC coordination
@@ -56,7 +64,14 @@ def initialize_distributed(
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
     multi_host_tpu = bool(os.environ.get("TPU_WORKER_HOSTNAMES", "").strip().count(","))
-    if coordinator_address is None and not multi_host_tpu:
+    if coordinator_address is None and not (multi_host_tpu or force):
+        if num_processes is not None or process_id is not None:
+            raise ValueError(
+                "num_processes/process_id configured but no coordinator address: "
+                "pass coordinator_address= (or JAX_COORDINATOR_ADDRESS), or use "
+                "force=True on TPU pods to let JAX auto-detect — refusing to "
+                "silently run single-process"
+            )
         # Single-process: nothing to coordinate.
         return {"process_index": 0, "process_count": 1}
 

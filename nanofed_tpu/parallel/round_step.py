@@ -93,9 +93,13 @@ def build_round_step(
     ``client_chunk`` bounds HBM when clients-per-device is large (SURVEY.md §7 "clients ≫
     chips"): a full ``vmap`` over N clients materializes N copies of every local-training
     activation at once; with ``client_chunk=k`` the per-device client batch is processed
-    as a sequential ``lax.map`` over N/k chunks of a k-wide vmap, so activation memory
-    scales with k while the MXU still sees k-client-wide batched matmuls.  Must divide the
-    per-device client count.
+    as a sequential scan over N/k chunks of a k-wide vmap, so activation memory scales
+    with k while the MXU still sees k-client-wide batched matmuls.  Must divide the
+    per-device client count.  Without ``validation`` the chunked reduce STREAMS: each
+    chunk's weighted delta sum folds into one params-sized accumulator, so the
+    ``[N, |params|]`` per-client stacks never exist (see ``streaming_chunk_reduce``);
+    with ``validation`` the deltas must materialize, because cohort z-score rejection
+    re-weights clients only after every client's statistics are known.
 
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
@@ -110,17 +114,116 @@ def build_round_step(
     local_fit = local_fit or make_local_fit(apply_fn, training, grad_fn=grad_fn)
     server_tx = strategy.server_tx
 
+    def clip_deltas(delta):
+        """Per-client clip to the central-DP sensitivity bound C (local, cohort-free)."""
+        clip = central_privacy.privacy.max_gradient_norm
+        return jax.vmap(lambda d: tree_clip_by_global_norm(d, clip)[0])(delta)
+
+    def streaming_chunk_reduce(gp_v, data, rngs, weights, n_chunks):
+        """Clients >> chips FAST PATH: fold the weighted reduce into the chunk loop.
+
+        The materializing path below runs every chunk's ``vmap(local_fit)``, stacks all
+        ``C_local`` per-client params, and only then forms deltas and reduces — two
+        ``[C_local, |params|]`` temporaries (at the 1000-client flagship shape: ~9.6 GB
+        of HBM written and re-read per round just to be summed).  Here each chunk's
+        weighted delta sum is accumulated into one params-sized carry as soon as it is
+        computed, so peak memory scales with ``client_chunk``, not ``C_local``, and the
+        big temporaries never exist.  Per-client OUTPUTS that the round reports
+        (metrics, squared update norms) are O(C) scalars — those still stack.
+
+        Only taken when ``validation is None``: cohort z-score rejection must adjust
+        weights AFTER seeing every client's stats, which a streamed weighted sum cannot
+        retroactively honor.  Central-DP clipping IS local (clip to constant C), so the
+        DP path streams fine — clip before accumulating, uniform weights.
+        """
+        uniform_dp = central_privacy is not None
+        chunked = jax.tree.map(
+            lambda x: x.reshape(n_chunks, client_chunk, *x.shape[1:]),
+            (data, rngs, weights),
+        )
+        acc0 = jax.tree.map(lambda g: jnp.zeros_like(g), gp_v)
+
+        def step_chunk(acc, chunk):
+            c_data, c_rngs, c_weights = chunk
+            result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, c_data, c_rngs)
+            delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
+            if uniform_dp:
+                delta = clip_deltas(delta)
+                w = (c_weights > 0).astype(jnp.float32)
+            else:
+                w = c_weights
+            acc = jax.tree.map(
+                lambda a, d: a + jnp.tensordot(w.astype(d.dtype), d, axes=1), acc, delta
+            )
+            return acc, (result.metrics, jax.vmap(tree_sq_norm)(delta))
+
+        acc, (metrics, sq_norms) = lax.scan(step_chunk, acc0, chunked)
+        flat = lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+        return acc, jax.tree.map(flat, metrics), flat(sq_norms)
+
+    def apply_server_update(gp, sos, agg_delta, total_w):
+        # optax convention: pass the NEGATIVE delta as "gradient" so SGD(1.0) applies
+        # +delta (exact FedAvg).  A round with zero total weight (no participants /
+        # all failed — the reference marks these FAILED, coordinator.py:295-304) must
+        # leave params AND server state untouched, even for stateful server optimizers.
+        neg_delta = jax.tree.map(jnp.negative, agg_delta)
+        updates, new_sos = server_tx.update(neg_delta, sos, gp)
+        ok = total_w > 0
+        new_gp = tree_where(ok, optax.apply_updates(gp, updates), gp)
+        new_sos = tree_where(ok, new_sos, sos)
+        return new_gp, new_sos
+
+    def add_central_noise(agg_delta, noise_rng, participants):
+        sigma = central_privacy.privacy.noise_multiplier
+        clip = central_privacy.privacy.max_gradient_norm
+        gen = get_noise_generator(central_privacy.privacy.noise_type)
+        server_noise = tree_noise(noise_rng, agg_delta, sigma * clip / participants, gen)
+        return jax.tree.map(jnp.add, agg_delta, server_noise)
+
+    def finish_streamed_round(gp, sos, weights, noise_rng, client_metrics, sq_norms,
+                              local_wsum):
+        """Aggregate a streamed local weighted-delta sum: one tree-psum, then the same
+        server transform / metrics as the materializing path."""
+        total_w = lax.psum(weights.sum(), axis_name)
+        global_wsum = jax.tree.map(lambda x: lax.psum(x, axis_name), local_wsum)
+        if central_privacy is not None:
+            # local_wsum was accumulated with UNIFORM weights over clipped deltas, so
+            # sensitivity of the mean is exactly C/K — identical math to the
+            # materializing DP path.
+            participants = jnp.maximum(
+                lax.psum((weights > 0).sum().astype(jnp.float32), axis_name), 1.0
+            )
+            agg_delta = jax.tree.map(
+                lambda x: x / participants.astype(x.dtype), global_wsum
+            )
+            agg_delta = add_central_noise(agg_delta, noise_rng, participants)
+        else:
+            den = jnp.maximum(total_w, 1e-12)
+            agg_delta = jax.tree.map(lambda x: x / den.astype(x.dtype), global_wsum)
+        new_gp, new_sos = apply_server_update(gp, sos, agg_delta, total_w)
+        metrics = psum_weighted_metrics(client_metrics, weights, axis_name)
+        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        return new_gp, new_sos, metrics, client_metrics, sq_norms
+
     def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng):
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
         gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
         c_local = rngs.shape[0]
-        if client_chunk is not None and client_chunk < c_local:
-            if c_local % client_chunk != 0:
-                raise ValueError(
-                    f"client_chunk {client_chunk} must divide per-device client count "
-                    f"{c_local}"
-                )
+        chunking = client_chunk is not None and client_chunk < c_local
+        if chunking and c_local % client_chunk != 0:
+            raise ValueError(
+                f"client_chunk {client_chunk} must divide per-device client count "
+                f"{c_local}"
+            )
+        if chunking and validation is None:
+            local_wsum, client_metrics, sq_norms = streaming_chunk_reduce(
+                gp_v, data, rngs, weights, c_local // client_chunk
+            )
+            return finish_streamed_round(
+                gp, sos, weights, noise_rng, client_metrics, sq_norms, local_wsum
+            )
+        if chunking:
             n_chunks = c_local // client_chunk
             chunked = jax.tree.map(
                 lambda x: x.reshape(n_chunks, client_chunk, *x.shape[1:]), (data, rngs)
@@ -168,26 +271,14 @@ def build_round_step(
 
         total_w = lax.psum(weights.sum(), axis_name)
         if central_privacy is not None:
-            clip = central_privacy.privacy.max_gradient_norm
-            sigma = central_privacy.privacy.noise_multiplier
-            delta = jax.vmap(lambda d: tree_clip_by_global_norm(d, clip)[0])(delta)
+            delta = clip_deltas(delta)
             uniform = (weights > 0).astype(jnp.float32)
             participants = jnp.maximum(lax.psum(uniform.sum(), axis_name), 1.0)
             agg_delta = psum_weighted_mean(delta, uniform, axis_name)
-            gen = get_noise_generator(central_privacy.privacy.noise_type)
-            server_noise = tree_noise(noise_rng, agg_delta, sigma * clip / participants, gen)
-            agg_delta = jax.tree.map(jnp.add, agg_delta, server_noise)
+            agg_delta = add_central_noise(agg_delta, noise_rng, participants)
         else:
             agg_delta = psum_weighted_mean(delta, weights, axis_name)
-        # optax convention: pass the NEGATIVE delta as "gradient" so SGD(1.0) applies
-        # +delta (exact FedAvg).  A round with zero total weight (no participants /
-        # all failed — the reference marks these FAILED, coordinator.py:295-304) must
-        # leave params AND server state untouched, even for stateful server optimizers.
-        neg_delta = jax.tree.map(jnp.negative, agg_delta)
-        updates, new_sos = server_tx.update(neg_delta, sos, gp)
-        ok = total_w > 0
-        new_gp = tree_where(ok, optax.apply_updates(gp, updates), gp)
-        new_sos = tree_where(ok, new_sos, sos)
+        new_gp, new_sos = apply_server_update(gp, sos, agg_delta, total_w)
 
         metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
         if validation is not None:

@@ -136,3 +136,57 @@ def test_chunk_must_divide(devices):
             step(params, sos, data, compute_weights(data.num_samples),
                  stack_rngs(jax.random.key(0), c)).params
         )
+
+
+def test_streamed_dp_chunking_matches_materialized(devices):
+    """The streaming chunk reduce under central DP must match the materializing path:
+    same clipping, same uniform weights, same noise draw (the noise key is independent
+    of the reduction layout)."""
+    from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+    from nanofed_tpu.security.validation import ValidationConfig
+
+    mesh, model, data, training, params = _setup(devices)
+    strategy = fedavg_strategy()
+    cp = PrivacyAwareAggregationConfig(privacy=PrivacyConfig(
+        epsilon=8.0, delta=1e-5, max_gradient_norm=0.5, noise_multiplier=0.3))
+    sos = init_server_state(strategy, params)
+    weights = compute_weights(data.num_samples)
+    rngs = stack_rngs(jax.random.key(3), 16)
+
+    full = build_round_step(model.apply, training, mesh, strategy,
+                            central_privacy=cp)(params, sos, data, weights, rngs)
+    streamed = build_round_step(model.apply, training, mesh, strategy,
+                                central_privacy=cp, client_chunk=1)(
+        params, sos, data, weights, rngs)
+    for a, b in zip(jax.tree.leaves(full.params), jax.tree.leaves(streamed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full.metrics["loss"]),
+                               np.asarray(streamed.metrics["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(full.update_sq_norms),
+                               np.asarray(streamed.update_sq_norms), rtol=1e-5)
+
+    # Chunking + validation takes the materializing path (cohort stats need all
+    # clients); with every check loosened past rejection it must agree with the
+    # streaming result.
+    validated = build_round_step(
+        model.apply, training, mesh, strategy, central_privacy=cp, client_chunk=1,
+        validation=ValidationConfig(max_norm=1e6, z_score_threshold=1e6),
+    )(params, sos, data, weights, rngs)
+    for a, b in zip(jax.tree.leaves(full.params), jax.tree.leaves(validated.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_streamed_zero_weight_round_is_noop(devices):
+    """All-dropout round through the STREAMING path leaves params + server state
+    untouched (same contract the materializing path pins)."""
+    mesh, model, data, training, params = _setup(devices)
+    strategy = fedavg_strategy()
+    sos = init_server_state(strategy, params)
+    rngs = stack_rngs(jax.random.key(0), 16)
+    res = build_round_step(model.apply, training, mesh, strategy, client_chunk=1)(
+        params, sos, data, jnp.zeros((16,)), rngs)
+    for a, b in zip(jax.tree.leaves(res.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(res.server_opt_state), jax.tree.leaves(sos)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -67,3 +67,32 @@ def test_env_vars_configure_init(monkeypatch):
     assert called == [
         {"coordinator_address": "10.0.0.2:9000", "num_processes": 2, "process_id": 0}
     ]
+
+
+def test_partial_config_without_coordinator_raises(monkeypatch):
+    """Process ids without a coordinator address must fail loudly — a silent
+    single-process fallback would train N divergent models."""
+    import pytest
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    with pytest.raises(ValueError, match="coordinator address"):
+        initialize_distributed(num_processes=4, process_id=2)
+
+
+def test_force_calls_bare_initialize(monkeypatch):
+    """force=True hands off to jax.distributed.initialize with no arguments so JAX's
+    TPU-metadata auto-detection runs (plain multi-host TPU VMs)."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    called = []
+    monkeypatch.setattr(
+        mesh_mod.jax.distributed, "initialize", lambda **kw: called.append(kw)
+    )
+    monkeypatch.setattr(mesh_mod.jax, "process_index", lambda: 0, raising=False)
+    monkeypatch.setattr(mesh_mod.jax, "process_count", lambda: 8, raising=False)
+    info = initialize_distributed(force=True)
+    assert called == [
+        {"coordinator_address": None, "num_processes": None, "process_id": None}
+    ]
+    assert info["process_count"] == 8
