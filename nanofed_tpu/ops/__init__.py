@@ -6,6 +6,9 @@ hardware PRNG buys something XLA's pattern library doesn't express:
 
 * ``ops.reduce``    — the FedAvg weighted reduce over the stacked client axis as one
                       MXU contraction per tile ([C, P] x [C] -> [P]).
+* ``ops.dp_reduce`` — the central-DP clip+mean fused into two read passes: per-row
+                      norms, then clip coefficients folded into the reduce WEIGHTS so
+                      the clipped [C, P] intermediate never exists.
 * ``ops.quantize``  — fixed-point uint32 quantize / dequantize and seeded additive
                       masking (the SecAgg inner loop) with the on-core PRNG, so masking
                       never round-trips to the host.
@@ -14,6 +17,11 @@ Every op takes ``interpret=None`` (auto: real kernels on TPU, interpreter elsewh
 the same code paths are exercised by the CPU-mesh test suite.
 """
 
+from nanofed_tpu.ops.dp_reduce import (
+    central_dp_reduce_stacked,
+    dp_clipped_mean_flat,
+    row_sq_norms,
+)
 from nanofed_tpu.ops.quantize import (
     add_mask,
     dequantize_u32,
@@ -23,8 +31,11 @@ from nanofed_tpu.ops.reduce import weighted_mean_flat, weighted_mean_tree
 
 __all__ = [
     "add_mask",
+    "central_dp_reduce_stacked",
     "dequantize_u32",
+    "dp_clipped_mean_flat",
     "quantize_u32",
+    "row_sq_norms",
     "weighted_mean_flat",
     "weighted_mean_tree",
 ]

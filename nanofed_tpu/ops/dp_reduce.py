@@ -1,0 +1,124 @@
+"""Fused central-DP reduce (clip + weighted mean) as Pallas TPU kernels.
+
+The central-DP aggregation over a STACKED client axis (``parallel/round_step.py``'s
+materializing path; host parity ``nanofed/server/aggregator/privacy.py:179-194``) is:
+
+    scale_c   = w_c * min(1, C / ||x_c||) / sum(w)        # per-client clip-to-C
+    out[p]    = sum_c scale_c * x[c, p]   (+ Gaussian noise outside)
+
+XLA expresses this as clip (read [C,P], WRITE [C,P]) then reduce (read [C,P]) — three
+[C,P]-sized HBM passes, because the clipped deltas are materialized.  The fusion here
+is two READ passes and no write:
+
+1. ``row_sq_norms``: one pass accumulating per-client squared norms tile by tile
+   (the grid revisits a single [1, C] output block — sequential on TPU, so the
+   accumulation is race-free).
+2. ``weighted_mean_flat`` (``ops.reduce``) with the clip folded into the WEIGHTS:
+   ``min(1, clip/norm_c)`` is an O(C) vector op, so "clip then mean" collapses into
+   "mean with clipped weights" — the [C, P] scaled intermediate never exists.
+
+Noise stays OUTSIDE the kernel on purpose: it is O(P), negligible next to the [C, P]
+traffic, and using ``privacy.noise`` keeps every DP noise draw in the framework on the
+same threefry generators (one RNG story to audit, same draws as the streaming path).
+
+Production-path note: at the flagship clients>>chips scale the round step now STREAMS
+the reduce chunk-wise (``streaming_chunk_reduce``) and never materializes [C, P] at
+all — these kernels target the stacked host/materializing paths, and exist to settle
+SURVEY.md §2's native-performance-layer mandate with measured numbers
+(``scripts/measure_pallas.py`` writes ``runs/pallas_reduce_*.json``).
+
+MEASURED (fill in by scripts/measure_pallas.py on the real chip): see module-level
+``MEASURED`` note appended to the artifact.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.ops._common import auto_interpret
+from nanofed_tpu.ops.reduce import weighted_mean_flat
+from nanofed_tpu.utils.trees import tree_ravel
+
+_TILE = 512
+
+
+def _sq_norm_kernel(x_ref, out_ref):
+    # x block: [C, TILE]; out block: [1, C] — the SAME block for every grid step, so
+    # accumulate (TPU grids run sequentially; no race).
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]
+    out_ref[:] += jnp.sum(x * x, axis=1, dtype=jnp.float32)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def row_sq_norms(x: jax.Array, interpret: bool | None = None) -> jax.Array:
+    """``[C, P] -> [C]`` per-row squared L2 norms in one HBM pass."""
+    c, p = x.shape
+    pad = (-p) % _TILE
+    xp = jnp.pad(x, ((0, 0), (0, pad)))
+    out = pl.pallas_call(
+        _sq_norm_kernel,
+        grid=((p + pad) // _TILE,),
+        in_specs=[pl.BlockSpec((c, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, c), jnp.float32),
+        interpret=auto_interpret(interpret),
+    )(xp.astype(jnp.float32))
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dp_clipped_mean_flat(
+    x: jax.Array,
+    weights: jax.Array,
+    clip: jax.Array | float,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``[C, P] x [C] -> [P]``: per-row clip-to-``clip`` folded into a weighted mean.
+
+    Exactly ``weighted_mean(clip_rows(x), weights)`` but without materializing the
+    clipped rows: the clip coefficient ``min(1, clip/||x_c||)`` scales the WEIGHT of
+    row c instead of the row itself.
+    """
+    # Pad + cast ONCE: both inner kernels re-pad only when misaligned, so handing them
+    # the aligned f32 buffer keeps the pipeline at its two HBM read passes (a separate
+    # pad inside each call would materialize two extra [C, P]-sized copies).
+    p = x.shape[1]
+    pad = (-p) % _TILE
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, pad)))
+    norms = jnp.sqrt(jnp.maximum(row_sq_norms(xp, interpret=interpret), 0.0))
+    coef = jnp.minimum(1.0, clip / jnp.maximum(norms, 1e-12))
+    w = weights.astype(jnp.float32)
+    # Denominator is the PARTICIPANT weight sum, not sum(w * coef): clipping bounds
+    # each client's contribution (sensitivity C / sum w); it must not inflate the
+    # weight of everyone else by shrinking the denominator.
+    return weighted_mean_flat(
+        xp, w * coef, interpret=interpret, denom=w.sum()
+    )[:p]
+
+
+def central_dp_reduce_stacked(
+    stacked: Params,
+    weights: jax.Array,
+    clip: jax.Array | float,
+    interpret: bool | None = None,
+) -> Params:
+    """Fused clip+mean over a stacked ``[C, ...]`` update pytree (kernel form of the
+    materializing central-DP reduce; add noise with ``privacy.noise.tree_noise``)."""
+    c = weights.shape[0]
+    flat = jnp.concatenate(
+        [leaf.reshape(c, -1) for leaf in jax.tree.leaves(stacked)], axis=1
+    )
+    _, unravel = tree_ravel(jax.tree.map(lambda leaf: leaf[0], stacked))
+    return unravel(dp_clipped_mean_flat(flat, weights, clip, interpret=interpret))

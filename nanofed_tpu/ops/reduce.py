@@ -44,14 +44,19 @@ def _wmean_kernel(w_ref, x_ref, denom_ref, out_ref):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def weighted_mean_flat(
-    x: jax.Array, weights: jax.Array, interpret: bool | None = None
+    x: jax.Array,
+    weights: jax.Array,
+    interpret: bool | None = None,
+    denom: jax.Array | None = None,
 ) -> jax.Array:
-    """``[C, P] x [C] -> [P]`` weighted mean (weights normalized by their sum)."""
+    """``[C, P] x [C] -> [P]`` weighted mean (weights normalized by their sum, or by an
+    explicit ``denom`` — the central-DP reduce divides by the PARTICIPANT sum even when
+    clip coefficients are folded into the weights, see ``ops.dp_reduce``)."""
     c, p = x.shape
     pad = (-p) % _TILE
     xp = jnp.pad(x, ((0, 0), (0, pad)))
     w = weights.astype(jnp.float32)
-    denom = jnp.maximum(w.sum(), 1e-12)[None]
+    denom = jnp.maximum(w.sum() if denom is None else denom, 1e-12)[None]
     out = pl.pallas_call(
         _wmean_kernel,
         grid=((p + pad) // _TILE,),

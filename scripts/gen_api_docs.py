@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import inspect
+import re
 import sys
 from pathlib import Path
 
@@ -46,7 +47,8 @@ MODULES = [
                        "nanofed_tpu.communication.http_server",
                        "nanofed_tpu.communication.http_client",
                        "nanofed_tpu.communication.network_coordinator"]),
-    ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.quantize"]),
+    ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",
+             "nanofed_tpu.ops.quantize"]),
     ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.trees",
                "nanofed_tpu.utils.platform", "nanofed_tpu.utils.dates"]),
     ("top-level", ["nanofed_tpu.experiments", "nanofed_tpu.benchmarks",
@@ -56,14 +58,23 @@ MODULES = [
 
 def _sig(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # Function-object defaults repr with a memory address ("<function sum at 0x...>"),
+    # which would churn the generated files on every run; keep just the name.
+    return re.sub(r"<function (\S+) at 0x[0-9a-f]+>", r"<function \1>", sig)
 
 
 def _doc(obj) -> str:
     d = inspect.getdoc(obj)
     return d.strip() if d else "*(undocumented)*"
+
+
+def _summary(obj) -> str:
+    """First PARAGRAPH of the docstring as one line (a first physical line can end
+    mid-sentence when the source wraps)."""
+    return " ".join(_doc(obj).split("\n\n")[0].split())
 
 
 def _is_public(name: str) -> bool:
@@ -97,7 +108,7 @@ def document_module(modname: str) -> str:
                     continue
                 func = meth.__func__ if isinstance(meth, (classmethod, staticmethod)) else meth
                 if inspect.isfunction(func) and inspect.getdoc(func):
-                    lines += [f"- **`{mname}{_sig(func)}`** — {_doc(func).splitlines()[0]}"]
+                    lines += [f"- **`{mname}{_sig(func)}`** — {_summary(func)}"]
             lines += [""]
         else:
             lines += [f"### `{name}{_sig(obj)}`", "", _doc(obj), ""]

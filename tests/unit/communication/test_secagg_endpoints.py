@@ -1,0 +1,175 @@
+"""Server-side secure-aggregation endpoint edge cases (aiohttp test client, no
+sockets): enrollment gating, roster lifecycle, malformed masked payloads."""
+
+import asyncio
+import base64
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from nanofed_tpu.communication.http_server import (
+    HEADER_CLIENT,
+    HEADER_ROUND,
+    HEADER_SECAGG,
+    HTTPServer,
+)
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _make_server() -> HTTPServer:
+    return HTTPServer(port=0)
+
+
+async def _with_client(fn):
+    server = _make_server()
+    client = TestClient(TestServer(server._app))
+    await client.start_server()
+    try:
+        return await fn(server, client)
+    finally:
+        await client.close()
+
+
+PK = base64.b64encode(bytes(32)).decode()
+
+
+def test_register_requires_open_enrollment():
+    async def scenario(server, client):
+        resp = await client.post(
+            "/secagg/register",
+            json={"public_key": PK, "num_samples": 10.0},
+            headers={HEADER_CLIENT: "c1"},
+        )
+        assert resp.status == 403  # not open
+        server.open_secagg(2)
+        resp = await client.post(
+            "/secagg/register",
+            json={"public_key": PK, "num_samples": 10.0},
+            headers={HEADER_CLIENT: "c1"},
+        )
+        assert resp.status == 200
+
+    _run(_with_client(scenario))
+
+
+def test_cohort_full_and_reregistration():
+    async def scenario(server, client):
+        server.open_secagg(1)
+        for cid, want in [("c1", 200), ("c2", 403), ("c1", 200)]:  # re-register ok
+            resp = await client.post(
+                "/secagg/register",
+                json={"public_key": PK, "num_samples": 5.0},
+                headers={HEADER_CLIENT: cid},
+            )
+            assert resp.status == want, cid
+
+    _run(_with_client(scenario))
+
+
+def test_bad_registrations_rejected():
+    async def scenario(server, client):
+        server.open_secagg(3)
+        bad = [
+            {"public_key": base64.b64encode(b"short").decode(), "num_samples": 5.0},
+            {"public_key": PK, "num_samples": 0.0},
+            {"public_key": PK, "num_samples": -3.0},
+            {"public_key": PK, "num_samples": "nope"},
+            {"num_samples": 5.0},
+        ]
+        for body in bad:
+            resp = await client.post(
+                "/secagg/register", json=body, headers={HEADER_CLIENT: "c1"}
+            )
+            assert resp.status == 400, body
+
+    _run(_with_client(scenario))
+
+
+def test_roster_completion_and_weights():
+    async def scenario(server, client):
+        server.open_secagg(2)
+        resp = await client.get("/secagg/roster")
+        payload = await resp.json()
+        assert payload["complete"] is False and payload["enrolled"] == 0
+        for cid, n in [("b", 30.0), ("a", 10.0)]:
+            await client.post(
+                "/secagg/register",
+                json={"public_key": PK, "num_samples": n},
+                headers={HEADER_CLIENT: cid},
+            )
+        payload = await (await client.get("/secagg/roster")).json()
+        assert payload["complete"] is True
+        assert payload["client_order"] == ["a", "b"]  # canonical sorted order
+        assert abs(payload["weights"]["a"] - 0.25) < 1e-9
+        assert abs(payload["weights"]["b"] - 0.75) < 1e-9
+
+    _run(_with_client(scenario))
+
+
+def test_masked_payload_structural_validation():
+    params = {"w": jnp.zeros((3, 2)), "b": jnp.zeros((2,))}
+
+    async def scenario(server, client):
+        server.open_secagg(1)
+        await client.post(
+            "/secagg/register",
+            json={"public_key": PK, "num_samples": 5.0},
+            headers={HEADER_CLIENT: "c1"},
+        )
+        await server.publish_model(params, 0)
+
+        import io
+
+        def masked_body(size, dtype=np.uint32):
+            buf = io.BytesIO()
+            np.savez_compressed(buf, masked=np.zeros(size, dtype))
+            return buf.getvalue()
+
+        headers = {HEADER_CLIENT: "c1", HEADER_ROUND: "0", HEADER_SECAGG: "masked"}
+        # Wrong length (model has 8 params), wrong dtype, non-npz garbage, unenrolled.
+        assert (await client.post("/update", data=masked_body(7), headers=headers)).status == 400
+        assert (await client.post(
+            "/update", data=masked_body(8, np.float32), headers=headers)).status == 400
+        assert (await client.post("/update", data=b"junk", headers=headers)).status == 400
+        assert (await client.post(
+            "/update", data=masked_body(8),
+            headers={**headers, HEADER_CLIENT: "intruder"})).status == 403
+        # Correct one accepted and buffered.
+        assert (await client.post("/update", data=masked_body(8), headers=headers)).status == 200
+        assert server.num_masked_updates() == 1
+        drained = await server.drain_masked_updates()
+        assert set(drained) == {"c1"} and drained["c1"].dtype == np.uint32
+        assert server.num_masked_updates() == 0
+
+    _run(_with_client(scenario))
+
+
+def test_publish_model_clears_stale_masked_updates():
+    params = {"w": jnp.zeros((4,))}
+
+    async def scenario(server, client):
+        server.open_secagg(1)
+        await client.post(
+            "/secagg/register",
+            json={"public_key": PK, "num_samples": 5.0},
+            headers={HEADER_CLIENT: "c1"},
+        )
+        await server.publish_model(params, 0)
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, masked=np.zeros(4, np.uint32))
+        headers = {HEADER_CLIENT: "c1", HEADER_ROUND: "0", HEADER_SECAGG: "masked"}
+        assert (await client.post("/update", data=buf.getvalue(), headers=headers)).status == 200
+        assert server.num_masked_updates() == 1
+        # Next round's publish drops the stale round-0 vector (its masks are bound to
+        # round 0 and would not cancel in round 1).
+        await server.publish_model(params, 1)
+        assert server.num_masked_updates() == 0
+
+    _run(_with_client(scenario))

@@ -83,3 +83,72 @@ class TestMask:
         m2 = add_mask(q, jnp.int32(2), jnp.int32(1))
         assert np.mean(np.asarray(m1) == np.asarray(q)) < 0.01
         assert np.mean(np.asarray(m1) == np.asarray(m2)) < 0.01
+
+
+class TestDPReduce:
+    """Fused clip+mean (ops.dp_reduce) vs the straightforward clip-then-mean."""
+
+    def _reference(self, x, w, clip):
+        norms = np.linalg.norm(x, axis=1)
+        coef = np.minimum(1.0, clip / np.maximum(norms, 1e-12))
+        clipped = x * coef[:, None]
+        return (w[:, None] * clipped).sum(axis=0) / max(w.sum(), 1e-12)
+
+    def test_row_sq_norms(self):
+        from nanofed_tpu.ops import row_sq_norms
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(7, 1300)).astype(np.float32)  # P not a tile multiple
+        got = np.asarray(row_sq_norms(jnp.asarray(x)))
+        np.testing.assert_allclose(got, (x.astype(np.float64) ** 2).sum(1), rtol=1e-5)
+
+    def test_fused_matches_clip_then_mean(self):
+        from nanofed_tpu.ops import dp_clipped_mean_flat
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(9, 700)).astype(np.float32) * 3.0
+        w = np.ones(9, np.float32)
+        got = np.asarray(dp_clipped_mean_flat(jnp.asarray(x), jnp.asarray(w), 1.0))
+        np.testing.assert_allclose(got, self._reference(x, w, 1.0), rtol=2e-5, atol=1e-6)
+
+    def test_fused_denominator_is_participant_sum(self):
+        # All rows over the clip bound: result must be mean of clip-scaled rows over
+        # sum(w), NOT over sum(w * coef) — the sensitivity-C/K contract.
+        from nanofed_tpu.ops import dp_clipped_mean_flat
+
+        x = np.full((4, 600), 10.0, np.float32)  # every norm >> clip
+        w = np.ones(4, np.float32)
+        got = np.asarray(dp_clipped_mean_flat(jnp.asarray(x), jnp.asarray(w), 1.0))
+        np.testing.assert_allclose(got, self._reference(x, w, 1.0), rtol=2e-5)
+        # Sanity: each row scaled to norm 1 -> mean row has norm ~1 (not ~4).
+        assert abs(np.linalg.norm(got) - 1.0) < 1e-3
+
+    def test_dropout_weight_zero_excluded(self):
+        from nanofed_tpu.ops import dp_clipped_mean_flat
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(5, 640)).astype(np.float32)
+        w = np.array([1, 0, 1, 1, 0], np.float32)
+        got = np.asarray(dp_clipped_mean_flat(jnp.asarray(x), jnp.asarray(w), 0.5))
+        np.testing.assert_allclose(got, self._reference(x, w, 0.5), rtol=2e-5, atol=1e-6)
+
+    def test_tree_wrapper_matches_round_step_math(self):
+        # central_dp_reduce_stacked == the materializing round-step DP reduce
+        # (clip_deltas + psum_weighted_mean with uniform weights) on one device.
+        from nanofed_tpu.ops import central_dp_reduce_stacked
+        from nanofed_tpu.utils.trees import tree_clip_by_global_norm
+
+        rng = np.random.default_rng(3)
+        stacked = {
+            "w": jnp.asarray(rng.normal(size=(6, 20, 10)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(6, 10)).astype(np.float32) * 5),
+        }
+        w = jnp.ones(6)
+        clip = 0.7
+        got = central_dp_reduce_stacked(stacked, w, clip)
+        clipped = jax.vmap(lambda d: tree_clip_by_global_norm(d, clip)[0])(stacked)
+        want = jax.tree.map(lambda leaf: (leaf * w[:, None, None] if leaf.ndim == 3
+                                          else leaf * w[:, None]).sum(0) / w.sum(),
+                            clipped)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
