@@ -216,7 +216,17 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("mode", choices=["dp", "fedprox", "labelskew"])
     ap.add_argument("--round-tag", default="r03")
+    ap.add_argument(
+        "--platform", choices=["auto", "cpu"], default="auto",
+        help="cpu forces the virtual 8-device CPU mesh (for wedged/absent accelerators; "
+        "the artifact records the platform either way)",
+    )
+    ap.add_argument("--n-devices", type=int, default=8)
     args = ap.parse_args()
+    if args.platform == "cpu":
+        from nanofed_tpu.utils.platform import force_cpu_mesh
+
+        force_cpu_mesh(args.n_devices)
     return {"dp": run_dp, "fedprox": run_fedprox, "labelskew": run_labelskew}[
         args.mode
     ](args.round_tag)

@@ -44,6 +44,13 @@ def test_benchmark_smoke(name, tmp_path):
     assert summary["rounds_completed"] >= 1
     assert "accuracy" in summary["final_eval_metrics"]
     assert summary["rounds_per_sec"] > 0
+    if name == "dp_fedavg_mnist":
+        # The CLI/experiment summary surfaces cumulative DP spend (VERDICT r2 item 6).
+        spent = summary["privacy_spent"]
+        assert spent["epsilon_spent"] > 0
+        assert 0 < spent["delta_spent"] <= 1e-5
+    else:
+        assert "privacy_spent" not in summary
 
 
 def test_unknown_benchmark_raises():
