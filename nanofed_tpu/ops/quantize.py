@@ -93,27 +93,49 @@ def dequantize_u32(
 
 
 def _mask_kernel(seed_ref, sign_ref, q_ref, out_ref):
-    # Per-block stream: seed with (caller seed, block index) so every block draws an
-    # independent deterministic stream — identical for both parties of a pair.
-    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    # Per-block stream: seed with (128-bit caller seed, block index) so every block
+    # draws an independent deterministic stream — identical for both parties of a pair.
+    pltpu.prng_seed(
+        seed_ref[0], seed_ref[1], seed_ref[2], seed_ref[3], pl.program_id(0)
+    )
     bits = pltpu.bitcast(pltpu.prng_random_bits(q_ref.shape), jnp.uint32)
     # sign +1: add mask; sign -1: subtract (uint32 wraps mod 2^32 either way).
     out_ref[:] = jnp.where(sign_ref[0] > 0, q_ref[:] + bits, q_ref[:] - bits)
+
+
+def _seed_words(seed: jax.Array) -> jax.Array:
+    """Normalize a scalar or [4]-vector seed to 4 int32 words (128-bit seed space —
+    a 32-bit seed would make the pairwise masks brute-forceable)."""
+    seed = jnp.asarray(seed, jnp.int32)
+    if seed.ndim == 0:
+        seed = jnp.stack([seed, jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+    if seed.shape != (4,):
+        raise ValueError(f"seed must be a scalar or [4] int32 vector, got {seed.shape}")
+    return seed
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def add_mask(
     q: jax.Array, seed: jax.Array, sign: jax.Array, interpret: bool | None = None
 ) -> jax.Array:
-    """Add (+1) or subtract (-1) the PRG mask expanded from ``seed`` (int32 scalar).
+    """Add (+1) or subtract (-1) the PRG mask expanded from ``seed`` (int32 scalar or
+    [4] int32 vector = 128 seed bits).
 
     Two parties calling with the same seed and opposite signs produce masks that cancel
     exactly in the uint32 sum — the pairwise SecAgg invariant, on-chip.  On non-TPU
     backends the mask comes from ``jax.random`` instead of the core PRNG (the interpreter
     has no ``prng_seed``); either way the stream is deterministic per seed *per backend*.
     """
+    words = _seed_words(seed)
     if auto_interpret(interpret):
-        mask = jax.random.bits(jax.random.key(seed.astype(jnp.uint32)), q.shape, jnp.uint32)
+        # All four seed words are folded through the threefry hash (not XOR-collapsed,
+        # which would alias distinct seeds).  NOTE: threefry2x32's keyspace is 64 bits,
+        # so this fallback is for functional testing on CPU/GPU — the security-bearing
+        # 128-bit-seeded path is the TPU kernel below.
+        folded = words.astype(jnp.uint32)
+        key = jax.random.wrap_key_data(folded[:2])
+        key = jax.random.fold_in(jax.random.fold_in(key, folded[2]), folded[3])
+        mask = jax.random.bits(key, q.shape, jnp.uint32)
         return jnp.where(sign > 0, q + mask, q - mask)
     q2, n, grid = _pad_grid(q)
     out = pl.pallas_call(
@@ -127,5 +149,5 @@ def add_mask(
         out_specs=_block_spec(),
         out_shape=jax.ShapeDtypeStruct(q2.shape, jnp.uint32),
         interpret=False,
-    )(jnp.asarray(seed, jnp.int32)[None], jnp.asarray(sign, jnp.int32)[None], q2)
+    )(words, jnp.asarray(sign, jnp.int32)[None], q2)
     return out.reshape(-1)[:n]

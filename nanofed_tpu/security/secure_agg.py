@@ -137,21 +137,36 @@ def mask_update(
     round_number: int,
     config: SecureAggregationConfig | None = None,
     weight: float = 1.0,
+    backend: str = "host",
 ) -> np.ndarray:
     """Client side: quantize ``weight · params`` and add the pairwise masks.
 
     Returns the masked flat uint32 vector to send to the server.  ``weight`` lets FedAvg
     weighting survive secure aggregation: clients pre-scale by (their weight / total) so the
     server-side sum IS the weighted mean.
+
+    ``backend="device"`` runs quantization and mask expansion on the accelerator via the
+    ``ops.quantize`` Pallas kernels — for large models this replaces several
+    host-memory passes per pair with on-chip PRNG expansion, and the masked vector
+    round-trips to the host exactly once for the wire.  The device PRNG stream differs
+    from the host Philox stream, so the WHOLE cohort must use the same backend for the
+    pairwise masks to cancel (the seeds are the same HKDF pair seeds either way; only
+    the expansion differs).  ``unmask_sum`` is stream-agnostic.
     """
     config = config or SecureAggregationConfig()
     if len(all_public_keys) < config.min_clients:
         raise AggregationError(
             f"Need at least {config.min_clients} clients, got {len(all_public_keys)}"
         )
+    ctx = f"round:{round_number}".encode()
+    if backend == "device":
+        return _mask_update_device(
+            params, client_index, my_key, all_public_keys, ctx, config, weight
+        )
+    if backend != "host":
+        raise ValueError(f"unknown backend {backend!r}; use 'host' or 'device'")
     flat, _ = tree_ravel(params)
     vec = quantize(np.asarray(flat, np.float64) * weight, config.frac_bits)
-    ctx = f"round:{round_number}".encode()
     for j, peer_pk in enumerate(all_public_keys):
         if j == client_index:
             continue
@@ -161,6 +176,36 @@ def mask_update(
         else:
             vec = vec - mask
     return vec
+
+
+def _mask_update_device(
+    params: Params,
+    client_index: int,
+    my_key: ClientKeyPair,
+    all_public_keys: Sequence[bytes],
+    ctx: bytes,
+    config: SecureAggregationConfig,
+    weight: float,
+) -> np.ndarray:
+    """Device-backend masking: ``ops.quantize`` kernels + on-core PRNG expansion.
+
+    The 256-bit HKDF pair seed is XOR-folded to the kernel's 128-bit seed (both parties
+    fold identically, so cancellation is preserved); mask bits never touch host memory.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nanofed_tpu.ops import add_mask, quantize_u32
+
+    flat, _ = tree_ravel(params)
+    vec = quantize_u32(jnp.asarray(flat, jnp.float32) * weight, config.frac_bits)
+    for j, peer_pk in enumerate(all_public_keys):
+        if j == client_index:
+            continue
+        seed = np.frombuffer(_pair_seed(my_key, peer_pk, ctx), dtype="<u4")
+        words = jnp.asarray((seed[:4] ^ seed[4:]).view(np.int32))
+        vec = add_mask(vec, words, jnp.int32(1 if j > client_index else -1))
+    return np.asarray(jax.device_get(vec))
 
 
 def unmask_sum(

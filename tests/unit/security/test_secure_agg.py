@@ -160,3 +160,73 @@ class TestTransportBox:
         a = TransportBox()
         b = TransportBox(key=a.key)
         assert b.decrypt(a.encrypt(b"x")) == b"x"
+
+
+class TestDeviceBackendMasking:
+    """backend="device": ops.quantize Pallas kernels do the quantize + PRG expansion.
+
+    Same HKDF pair seeds, different (on-core) PRNG stream — cancellation must hold
+    whenever the WHOLE cohort uses the device backend."""
+
+    def test_device_cohort_cancels_to_weighted_mean(self):
+        cfg = SecureAggregationConfig(min_clients=3, frac_bits=16)
+        params = [_client_params(i) for i in range(3)]
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        weights = np.array([3.0, 1.0, 2.0])
+        rel = weights / weights.sum()
+        masked = [
+            mask_update(params[i], i, keys[i], pks, round_number=1, config=cfg,
+                        weight=rel[i], backend="device")
+            for i in range(3)
+        ]
+        out = unmask_sum(masked, params[0], cfg)
+        expected = jax.tree.map(
+            lambda *xs: sum(w * x for w, x in zip(rel, xs)), *params
+        )
+        _tree_allclose(out, expected, atol=3 * 2**-15)
+
+    def test_device_masked_vector_hides_plaintext(self):
+        from nanofed_tpu.security.secure_agg import quantize
+
+        cfg = SecureAggregationConfig(min_clients=3, frac_bits=16)
+        params = _client_params(0)
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        masked = mask_update(params, 0, keys[0], pks, round_number=0, config=cfg,
+                             backend="device")
+        from nanofed_tpu.utils.trees import tree_ravel
+
+        flat, _ = tree_ravel(params)
+        bare = quantize(np.asarray(flat, np.float64), cfg.frac_bits)
+        assert not np.array_equal(masked, bare)
+
+    def test_mixed_backends_do_not_cancel(self):
+        # The documented contract: host and device streams differ, so a mixed cohort's
+        # masks leave residue — pin it so nobody assumes interop.
+        cfg = SecureAggregationConfig(min_clients=3, frac_bits=16)
+        params = [_client_params(i) for i in range(3)]
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        backends = ["host", "device", "device"]
+        masked = [
+            mask_update(params[i], i, keys[i], pks, round_number=0, config=cfg,
+                        weight=1 / 3, backend=backends[i])
+            for i in range(3)
+        ]
+        out = unmask_sum(masked, params[0], cfg)
+        expected = jax.tree.map(lambda *xs: sum(xs) / 3, *params)
+        leaves_close = all(
+            np.allclose(np.asarray(a), np.asarray(b), atol=1e-2)
+            for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(expected))
+        )
+        assert not leaves_close
+
+    def test_unknown_backend_raises(self):
+        import pytest
+
+        cfg = SecureAggregationConfig(min_clients=3)
+        keys = [ClientKeyPair.generate() for _ in range(3)]
+        pks = [k.public_bytes() for k in keys]
+        with pytest.raises(ValueError, match="backend"):
+            mask_update(_client_params(0), 0, keys[0], pks, 0, cfg, backend="gpu")
