@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""End-to-end SECURE federated learning over real HTTP: Bonawitz pairwise masking.
+
+The reference's secure aggregators never touch its transport (its coordinator cannot
+carry a masked round); this example runs the full honest protocol over localhost
+aiohttp — the server only ever sees uniformly-masked uint32 vectors and the cohort's
+weighted mean:
+
+    1. every client enrolls its X25519 public key + sample count  (POST /secagg/register)
+    2. clients fetch the roster: canonical order, all public keys,
+       server-computed NORMALIZED FedAvg weights                  (GET /secagg/roster)
+    3. each round: fetch global model -> local SGD -> pre-scale by
+       weight -> quantize + pairwise-mask -> submit               (POST /update, masked)
+    4. the coordinator modular-sums the cohort (masks cancel exactly in uint32),
+       dequantizes, and that IS the new global model
+
+Run:  python examples/secure_federation/run_secure.py [--port 18765] [--rounds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.communication import (
+    HTTPClient,
+    HTTPServer,
+    NetworkCoordinator,
+    NetworkRoundConfig,
+)
+from nanofed_tpu.data import federate, load_digits_dataset
+from nanofed_tpu.models import get_model
+from nanofed_tpu.security.secure_agg import (
+    ClientKeyPair,
+    SecureAggregationConfig,
+    mask_update,
+)
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.trainer.local import make_local_fit
+
+
+async def run_client(client_id: str, url: str, local_fit, data, cfg, template):
+    """One secure federated client: enroll once, then mask + submit every round."""
+    import hashlib
+
+    # Deterministic per-client RNG base (Python's str hash is salted per process).
+    client_seed = int.from_bytes(
+        hashlib.sha256(client_id.encode()).digest()[:4], "little"
+    )
+    keypair = ClientKeyPair.generate()
+    num_samples = float(np.asarray(data.mask).sum())
+    async with HTTPClient(url, client_id, timeout_s=60) as client:
+        assert await client.register_secagg(keypair.public_bytes(), num_samples)
+        roster = await client.fetch_secagg_roster(timeout_s=60)
+        print(f"  {client_id}: enrolled; weight={roster.weights[client_id]:.3f}")
+        while True:
+            try:
+                params, rnd, active = await client.fetch_global_model(like=template)
+            except Exception:
+                await asyncio.sleep(0.05)
+                continue
+            if not active:
+                return
+            result = local_fit(jax.tree.map(jnp.asarray, params), data,
+                               jax.random.fold_in(jax.random.key(client_seed), rnd))
+            masked = mask_update(
+                result.params, roster.index_of(client_id), keypair,
+                roster.ordered_keys(), rnd, cfg, weight=roster.weights[client_id],
+            )
+            await client.submit_masked_update(
+                masked, {"num_samples": num_samples}
+            )
+            status = await client.check_server_status()
+            while status["training_active"] and status["round"] == rnd:
+                await asyncio.sleep(0.05)
+                status = await client.check_server_status()
+            if not status["training_active"]:
+                return
+
+
+async def main(port: int, rounds: int, num_clients: int) -> None:
+    model = get_model("digits_mlp", hidden=64)
+    train = load_digits_dataset("train")
+    client_data = federate(train, num_clients=num_clients, scheme="iid",
+                           batch_size=16, seed=0)
+    training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
+    local_fit = jax.jit(make_local_fit(model.apply, training))
+    init = model.init(jax.random.key(0))
+    cfg = SecureAggregationConfig(min_clients=num_clients)
+
+    server = HTTPServer(port=port)
+    await server.start()
+    try:
+        coordinator = NetworkCoordinator(
+            server, init,
+            NetworkRoundConfig(num_rounds=rounds, min_clients=num_clients,
+                               round_timeout_s=120),
+            secure=cfg,
+        )
+        clients = [
+            run_client(
+                f"client_{i}", f"http://127.0.0.1:{port}", local_fit,
+                jax.tree.map(lambda x, i=i: x[i], client_data), cfg, init,
+            )
+            for i in range(num_clients)
+        ]
+        await asyncio.gather(coordinator.run(), *clients)
+        print("\nround history:")
+        for h in coordinator.history:
+            print(f"  {h}")
+        # Held-out sanity: the securely-aggregated global model actually learned.
+        test = load_digits_dataset("test")
+        logits = model.apply(coordinator.params, jnp.asarray(test.x))
+        acc = float((jnp.argmax(logits, -1) == jnp.asarray(test.y)).mean())
+        print(f"\nheld-out accuracy of the securely-aggregated model: {acc:.4f}")
+    finally:
+        await server.stop()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=18765)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    args = ap.parse_args()
+    asyncio.run(main(args.port, args.rounds, args.clients))

@@ -203,7 +203,13 @@ def _mask_update_device(
         if j == client_index:
             continue
         seed = np.frombuffer(_pair_seed(my_key, peer_pk, ctx), dtype="<u4")
-        words = jnp.asarray((seed[:4] ^ seed[4:]).view(np.int32))
+        # Endian-independent two's-complement centering (a .view would reinterpret in
+        # NATIVE byte order and break cross-endian mask cancellation — the invariant
+        # _prg_uint32 pins for the host path).
+        folded = (seed[:4] ^ seed[4:]).astype(np.int64)
+        words = jnp.asarray(
+            np.where(folded >= 1 << 31, folded - (1 << 32), folded).astype(np.int32)
+        )
         vec = add_mask(vec, words, jnp.int32(1 if j > client_index else -1))
     return np.asarray(jax.device_get(vec))
 

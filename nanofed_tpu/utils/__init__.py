@@ -7,6 +7,7 @@ stack is built on.
 
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import LogConfig, Logger, log_exec
+from nanofed_tpu.utils.profiling import annotate, device_time, trace
 from nanofed_tpu.utils.trees import (
     tree_add,
     tree_cast,
@@ -28,7 +29,10 @@ from nanofed_tpu.utils.trees import (
 __all__ = [
     "Logger",
     "LogConfig",
+    "annotate",
+    "device_time",
     "log_exec",
+    "trace",
     "get_current_time",
     "tree_add",
     "tree_cast",

@@ -21,7 +21,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -29,17 +28,11 @@ sys.path.insert(0, str(REPO))
 
 
 def time_fn(fn, *args, reps: int = 7) -> float:
-    """Median wall-clock seconds of ``fn(*args)`` (blocked), after one warm-up."""
-    import jax
-    import numpy as np
+    """Median wall-clock seconds of ``fn(*args)`` (blocked), after one warm-up —
+    thin wrapper over the shared ``utils.profiling.device_time`` discipline."""
+    from nanofed_tpu.utils.profiling import device_time
 
-    jax.block_until_ready(fn(*args))
-    times = []
-    for _ in range(reps):
-        t = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t)
-    return float(np.median(times))
+    return device_time(lambda: fn(*args), reps=reps)["median_s"]
 
 
 def main() -> int:

@@ -1,0 +1,31 @@
+"""Profiling helpers: trace capture produces artifacts; device_time measures honestly."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from nanofed_tpu.utils.profiling import annotate, device_time, trace
+
+
+def test_device_time_orders_and_excludes_compile():
+    calls = []
+
+    @jax.jit
+    def f(x):
+        return (x * 2).sum()
+
+    x = jnp.ones((64,))
+    stats = device_time(lambda: (calls.append(1), f(x))[1], reps=4)
+    # warm-up + 4 timed reps
+    assert len(calls) == 5
+    assert 0 < stats["min_s"] <= stats["median_s"] <= stats["max_s"]
+
+
+def test_trace_writes_artifacts(tmp_path):
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((32, 32))
+    with trace(tmp_path):
+        with annotate("span"):
+            jax.block_until_ready(f(x))
+    assert list(Path(tmp_path).rglob("*")), "no trace artifacts written"
