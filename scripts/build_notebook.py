@@ -87,16 +87,33 @@ round (`privacy_epsilon` in the metrics).""",
 up at the next round — resume is integrated into the engine (the reference ships a
 recovery module but never wires it in).""",
     # 9
+    """## 8. Privacy calibration: pick σ for your budget, not by hand
+
+The reference makes users choose a noise multiplier and hope; here
+`noise_multiplier_for_budget` inverts the tight RDP accountant — give it (ε, δ) and the
+round count, get the smallest σ that stays within budget.""",
+    # 10
+    """## 9. Secure aggregation over a REAL network
+
+The masked round end-to-end on localhost aiohttp: clients enroll X25519 keys, fetch the
+roster (canonical order + server-computed normalized weights), pre-scale + quantize +
+pairwise-mask their update, and POST the masked uint32 vector. The server modular-sums —
+the pairwise masks cancel *exactly* — and dequantizes the cohort's weighted mean. It
+never sees an individual update. (This is the single-round no-dropout Bonawitz variant;
+a missing client fails the round closed.)""",
+    # 11
     """## Where to go next
 
 - **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
   (`nanofed-tpu bench mnist_1000`); `compute_dtype="bfloat16"` engages the MXU.
+  Measured on ONE real v5e chip: 0.75 s for a 1000-client round (`runs/bench_tpu_r03.json`).
 - **Real networks**: `nanofed_tpu.communication` has a binary-payload HTTP server/client
-  with RSA-PSS-signed updates for true cross-device federation.
-- **Secure aggregation**: `nanofed_tpu.security.secure_agg` implements honest Bonawitz
-  pairwise masking (X25519 + HKDF + Shamir).
+  with RSA-PSS-signed updates; `examples/secure_federation/run_secure.py` is the full
+  secure-aggregation protocol as a runnable script.
+- **Profiling**: `nanofed_tpu.utils.profiling.trace` captures TensorBoard/Perfetto
+  device traces of a round.
 - **Benchmarks**: `nanofed-tpu bench --list`; accuracy evidence in
-  `runs/accuracy_digits_r02.json`.""",
+  `runs/accuracy_digits_cnn28_r03.json` (the flagship CNN at 97.2% on real images).""",
 ]
 
 CODE = [
@@ -190,6 +207,63 @@ c2 = Coordinator(model=model, train_data=client_data,
                  training=training, state_store=FileStateStore("runs/tutorial_ckpt"))
 resumed = c2.run()
 print("resumed coordinator ran rounds:", [m.round_id for m in resumed])""",
+    # I (after MD 9)
+    """from nanofed_tpu.privacy.accounting import RDPAccountant, noise_multiplier_for_budget
+
+rounds = 10
+sigma = noise_multiplier_for_budget(epsilon=8.0, delta=1e-5,
+                                    sampling_rate=1.0, num_events=rounds)
+print(f"calibrated sigma for (eps=8, delta=1e-5) over {rounds} rounds: {sigma:.4f}")
+
+acc = RDPAccountant()
+acc.add_noise_event(sigma, 1.0, count=rounds)
+print(f"spend check: eps={acc.get_privacy_spent(1e-5).epsilon_spent:.4f} <= 8.0")""",
+    # J (after MD 10)
+    """import asyncio, numpy as np
+from nanofed_tpu.communication import (HTTPClient, HTTPServer,
+                                       NetworkCoordinator, NetworkRoundConfig)
+from nanofed_tpu.security.secure_agg import (ClientKeyPair, SecureAggregationConfig,
+                                             mask_update)
+
+cfg = SecureAggregationConfig(min_clients=3)
+init = model.init(jax.random.key(0))
+local = {f"c{i}": model.init(jax.random.key(10 + i)) for i in range(3)}
+
+async def secure_client(cid, n_samples):
+    kp = ClientKeyPair.generate()
+    async with HTTPClient("http://127.0.0.1:18712", cid, timeout_s=30) as c:
+        await c.register_secagg(kp.public_bytes(), n_samples)
+        roster = await c.fetch_secagg_roster()
+        while True:
+            try:
+                params, rnd, active = await c.fetch_global_model(like=init)
+                break
+            except Exception:
+                await asyncio.sleep(0.05)
+        masked = mask_update(local[cid], roster.index_of(cid), kp,
+                             roster.ordered_keys(), rnd, cfg,
+                             weight=roster.weights[cid])
+        await c.submit_masked_update(masked, {"num_samples": n_samples})
+
+async def secure_round():
+    server = HTTPServer(port=18712)
+    await server.start()
+    try:
+        nc = NetworkCoordinator(server, init,
+                                NetworkRoundConfig(num_rounds=1, min_clients=3,
+                                                   round_timeout_s=30),
+                                secure=cfg)
+        await asyncio.gather(nc.run(), secure_client("c0", 30.0),
+                             secure_client("c1", 10.0), secure_client("c2", 20.0))
+        return nc
+    finally:
+        await server.stop()
+
+nc = await secure_round()
+print("history:", nc.history)
+delta = jax.tree.map(lambda a, b: float(np.abs(np.asarray(a - b)).max()),
+                     nc.params, init)
+print("aggregate moved (max |leaf delta|):", delta)""",
 ]
 
 
@@ -198,11 +272,12 @@ def build() -> nbf.NotebookNode:
     nb.metadata["kernelspec"] = {"name": "python3", "display_name": "Python 3",
                                  "language": "python"}
     cells = [nbf.v4.new_markdown_cell(MD[0])]
-    pairs = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4), (6, 5), (7, 6), (8, 7)]
+    pairs = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4), (6, 5), (7, 6), (8, 7),
+             (9, 8), (10, 9)]
     for md_i, code_i in pairs:
         cells.append(nbf.v4.new_markdown_cell(MD[md_i]))
         cells.append(nbf.v4.new_code_cell(CODE[code_i]))
-    cells.append(nbf.v4.new_markdown_cell(MD[9]))
+    cells.append(nbf.v4.new_markdown_cell(MD[11]))
     nb.cells = cells
     return nb
 
