@@ -2,8 +2,10 @@
 
 The reference declares a CLI entry point that doesn't exist (``pyproject.toml:22-23`` names
 ``nanofed.cli:main`` but no module is shipped — SURVEY.md layer-map quirks).  This one is
-real: ``nanofed-tpu run`` drives a federated training run, ``info`` prints environment and
-model-zoo facts.
+real: ``run`` drives a simulated federated experiment (``--dp-epsilon`` engages
+budget-calibrated central DP), ``serve`` hosts the real-network federation server
+(``--secure`` for masked rounds, ``--validate`` for update validation), ``bench`` runs
+the BASELINE.json suite, ``info`` prints environment and model-zoo facts.
 """
 
 from __future__ import annotations
@@ -37,6 +39,32 @@ def _cmd_info(_args: argparse.Namespace) -> int:
 def _cmd_run(args: argparse.Namespace) -> int:
     from nanofed_tpu.experiments import run_experiment
 
+    central_privacy = None
+    if args.dp_epsilon is not None:
+        from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+        from nanofed_tpu.privacy import PrivacyConfig
+        from nanofed_tpu.privacy.accounting import noise_multiplier_for_budget
+
+        try:
+            sigma = noise_multiplier_for_budget(
+                args.dp_epsilon, args.dp_delta, sampling_rate=1.0,
+                num_events=args.rounds,
+            )
+            central_privacy = PrivacyAwareAggregationConfig(
+                privacy=PrivacyConfig(
+                    epsilon=args.dp_epsilon, delta=args.dp_delta,
+                    max_gradient_norm=args.dp_clip, noise_multiplier=sigma,
+                )
+            )
+        except ValueError as e:
+            # Config bounds (eps in [0.01, 10], delta in [1e-10, 0.1]) or an
+            # infeasible budget — a CLI error, not a traceback.
+            print(f"error: invalid DP budget: {e}", file=sys.stderr)
+            return 2
+        print(f"# central DP: sigma={sigma:.4f} calibrated for "
+              f"(eps={args.dp_epsilon}, delta={args.dp_delta}) over {args.rounds} "
+              "rounds (tight RDP accounting)", file=sys.stderr)
+
     metrics = run_experiment(
         model=args.model,
         num_clients=args.clients,
@@ -52,9 +80,71 @@ def _cmd_run(args: argparse.Namespace) -> int:
         train_size=args.train_size,
         client_chunk=args.client_chunk,
         compute_dtype=args.dtype,
+        central_privacy=central_privacy,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Host a real-network federation server (the reference's HTTPServer+Coordinator
+    pair, ``examples/mnist/run_experiment.py:89-131``, as one command)."""
+    import asyncio
+
+    import jax
+
+    from nanofed_tpu.communication import HTTPServer, NetworkCoordinator, NetworkRoundConfig
+    from nanofed_tpu.models import get_model
+
+    if args.secure and args.validate:
+        # Masked vectors are unvalidatable by construction (uniform uint32); a server
+        # operator must not believe norm/z-score checks run when they cannot.
+        print("error: --validate cannot be combined with --secure — masked updates "
+              "are indistinguishable from noise; range enforcement in secure mode "
+              "comes from quantization bounds and client-side DP clipping",
+              file=sys.stderr)
+        return 2
+
+    model = get_model(args.model)
+    params = model.init(jax.random.key(args.seed))
+    secure = None
+    if args.secure:
+        from nanofed_tpu.security.secure_agg import SecureAggregationConfig
+
+        secure = SecureAggregationConfig(min_clients=args.min_clients)
+    validation = None
+    if args.validate:
+        from nanofed_tpu.security.validation import ValidationConfig
+
+        validation = ValidationConfig(max_norm=args.max_norm)
+
+    async def serve() -> list[dict]:
+        server = HTTPServer(host=args.host, port=args.port)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, params,
+                NetworkRoundConfig(
+                    num_rounds=args.rounds,
+                    min_clients=args.min_clients,
+                    min_completion_rate=args.completion_rate,
+                    round_timeout_s=args.timeout,
+                ),
+                validation=validation,
+                secure=secure,
+            )
+            return await coordinator.run()
+        finally:
+            await server.stop()
+
+    try:
+        history = asyncio.run(serve())
+    except TimeoutError as e:
+        # Cohort never completed enrollment: keep the JSON-output contract.
+        print(json.dumps([{"status": "FAILED", "error": str(e)}]))
+        return 1
+    print(json.dumps(history, indent=2, default=str))
+    return 0 if all(h["status"] == "COMPLETED" for h in history) else 1
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
@@ -108,6 +198,39 @@ def main(argv: list[str] | None = None) -> int:
         "--dtype", default=None, choices=["bfloat16", "float32"],
         help="local-training compute dtype (mixed precision when bfloat16)",
     )
+    run.add_argument(
+        "--dp-epsilon", type=float, default=None,
+        help="enable central DP-FedAvg with noise CALIBRATED to this epsilon budget "
+        "over the run's rounds (tight RDP accounting); spend is reported per round "
+        "and in the summary",
+    )
+    run.add_argument("--dp-delta", type=float, default=1e-5)
+    run.add_argument("--dp-clip", type=float, default=1.0,
+                     help="central-DP per-update clip norm C")
+
+    serve = sub.add_parser(
+        "serve", help="host a real-network federation server (binary HTTP transport)"
+    )
+    serve.add_argument("--model", default="mnist_cnn")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=8080)
+    serve.add_argument("--rounds", type=int, default=2)
+    serve.add_argument("--min-clients", type=int, default=1)
+    serve.add_argument("--completion-rate", type=float, default=1.0)
+    serve.add_argument("--timeout", type=float, default=300.0)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--secure", action="store_true",
+        help="secure-aggregation rounds: clients enroll via /secagg and submit "
+        "pairwise-masked updates; the server only ever sees the cohort sum",
+    )
+    serve.add_argument(
+        "--validate", action="store_true",
+        help="validate every drained update (shape / finite / norm / cohort z-score); "
+        "invalid clients are dropped from the round",
+    )
+    serve.add_argument("--max-norm", type=float, default=100.0,
+                       help="per-leaf norm cap for --validate")
 
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
@@ -123,6 +246,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_info(args)
     if args.cmd == "bench":
         return _cmd_bench(args)
+    if args.cmd == "serve":
+        return _cmd_serve(args)
     return _cmd_run(args)
 
 

@@ -219,11 +219,15 @@ acc = RDPAccountant()
 acc.add_noise_event(sigma, 1.0, count=rounds)
 print(f"spend check: eps={acc.get_privacy_spent(1e-5).epsilon_spent:.4f} <= 8.0")""",
     # J (after MD 10)
-    """import asyncio, numpy as np
+    """import asyncio, socket, numpy as np
 from nanofed_tpu.communication import (HTTPClient, HTTPServer,
                                        NetworkCoordinator, NetworkRoundConfig)
 from nanofed_tpu.security.secure_agg import (ClientKeyPair, SecureAggregationConfig,
                                              mask_update)
+
+with socket.socket() as s:      # pick a free port (portable notebook)
+    s.bind(("127.0.0.1", 0))
+    PORT = s.getsockname()[1]
 
 cfg = SecureAggregationConfig(min_clients=3)
 init = model.init(jax.random.key(0))
@@ -231,22 +235,24 @@ local = {f"c{i}": model.init(jax.random.key(10 + i)) for i in range(3)}
 
 async def secure_client(cid, n_samples):
     kp = ClientKeyPair.generate()
-    async with HTTPClient("http://127.0.0.1:18712", cid, timeout_s=30) as c:
-        await c.register_secagg(kp.public_bytes(), n_samples)
+    async with HTTPClient(f"http://127.0.0.1:{PORT}", cid, timeout_s=30) as c:
+        assert await c.register_secagg(kp.public_bytes(), n_samples)
         roster = await c.fetch_secagg_roster()
-        while True:
-            try:
+        for _ in range(200):                      # bounded: a failed round must error,
+            try:                                  # not hang the notebook
                 params, rnd, active = await c.fetch_global_model(like=init)
                 break
             except Exception:
                 await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never published")
         masked = mask_update(local[cid], roster.index_of(cid), kp,
                              roster.ordered_keys(), rnd, cfg,
                              weight=roster.weights[cid])
         await c.submit_masked_update(masked, {"num_samples": n_samples})
 
 async def secure_round():
-    server = HTTPServer(port=18712)
+    server = HTTPServer(port=PORT)
     await server.start()
     try:
         nc = NetworkCoordinator(server, init,
