@@ -159,16 +159,34 @@ class HTTPClient:
 
     async def register_secagg(self, public_key: bytes, num_samples: float) -> bool:
         """Enroll in the secure-aggregation cohort with this client's X25519 public key
-        and its FedAvg sample count."""
+        and its FedAvg sample count.  With a ``security_manager``, the enrollment is
+        RSA-PSS-signed over the server's per-cohort session nonce (fetched from the
+        roster endpoint first) — required by ``require_signatures=True`` servers, and
+        what makes a captured enrollment unreplayable into a later cohort."""
         import base64
 
         session = self._require_session()
         url = self.server_url + self.endpoints.secagg_register
+        headers = {HEADER_CLIENT: self.client_id}
+        if self.security_manager is not None:
+            async with session.get(
+                self.server_url + self.endpoints.secagg_roster
+            ) as resp:
+                if resp.status != 200:
+                    self._log.warning(
+                        "secagg session fetch rejected (HTTP %d)", resp.status
+                    )
+                    return False
+                cohort_session = (await resp.json()).get("session", "")
+            signature = self.security_manager.sign_enrollment(
+                self.client_id, public_key, num_samples, cohort_session
+            )
+            headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
         async with session.post(
             url,
             json={"public_key": base64.b64encode(public_key).decode(),
                   "num_samples": num_samples},
-            headers={HEADER_CLIENT: self.client_id},
+            headers=headers,
         ) as resp:
             if resp.status != 200:
                 self._log.warning("secagg registration rejected (HTTP %d)", resp.status)

@@ -88,6 +88,7 @@ class HTTPServer:
         # client, opened by the round engine, and a separate buffer for masked payloads
         # (they are uniform uint32 vectors, not decodable params).
         self._secagg_expected: int | None = None
+        self._secagg_session: str = ""
         self._secagg_roster: dict[str, dict[str, Any]] = {}
         self._masked_updates: dict[str, tuple[Any, dict[str, Any]]] = {}
         self._app = web.Application(client_max_size=max_request_size)
@@ -141,8 +142,14 @@ class HTTPServer:
         ``expected_clients``.  Clients register their X25519 public key + sample count
         via POST ``/secagg/register``; the roster endpoint reports ``complete`` once all
         have.  The cohort is fixed for the whole training run (masks are re-derived per
-        round from the round number, so one enrollment covers every round)."""
+        round from the round number, so one enrollment covers every round).
+
+        A fresh random session nonce is issued per call; signed enrollments bind to it,
+        so captured enrollments from an earlier session cannot be replayed here."""
+        import secrets
+
         self._secagg_expected = int(expected_clients)
+        self._secagg_session = secrets.token_hex(16)
         self._secagg_roster.clear()
         self._masked_updates.clear()
 
@@ -306,10 +313,45 @@ class HTTPServer:
             )
         return None
 
+    async def _check_signature(
+        self, request: web.Request, client_id: str, verify: Any, *verify_args: Any
+    ) -> web.StreamResponse | None:
+        """Shared signature-enforcement plumbing: registered-key lookup, tolerant
+        base64 decode, threaded RSA verify, warn + 403 on failure.  ``verify`` is the
+        module-level verifier whose trailing arguments are ``(signature, pem)``.
+        Returns the error response, or None when the signature checks out."""
+        import base64
+
+        pem = self.client_keys.get(client_id)
+        if pem is None:
+            return web.json_response(
+                {"status": "error", "message": f"unknown client {client_id!r}"},
+                status=403,
+            )
+        try:
+            signature = base64.b64decode(request.headers.get(HEADER_SIGNATURE, ""))
+        except Exception:
+            signature = b""
+        ok = signature and await asyncio.to_thread(verify, *verify_args, signature, pem)
+        if not ok:
+            self._log.warning("invalid signature from %s on %s", client_id,
+                              request.path)
+            return web.json_response(
+                {"status": "error", "message": "invalid signature"}, status=403
+            )
+        return None
+
     async def _handle_secagg_register(self, request: web.Request) -> web.StreamResponse:
         """Enroll one client in the secure-aggregation cohort: X25519 public key (for
-        pairwise mask agreement) + sample count (for server-computed FedAvg weights)."""
+        pairwise mask agreement) + sample count (for server-computed FedAvg weights).
+
+        Re-registration is IDEMPOTENT-ONLY: the identical payload returns 200 (safe
+        retry), but a changed key/count for an enrolled id is a 409 — a mid-session
+        key swap (including a replayed enrollment from an earlier session) would
+        silently break pairwise-mask cancellation for everyone who already fetched
+        the roster."""
         import base64
+        import math
 
         client_id = request.headers.get(HEADER_CLIENT)
         if not client_id:
@@ -324,17 +366,41 @@ class HTTPServer:
             body = await request.json()
             public_key = base64.b64decode(body["public_key"])
             num_samples = float(body["num_samples"])
-            if len(public_key) != 32 or not (num_samples > 0):
-                raise ValueError("bad key length or non-positive sample count")
+            if len(public_key) != 32:
+                raise ValueError("bad key length")
+            if not (math.isfinite(num_samples) and num_samples > 0):
+                # Infinity would make every honest weight num/inf = 0 at the roster.
+                raise ValueError("sample count must be finite and positive")
         except Exception as e:
             return web.json_response(
                 {"status": "error", "message": f"bad registration: {e}"}, status=400
             )
+        if self.require_signatures:
+            # Enrollment must be as authentic as updates: an unsigned register would
+            # let anyone claim a cohort slot (and its mask identity) for a known id.
+            # The signature binds this server's session nonce against replay.
+            from nanofed_tpu.security.signing import verify_enrollment_signature
+
+            verdict = await self._check_signature(
+                request, client_id, verify_enrollment_signature,
+                client_id, public_key, num_samples, self._secagg_session,
+            )
+            if verdict is not None:
+                return verdict
         async with self._lock:
-            if (
-                client_id not in self._secagg_roster
-                and len(self._secagg_roster) >= self._secagg_expected
-            ):
+            existing = self._secagg_roster.get(client_id)
+            if existing is not None:
+                if (existing["public_key"] == public_key
+                        and existing["num_samples"] == num_samples):
+                    return web.json_response(
+                        {"status": "success", "message": "already enrolled"}
+                    )
+                return web.json_response(
+                    {"status": "error",
+                     "message": "already enrolled with a different key/count"},
+                    status=409,
+                )
+            if len(self._secagg_roster) >= self._secagg_expected:
                 return web.json_response(
                     {"status": "error", "message": "cohort is full"}, status=403
                 )
@@ -362,6 +428,7 @@ class HTTPServer:
             "complete": complete,
             "expected": self._secagg_expected,
             "enrolled": len(self._secagg_roster),
+            "session": self._secagg_session,
         }
         if complete:
             order = self.secagg_client_order()
@@ -400,30 +467,14 @@ class HTTPServer:
             )
         body = await request.read()
         if self.require_signatures:
-            import base64
-
             from nanofed_tpu.security.signing import verify_masked_signature
 
-            pem = self.client_keys.get(client_id)
-            if pem is None:
-                return web.json_response(
-                    {"status": "error", "message": f"unknown client {client_id!r}"},
-                    status=403,
-                )
-            try:
-                signature = base64.b64decode(request.headers.get(HEADER_SIGNATURE, ""))
-            except Exception:
-                signature = b""
-            metrics_json = request.headers.get(HEADER_METRICS, "{}")
-            ok = signature and await asyncio.to_thread(
-                verify_masked_signature, body, client_id, round_number, metrics_json,
-                signature, pem,
+            verdict = await self._check_signature(
+                request, client_id, verify_masked_signature,
+                body, client_id, round_number, request.headers.get(HEADER_METRICS, "{}"),
             )
-            if not ok:
-                self._log.warning("invalid masked-update signature from %s", client_id)
-                return web.json_response(
-                    {"status": "error", "message": "invalid signature"}, status=403
-                )
+            if verdict is not None:
+                return verdict
         try:
             with np.load(io.BytesIO(body)) as z:
                 masked = z["masked"]

@@ -125,6 +125,44 @@ def verify_masked_signature(
     )
 
 
+def enrollment_signing_bytes(client_id: str, x25519_public_key: bytes,
+                             num_samples: float, session: str) -> bytes:
+    """Byte string a secure-aggregation ENROLLMENT signature covers.
+
+    Without this, a server enforcing signatures on updates would still accept a forged
+    ``/secagg/register`` — an attacker who knows a client id could claim its cohort
+    slot with their own X25519 key (denying the real client, or setting up a masked
+    submission under the stolen identity).  The signature binds the identity to the
+    mask key, the claimed sample count, AND the server's per-``open_secagg`` session
+    nonce — a captured signed enrollment from an earlier run cannot be replayed into a
+    live cohort (a stale key splice would silently break mask cancellation).
+    """
+    import base64
+
+    return (
+        f"enroll:session={session}"
+        f"&client={client_id}&x25519={base64.b64encode(x25519_public_key).decode()}"
+        f"&num_samples={float(num_samples)!r}"  # normalized: int 10 and float 10.0
+        # must sign identically, since JSON round-trips both to float
+    ).encode()
+
+
+def verify_enrollment_signature(
+    client_id: str,
+    x25519_public_key: bytes,
+    num_samples: float,
+    session: str,
+    signature: bytes,
+    public_key: bytes,
+) -> bool:
+    """Verify a secure-aggregation enrollment (see :func:`enrollment_signing_bytes`)."""
+    return _verify_bytes(
+        enrollment_signing_bytes(client_id, x25519_public_key, num_samples, session),
+        signature,
+        public_key,
+    )
+
+
 class SecurityManager:
     """Holds this party's RSA keypair; signs outgoing and verifies incoming updates.
 
@@ -161,6 +199,16 @@ class SecurityManager:
         """Sign a masked (secure-aggregation) update body with its replay-protection
         context (see :func:`masked_signing_bytes`)."""
         data = masked_signing_bytes(body, client_id, round_number, metrics_json)
+        return self._private_key.sign(data, _PSS, hashes.SHA256())
+
+    def sign_enrollment(
+        self, client_id: str, x25519_public_key: bytes, num_samples: float,
+        session: str,
+    ) -> bytes:
+        """Sign a secure-aggregation enrollment (see :func:`enrollment_signing_bytes`)."""
+        data = enrollment_signing_bytes(
+            client_id, x25519_public_key, num_samples, session
+        )
         return self._private_key.sign(data, _PSS, hashes.SHA256())
 
     def verify_signature(self, params: Params, signature: bytes, public_key: bytes) -> bool:

@@ -210,8 +210,9 @@ def run_labelskew(tag: str) -> int:
         "data_note": "synthetic MNIST-shaped data (class-prototype Gaussians) — "
                      "MNIST unfetchable here; mechanics under test are the 100-client "
                      "label-skew partition + C=0.1 participation"
-                     + ("" if on_tpu else " (dataset scaled to 12k samples for the "
-                        "1-core CPU mesh; full 60k on TPU)"),
+                     + ("" if on_tpu else " (scaled for the 1-core CPU mesh: 12k "
+                        "samples and 6 rounds vs the full config's 60k/8; full "
+                        "scale on TPU)"),
         "real_data": False,
         "summary": {k: v for k, v in summary.items() if k != "devices"},
         "platform": str(jax.devices()[0].platform),

@@ -65,8 +65,11 @@ def test_serve_secure_round(capsys):
         kp = ClientKeyPair.generate()
         async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as c:
             for _ in range(200):
-                if await c.register_secagg(kp.public_bytes(), 10.0):
-                    break
+                try:
+                    if await c.register_secagg(kp.public_bytes(), 10.0):
+                        break
+                except OSError:
+                    pass  # server thread still binding the port
                 await asyncio.sleep(0.05)
             roster = await c.fetch_secagg_roster()
             params = None

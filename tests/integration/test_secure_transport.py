@@ -282,10 +282,9 @@ def test_forged_masked_update_rejected_under_signatures():
 
     async def run_client(cid: str, forge: bool):
         keypair = ClientKeyPair.generate()
-        manager = None if forge else managers[cid]
         async with HTTPClient(
             f"http://127.0.0.1:{PORT + 4}", cid, timeout_s=10,
-            security_manager=manager,
+            security_manager=managers[cid],
         ) as client:
             assert await client.register_secagg(keypair.public_bytes(), 10.0)
             roster = await client.fetch_secagg_roster()
@@ -294,6 +293,10 @@ def test_forged_masked_update_rejected_under_signatures():
                 _client_params(model, 7), roster.index_of(cid), keypair,
                 roster.ordered_keys(), rnd, cfg, weight=roster.weights[cid],
             )
+            if forge:
+                # Enrolled legitimately, then submits WITHOUT signing (e.g. a stolen
+                # session replaying through a different stack).
+                client.security_manager = None
             ok = await client.submit_masked_update(masked, {})
             rejected[cid] = not ok
 
@@ -327,3 +330,79 @@ def test_forged_masked_update_rejected_under_signatures():
     assert coordinator.history[0]["status"] == "FAILED"
     for got, want in zip(jax.tree.leaves(coordinator.params), jax.tree.leaves(init)):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_unsigned_enrollment_rejected_under_signatures():
+    """require_signatures gates ENROLLMENT too: an attacker who knows a client id
+    cannot claim its cohort slot (and mask identity) with an unsigned register."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from nanofed_tpu.security.signing import SecurityManager
+
+    manager = SecurityManager(key_size=1024)
+
+    async def scenario():
+        import base64
+
+        server = HTTPServer(
+            port=0, client_keys={"c1": manager.get_public_key()},
+            require_signatures=True,
+        )
+        client = TestClient(TestServer(server._app))
+        await client.start_server()
+        try:
+            server.open_secagg(1)
+            session = (await (await client.get("/secagg/roster")).json())["session"]
+            pk = bytes(32)
+            body = {"public_key": base64.b64encode(pk).decode(), "num_samples": 10.0}
+            # Unsigned -> 403; unknown id -> 403; correctly signed -> 200.
+            r = await client.post("/secagg/register", json=body,
+                                  headers={"X-NanoFed-Client": "c1"})
+            assert r.status == 403
+            r = await client.post("/secagg/register", json=body,
+                                  headers={"X-NanoFed-Client": "intruder"})
+            assert r.status == 403
+            sig = base64.b64encode(
+                manager.sign_enrollment("c1", pk, 10.0, session)).decode()
+            r = await client.post("/secagg/register", json=body,
+                                  headers={"X-NanoFed-Client": "c1",
+                                           "X-NanoFed-Signature": sig})
+            assert r.status == 200
+            # Idempotent retry: identical signed payload (int/float sample counts
+            # sign identically — JSON round-trips both to float) -> 200.
+            sig2 = base64.b64encode(
+                manager.sign_enrollment("c1", pk, 10, session)).decode()
+            r = await client.post("/secagg/register", json=body,
+                                  headers={"X-NanoFed-Client": "c1",
+                                           "X-NanoFed-Signature": sig2})
+            assert r.status == 200
+            # REPLAY into a fresh cohort: the old signature no longer verifies
+            # (bound to the previous session nonce).
+            server.open_secagg(1)
+            r = await client.post("/secagg/register", json=body,
+                                  headers={"X-NanoFed-Client": "c1",
+                                           "X-NanoFed-Signature": sig})
+            assert r.status == 403
+            # A DIFFERENT key for an enrolled id is refused even when validly signed
+            # (mid-session key swap would break mask cancellation).
+            server.open_secagg(1)
+            session3 = (await (await client.get("/secagg/roster")).json())["session"]
+            sig3 = base64.b64encode(
+                manager.sign_enrollment("c1", pk, 10.0, session3)).decode()
+            assert (await client.post("/secagg/register", json=body,
+                                      headers={"X-NanoFed-Client": "c1",
+                                               "X-NanoFed-Signature": sig3})).status == 200
+            pk2 = bytes(31) + b"x"
+            body2 = {"public_key": base64.b64encode(pk2).decode(), "num_samples": 10.0}
+            sig4 = base64.b64encode(
+                manager.sign_enrollment("c1", pk2, 10.0, session3)).decode()
+            r = await client.post("/secagg/register", json=body2,
+                                  headers={"X-NanoFed-Client": "c1",
+                                           "X-NanoFed-Signature": sig4})
+            assert r.status == 409
+        finally:
+            await client.close()
+
+    aio.new_event_loop().run_until_complete(scenario())

@@ -181,3 +181,26 @@ def test_digits_mlp_experiment_path(tmp_path):
                          out_dir=tmp_path)
     assert out["rounds_completed"] == 2
     assert out["final_eval_metrics"]["accuracy"] > 0.5
+
+
+class TestResizeImages:
+    def test_upsample_shapes_and_labels(self):
+        from nanofed_tpu.data import load_digits_dataset
+        from nanofed_tpu.data.datasets import resize_images
+
+        ds = load_digits_dataset("train")
+        up = resize_images(ds, 28, 28)
+        assert up.x.shape == (len(ds), 28, 28, 1)
+        assert up.x.dtype == np.float32
+        np.testing.assert_array_equal(up.y, ds.y)
+        assert up.name == "digits@28x28"
+        # Bilinear interpolation cannot exceed the source intensity range.
+        assert up.x.min() >= ds.x.min() - 1e-6 and up.x.max() <= ds.x.max() + 1e-6
+
+    def test_identity_resize_is_lossless(self):
+        from nanofed_tpu.data import load_digits_dataset
+        from nanofed_tpu.data.datasets import resize_images
+
+        ds = load_digits_dataset("test")
+        same = resize_images(ds, 8, 8)
+        np.testing.assert_allclose(same.x, ds.x, atol=1e-6)
