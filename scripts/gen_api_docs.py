@@ -49,8 +49,9 @@ MODULES = [
                        "nanofed_tpu.communication.network_coordinator"]),
     ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",
              "nanofed_tpu.ops.quantize"]),
-    ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.trees",
-               "nanofed_tpu.utils.platform", "nanofed_tpu.utils.dates"]),
+    ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.profiling",
+               "nanofed_tpu.utils.trees", "nanofed_tpu.utils.platform",
+               "nanofed_tpu.utils.dates"]),
     ("top-level", ["nanofed_tpu.experiments", "nanofed_tpu.benchmarks",
                    "nanofed_tpu.cli"]),
 ]
