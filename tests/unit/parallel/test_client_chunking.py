@@ -190,3 +190,53 @@ def test_streamed_zero_weight_round_is_noop(devices):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(jax.tree.leaves(res.server_opt_state), jax.tree.leaves(sos)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_streaming_equivalence_property_sweep(devices, seed):
+    """Property-style sweep (the reference's hand-rolled-property pattern,
+    tests/unit/privacy/test_privacy_properties.py): for random client counts, chunk
+    sizes, weights (including zeros), and epochs, the streamed reduce equals the
+    materialized full-vmap reduce within float tolerance."""
+    rng = np.random.default_rng(seed)
+    n_dev = 8
+    per_dev = int(rng.integers(2, 5))
+    c = n_dev * per_dev
+    # Proper divisors only: chunk == per_dev would degrade to the full-vmap path and
+    # make the streamed-vs-materialized comparison vacuous.
+    divisors = [d for d in range(1, per_dev) if per_dev % d == 0]
+    chunk = int(rng.choice(divisors))
+    n, feats = 8, int(rng.integers(4, 10))
+    epochs = int(rng.integers(1, 4))
+
+    mesh = make_mesh(devices)
+    model = get_model("mlp", in_features=feats, hidden=6, num_classes=3)
+    data = shard_client_data(
+        ClientData(
+            x=jnp.asarray(rng.normal(size=(c, n, feats)), jnp.float32),
+            y=jnp.asarray(rng.integers(0, 3, size=(c, n))),
+            mask=jnp.asarray(rng.random(size=(c, n)) > 0.2, jnp.float32),
+        ),
+        mesh,
+    )
+    training = TrainingConfig(batch_size=4, local_epochs=epochs, learning_rate=0.2)
+    params = model.init(jax.random.key(seed))
+    strategy = fedavg_strategy()
+    sos = init_server_state(strategy, params)
+    weights = compute_weights(data.num_samples) * jnp.asarray(
+        rng.random(size=(c,)) > 0.25, jnp.float32
+    )
+    rngs = stack_rngs(jax.random.key(seed + 100), c)
+
+    full = build_round_step(model.apply, training, mesh, strategy)(
+        params, sos, data, weights, rngs)
+    streamed = build_round_step(model.apply, training, mesh, strategy,
+                                client_chunk=chunk)(params, sos, data, weights, rngs)
+    for a, b in zip(jax.tree.leaves(full.params), jax.tree.leaves(streamed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full.metrics["loss"]),
+                               np.asarray(streamed.metrics["loss"]),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(full.update_sq_norms),
+                               np.asarray(streamed.update_sq_norms),
+                               rtol=2e-5, atol=1e-7)
