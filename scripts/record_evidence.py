@@ -202,7 +202,11 @@ def run_labelskew(tag: str) -> int:
     on_tpu = jax.default_backend() == "tpu"
     overrides = dict(eval_every=1, num_rounds=8)
     if not on_tpu:
-        overrides.update(train_size=12_000, num_rounds=6)
+        # A 1-core CPU mesh cannot finish the CNN at 100-client scale in bounded time
+        # (measured: >3400 s even at 12k samples); the mechanics this benchmark is
+        # about — 2-class label-skew shards + C=0.1 cohort sampling over 100 clients —
+        # are model-independent, so fall back to the MLP and say so.
+        overrides.update(train_size=12_000, num_rounds=6, model="mlp")
     summary = run_benchmark("mnist_labelskew", out_dir="runs/labelskew_run", **overrides)
     _write(f"labelskew_{tag}", {
         "artifact": f"labelskew_{tag}",
@@ -210,9 +214,9 @@ def run_labelskew(tag: str) -> int:
         "data_note": "synthetic MNIST-shaped data (class-prototype Gaussians) — "
                      "MNIST unfetchable here; mechanics under test are the 100-client "
                      "label-skew partition + C=0.1 participation"
-                     + ("" if on_tpu else " (scaled for the 1-core CPU mesh: 12k "
-                        "samples and 6 rounds vs the full config's 60k/8; full "
-                        "scale on TPU)"),
+                     + ("" if on_tpu else " (scaled for the 1-core CPU mesh: MLP "
+                        "model, 12k samples, 6 rounds vs the full config's "
+                        "CNN/60k/8; full scale runs on TPU)"),
         "real_data": False,
         "summary": {k: v for k, v in summary.items() if k != "devices"},
         "platform": str(jax.devices()[0].platform),
