@@ -115,17 +115,30 @@ def record_central_privacy(
     accountant: BasePrivacyAccountant,
     config: PrivacyAwareAggregationConfig,
     num_rounds: int = 1,
+    sampling_rate: float = 1.0,
 ) -> None:
     """Account ``num_rounds`` rounds of the round step's central-DP reduce.
 
     The in-mesh mechanism is ONE Gaussian release per round: sensitivity of the uniform
     mean is C/K and the noise std is σ·C/K, so the effective noise multiplier is exactly σ
-    regardless of cohort size — one event at q=1 per round.  (Accounting it as K events
+    regardless of cohort size — one event per round.  (Accounting it as K events
     would over-report ε by ~K×.)  For the per-update host path
     (``apply_central_privacy``), account with ``central_mechanism(...).record`` instead.
+
+    ``sampling_rate`` is the client-level subsampling probability q.  When the
+    coordinator samples a random cohort each round (``participation_rate`` < 1, drawn
+    uniformly without replacement — ``orchestration/coordinator.py``), each round is a
+    subsampled Gaussian release and privacy amplification applies (Abadi et al. 2016 /
+    McMahan et al. 2018 treat the fixed-size uniform cohort as Poisson sampling at
+    q = K/N, the standard approximation).  ``RDPAccountant`` only credits amplification
+    for q ≤ 0.1 and falls back to the unamplified bound above that — conservative, never
+    over-claimed.  Client dropout after sampling only shrinks the realized cohort, so
+    accounting at the nominal q is likewise conservative.
     """
     require_gaussian_accounting(config.privacy)
-    accountant.add_noise_event(config.privacy.noise_multiplier, 1.0, count=num_rounds)
+    accountant.add_noise_event(
+        config.privacy.noise_multiplier, sampling_rate, count=num_rounds
+    )
 
 
 def epsilon_adjusted_weights(

@@ -70,11 +70,19 @@ def run_benchmark(
     config.update(overrides)
     if config.pop("dp", False):
         from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+        from nanofed_tpu.orchestration import cohort_size
         from nanofed_tpu.privacy import PrivacyConfig
+        from nanofed_tpu.privacy.accounting import noise_multiplier_for_budget
 
+        # Calibrate σ so the whole run spends exactly the (ε=8, δ=1e-5) budget at the
+        # realized cohort rate — a fixed σ would either blow the budget or waste it.
+        q = cohort_size(config["num_clients"], config["participation"]) / config["num_clients"]
+        sigma = noise_multiplier_for_budget(
+            8.0, 1e-5, sampling_rate=q, num_events=config["num_rounds"]
+        )
         config["central_privacy"] = PrivacyAwareAggregationConfig(
             privacy=PrivacyConfig(
-                epsilon=8.0, delta=1e-5, max_gradient_norm=1.0, noise_multiplier=0.5
+                epsilon=8.0, delta=1e-5, max_gradient_norm=1.0, noise_multiplier=sigma
             )
         )
     summary = run_experiment(out_dir=out_dir, **config)

@@ -45,9 +45,15 @@ def _cmd_run(args: argparse.Namespace) -> int:
         from nanofed_tpu.privacy import PrivacyConfig
         from nanofed_tpu.privacy.accounting import noise_multiplier_for_budget
 
+        from nanofed_tpu.orchestration.types import cohort_size
+
+        # Calibrate at the realized per-client inclusion probability (the coordinator
+        # accounts spend at cohort/N, which ceil+floor make >= the nominal rate) so the
+        # run actually spends the requested budget instead of over-noising.
+        cohort = cohort_size(args.clients, args.participation)
         try:
             sigma = noise_multiplier_for_budget(
-                args.dp_epsilon, args.dp_delta, sampling_rate=1.0,
+                args.dp_epsilon, args.dp_delta, sampling_rate=cohort / args.clients,
                 num_events=args.rounds,
             )
             central_privacy = PrivacyAwareAggregationConfig(

@@ -16,6 +16,7 @@ def linear(in_features: int = 10, num_classes: int = 2) -> Model:
         return {"fc": nn.dense_init(rng, in_features, num_classes)}
 
     def apply(params: Params, x: jax.Array, *, train: bool = False, rng=None) -> jax.Array:
+        x = nn.flatten(x) if x.ndim > 2 else x
         return nn.log_softmax(nn.dense(params["fc"], x))
 
     return Model(

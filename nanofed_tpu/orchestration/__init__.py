@@ -7,6 +7,7 @@ from nanofed_tpu.orchestration.types import (
     RoundMetrics,
     RoundStatus,
     TrainingProgress,
+    cohort_size,
 )
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "RoundMetrics",
     "RoundStatus",
     "TrainingProgress",
+    "cohort_size",
 ]

@@ -226,11 +226,7 @@ class Coordinator:
         host_rng = np.random.default_rng(self.config.seed * 100_003 + round_id)
 
         # --- participant sampling (replaces the HTTP wait barrier) ---
-        # ceil, per the CoordinatorConfig contract (round() would banker's-round .5 down).
-        cohort = min(
-            self.num_clients,
-            max(1, math.ceil(self.num_clients * self.config.participation_rate)),
-        )
+        cohort = self.cohort_size
         sampled = host_rng.choice(self.num_clients, size=cohort, replace=False)
         survived = sampled
         if self.config.dropout_rate > 0:
@@ -272,7 +268,11 @@ class Coordinator:
         if self.privacy_accountant is not None:
             from nanofed_tpu.aggregation.privacy import record_central_privacy
 
-            record_central_privacy(self.privacy_accountant, self.central_privacy)
+            record_central_privacy(
+                self.privacy_accountant,
+                self.central_privacy,
+                sampling_rate=self.cohort_size / self.num_clients,
+            )
             spent = self.privacy_accountant.get_privacy_spent(
                 self.central_privacy.privacy.delta
             )
@@ -342,6 +342,18 @@ class Coordinator:
             failed_rounds=len(failed),
             global_metrics=global_metrics,
         )
+
+    @property
+    def cohort_size(self) -> int:
+        """Clients sampled per round (see ``orchestration.types.cohort_size``).
+
+        The realized per-client inclusion probability is ``cohort_size / num_clients``
+        — this, not the nominal rate, is what privacy accounting must use (the floor
+        and ceil make it ≥ the nominal rate).
+        """
+        from nanofed_tpu.orchestration.types import cohort_size
+
+        return cohort_size(self.num_clients, self.config.participation_rate)
 
     @property
     def privacy_spent(self):

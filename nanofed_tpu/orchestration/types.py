@@ -2,9 +2,22 @@
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any
+
+
+def cohort_size(num_clients: int, participation_rate: float) -> int:
+    """Clients sampled per round: ceil(N · rate), floored at 1, capped at N.
+
+    ceil per the CoordinatorConfig contract (round() would banker's-round .5 down).
+    THE single definition — privacy-critical: σ calibration (``cli.py``,
+    ``noise_multiplier_for_budget`` callers) and spend accounting
+    (``Coordinator._train_round``) must agree on the realized inclusion probability
+    ``cohort_size/N``, which the floor and ceil make ≥ the nominal rate.
+    """
+    return min(num_clients, max(1, math.ceil(num_clients * participation_rate)))
 
 
 class RoundStatus(Enum):

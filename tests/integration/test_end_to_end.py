@@ -174,6 +174,32 @@ def test_central_privacy_accounting_surfaces_epsilon(mlp, tmp_path, devices):
     assert payload["agg_metrics"]["privacy_epsilon"] == pytest.approx(eps[-1])
 
 
+def test_central_privacy_accounts_at_realized_cohort_rate(mlp, tmp_path, devices):
+    """Accounting must use the REALIZED inclusion probability cohort/N, not the nominal
+    participation_rate: ceil + the floor-at-1 make cohort/N >= rate, and accounting at
+    the smaller nominal q would under-report ε (q² amplification ⇒ ~25× at the extreme)."""
+    from nanofed_tpu.aggregation import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+
+    cd = federate(_data(n=256), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp,
+        train_data=cd,
+        # nominal q=0.02 -> cohort = max(1, ceil(0.16)) = 1 -> realized q = 1/8
+        config=CoordinatorConfig(
+            num_rounds=2, participation_rate=0.02, base_dir=tmp_path, seed=3
+        ),
+        training=TrainingConfig(batch_size=16),
+        central_privacy=PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        ),
+    )
+    assert coord.cohort_size == 1
+    coord.run()
+    events = coord.privacy_accountant.state_dict()["events"]
+    assert events == [[1.0, 1 / 8, 2.0]]
+
+
 def test_no_privacy_no_accounting(mlp, tmp_path, devices):
     cd = federate(_data(n=128), num_clients=8, scheme="iid", batch_size=16)
     coord = Coordinator(

@@ -92,6 +92,21 @@ class TestCentral:
         record_central_privacy(acc, cfg, num_rounds=5)
         assert acc.state_dict()["events"] == [[2.0, 1.0, 5.0]]
 
+    def test_accounting_amplified_by_client_subsampling(self):
+        # With a randomly sampled cohort (participation_rate = q), each round is a
+        # subsampled Gaussian release: the RDP accountant credits q^2 amplification,
+        # so spend at q=0.1 is far below spend at q=1 for the same sigma.
+        from nanofed_tpu.privacy.accounting import RDPAccountant
+
+        cfg = PrivacyAwareAggregationConfig(privacy=PrivacyConfig(noise_multiplier=1.0))
+        full, sub = RDPAccountant(), RDPAccountant()
+        record_central_privacy(full, cfg, num_rounds=20)
+        record_central_privacy(sub, cfg, num_rounds=20, sampling_rate=0.1)
+        assert sub.state_dict()["events"] == [[1.0, 0.1, 20.0]]
+        eps_full = full.get_privacy_spent(1e-5).epsilon_spent
+        eps_sub = sub.get_privacy_spent(1e-5).epsilon_spent
+        assert eps_sub < eps_full / 5
+
 
 class TestLocalReweighting:
     def test_epsilon_weighting_normalizes(self):
