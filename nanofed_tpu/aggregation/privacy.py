@@ -130,10 +130,15 @@ def record_central_privacy(
     uniformly without replacement — ``orchestration/coordinator.py``), each round is a
     subsampled Gaussian release and privacy amplification applies (Abadi et al. 2016 /
     McMahan et al. 2018 treat the fixed-size uniform cohort as Poisson sampling at
-    q = K/N, the standard approximation).  ``RDPAccountant`` only credits amplification
-    for q ≤ 0.1 and falls back to the unamplified bound above that — conservative, never
-    over-claimed.  Client dropout after sampling only shrinks the realized cohort, so
-    accounting at the nominal q is likewise conservative.
+    q = K/N, the standard approximation — NOT a strict without-replacement upper bound;
+    see ``RDPAccountant``).  ``RDPAccountant`` applies the exact sampled-Gaussian RDP
+    (Mironov-Talwar-Zhang 2019 closed form) at every q < 1 — integer orders only,
+    fractional orders excluded as +inf.  Client dropout after sampling only shrinks the
+    realized cohort, so accounting at the nominal q is conservative.
+
+    Amplification is only valid when the sampling randomness is SECRET: the coordinator
+    draws DP cohorts — and the round's noise keys — from OS entropy, never from the
+    persisted config seed (see ``Coordinator._sample_cohort``).
     """
     require_gaussian_accounting(config.privacy)
     accountant.add_noise_event(

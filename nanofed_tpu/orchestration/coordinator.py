@@ -132,6 +132,10 @@ class Coordinator:
             from nanofed_tpu.privacy.accounting import RDPAccountant
 
             self.privacy_accountant = RDPAccountant()
+        # OS-entropy generator for DP cohort sampling (_sample_cohort) and the DP
+        # round's device-RNG entropy fold (_train_round): seeded from the system RNG at
+        # construction, never from config.seed.
+        self._secret_sampling_rng = np.random.default_rng()
 
         self.num_clients = int(train_data.x.shape[0])
         n_dev = len(self.mesh.devices.flat)
@@ -178,6 +182,9 @@ class Coordinator:
                 # from the host and would otherwise change the round-step input sharding.
                 self.params = jax.device_put(restored.params, repl)
                 self.server_state = jax.device_put(restored.server_state, repl)
+                acct_state = restored.metadata.metrics.get("privacy_accountant")
+                if self.privacy_accountant is not None and acct_state is not None:
+                    self.privacy_accountant.load_state_dict(acct_state)
                 self._log.info(
                     "resumed from round %d checkpoint", restored.round_number
                 )
@@ -193,6 +200,29 @@ class Coordinator:
             while self.current_round < self.config.num_rounds:
                 metrics = self._train_round(self.current_round)
                 self.history.append(metrics)
+                # The checkpoint is written FIRST, before any released artifact of the
+                # round (metrics JSON, versioned model): a crash between them then
+                # loses at most an artifact, never an accounting event.  The reverse
+                # order would let a persisted noised release outlive its accountant
+                # entry — a resumed run would re-release round r with fresh noise
+                # while reporting an ε that counts only one of the two releases.
+                if self.state_store is not None:
+                    ckpt_metrics = metrics.to_dict()
+                    if self.privacy_accountant is not None:
+                        ckpt_metrics["privacy_accountant"] = (
+                            self.privacy_accountant.state_dict()
+                        )
+                    self.state_store.checkpoint(
+                        round_number=metrics.round_id,
+                        params=self.params,
+                        server_state=self.server_state,
+                        metrics=ckpt_metrics,
+                        status=(
+                            "COMPLETED"
+                            if metrics.status == RoundStatus.COMPLETED
+                            else "FAILED"
+                        ),
+                    )
                 if self.config.save_metrics:
                     self._save_round_metrics(metrics)
                 if self.model_manager is not None and metrics.status == RoundStatus.COMPLETED:
@@ -203,35 +233,38 @@ class Coordinator:
                             "metrics": metrics.agg_metrics,
                         },
                     )
-                if self.state_store is not None:
-                    self.state_store.checkpoint(
-                        round_number=metrics.round_id,
-                        params=self.params,
-                        server_state=self.server_state,
-                        metrics=metrics.to_dict(),
-                        status=(
-                            "COMPLETED"
-                            if metrics.status == RoundStatus.COMPLETED
-                            else "FAILED"
-                        ),
-                    )
                 if self.on_round_end is not None:
                     self.on_round_end(metrics)
                 self.current_round += 1
                 yield metrics
 
+    def _sample_cohort(self, round_id: int) -> np.ndarray:
+        """Draw this round's participant cohort (replaces the HTTP wait barrier),
+        applying the simulated ``dropout_rate`` fault model.
+
+        Without DP this is a deterministic function of the config seed (reproducible
+        runs).  Under central DP the amplified ε credited by the accountant is only
+        valid if the sampling randomness is SECRET — a cohort predictable from a seed
+        persisted in checkpoints/artifacts voids amplification-by-subsampling against
+        an adversary who reads the seed — so DP cohorts are drawn from OS entropy
+        (trajectories then vary run to run; the privacy guarantee is what must be
+        reproducible, not the cohort).
+        """
+        if self.central_privacy is not None:
+            host_rng = self._secret_sampling_rng
+        else:
+            host_rng = np.random.default_rng(self.config.seed * 100_003 + round_id)
+        sampled = host_rng.choice(self.num_clients, size=self.cohort_size, replace=False)
+        if self.config.dropout_rate > 0:
+            keep = host_rng.random(len(sampled)) >= self.config.dropout_rate
+            sampled = sampled[keep]
+        return sampled
+
     @log_exec
     def _train_round(self, round_id: int) -> RoundMetrics:
         t0 = time.perf_counter()
-        host_rng = np.random.default_rng(self.config.seed * 100_003 + round_id)
-
-        # --- participant sampling (replaces the HTTP wait barrier) ---
         cohort = self.cohort_size
-        sampled = host_rng.choice(self.num_clients, size=cohort, replace=False)
-        survived = sampled
-        if self.config.dropout_rate > 0:
-            keep = host_rng.random(cohort) >= self.config.dropout_rate
-            survived = sampled[keep]
+        survived = self._sample_cohort(round_id)
         required = int(np.ceil(cohort * self.config.min_completion_rate))
         if len(survived) < max(required, 1):
             self._log.warning(
@@ -250,10 +283,22 @@ class Coordinator:
         mask[survived] = 1.0
         weights = compute_weights(self._num_samples, jnp.asarray(mask))
 
-        rngs = stack_rngs(
-            jax.random.fold_in(jax.random.key(self.config.seed), round_id),
-            self._padded_clients,
-        )
+        # Device RNG stack: seed-deterministic without DP.  Under central DP the round
+        # step derives the server NOISE key from this stack (round_step.py
+        # ``noise_rng``) — noise regenerable from a persisted seed could be subtracted
+        # from the released aggregate, voiding DP entirely, so fold in OS entropy
+        # (same secrecy argument as _sample_cohort, but for the noise itself).
+        base = jax.random.fold_in(jax.random.key(self.config.seed), round_id)
+        if self.central_privacy is not None:
+            # Fold in 4 secret words — saturating threefry2x32's 64-bit key state, the
+            # effective bound here (see ops/quantize.py on the keyspace); a single
+            # 31-bit fold would leave the noise key brute-forceable by an adversary
+            # testing candidate draws against the released aggregate.
+            for word in self._secret_sampling_rng.integers(
+                0, 1 << 32, size=4, dtype=np.uint32
+            ):
+                base = jax.random.fold_in(base, word)
+        rngs = stack_rngs(base, self._padded_clients)
         result = self._round_step(
             self.params, self.server_state, self._data, weights, rngs
         )
@@ -291,7 +336,11 @@ class Coordinator:
 
         # Per-client detail for the metrics file (parity: coordinator.py:247-280).  Only
         # consumed by _save_round_metrics — skip the device->host transfers otherwise.
-        if self.config.save_metrics:
+        # Under central DP the per-client detail is NOT persisted: the weight vector
+        # reveals exactly who participated (voiding amplification-by-subsampling for an
+        # artifact-reading adversary), and per-client losses/update norms are
+        # statistics of the un-noised deltas — information the DP release never covers.
+        if self.config.save_metrics and self.central_privacy is None:
             self._last_client_detail = {
                 "weights": np.asarray(weights).tolist(),
                 "client_loss": np.asarray(result.client_metrics.loss).tolist(),
@@ -373,6 +422,16 @@ class Coordinator:
         payload: dict[str, Any] = metrics.to_dict()
         if metrics.status == RoundStatus.COMPLETED and hasattr(self, "_last_client_detail"):
             payload["clients"] = self._last_client_detail
+        if self.central_privacy is not None:
+            # Honest scoping of what the accounted (ε, δ) covers: eval metrics are
+            # post-processing of the noised release (covered); the aggregated TRAIN
+            # loss/accuracy are cohort statistics of un-noised local training and sit
+            # outside the guarantee.  Per-client detail is suppressed entirely.
+            payload["dp_note"] = (
+                "train loss/accuracy in agg_metrics are un-noised cohort statistics "
+                "outside the accounted (epsilon, delta); eval metrics are "
+                "post-processing of the DP release and are covered"
+            )
         path = self.base_dir / "metrics" / f"metrics_round_{metrics.round_id}.json"
         tmp = path.with_suffix(".tmp")
         tmp.write_text(json.dumps(payload, indent=2))

@@ -58,11 +58,20 @@ class PrivacySpent:
 
 class PrivacyAccountant(Protocol):
     """Structural type every accountant satisfies (parity: ``PrivacyAccountant`` Protocol,
-    ``accountant/base.py:23-46``)."""
+    ``accountant/base.py:23-46``).
+
+    ``state_dict``/``load_state_dict`` are part of the contract: the coordinator
+    persists accounting history into round checkpoints so a resumed DP run reports the
+    CUMULATIVE ε of the released model, not just the post-crash tail.
+    """
 
     def add_noise_event(self, noise_multiplier: float, sampling_rate: float) -> None: ...
 
     def get_privacy_spent(self, delta: float) -> PrivacySpent: ...
+
+    def state_dict(self) -> dict: ...
+
+    def load_state_dict(self, state: dict) -> None: ...
 
 
 class BasePrivacyAccountant:

@@ -153,8 +153,11 @@ def run_dp(tag: str) -> int:
                      "uniform-weight mean over the sampled cohort, one Gaussian draw "
                      "sigma*C/K at the replicated aggregate; client-subsampling "
                      "amplification accounted at q=participation_rate",
-        "accounting": "RDPAccountant (tight composition, q^2 amplification for q<=0.1); "
-                      "sigma per arm from noise_multiplier_for_budget",
+        "accounting": "RDPAccountant (exact sampled-Gaussian RDP, Mironov-Talwar-Zhang "
+                      "2019; integer orders); fixed-size uniform cohort accounted as "
+                      "Poisson subsampling at q=cohort/N — the standard approximation "
+                      "(McMahan et al. 2018), not a strict without-replacement upper "
+                      "bound; sigma per arm from noise_multiplier_for_budget",
         "arms": arms,
         "summary": {k: v.get("final_test_accuracy") for k, v in arms.items()},
         "platform": str(jax.devices()[0].platform),

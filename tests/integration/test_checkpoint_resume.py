@@ -70,6 +70,39 @@ def test_resume_matches_uninterrupted_run(mlp, cd, tmp_path, devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
 
 
+def test_resume_preserves_privacy_accounting(mlp, cd, tmp_path, devices):
+    """A resumed central-DP run must carry the pre-crash accounting events: restarting
+    at ε=0 would report a budget covering only post-crash rounds while the restored
+    params already embody every pre-crash noised release."""
+    from nanofed_tpu.aggregation import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+
+    dp = dict(
+        central_privacy=PrivacyAwareAggregationConfig(
+            privacy=PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+        )
+    )
+    full = _coordinator(mlp, cd, tmp_path / "full", rounds=4, **dp)
+    full.run()
+
+    store = FileStateStore(tmp_path / "ckpt")
+    first = _coordinator(mlp, cd, tmp_path / "a", rounds=2, state_store=store, **dp)
+    first.run()
+    resumed = _coordinator(mlp, cd, tmp_path / "b", rounds=4, state_store=store, **dp)
+    assert resumed.current_round == 2
+    # Pre-crash events restored before any new round runs.
+    assert resumed.privacy_accountant.state_dict() == first.privacy_accountant.state_dict()
+    resumed.run()
+    # Accounting events are deterministic (σ, q, count) — the resumed total must equal
+    # the uninterrupted run's cumulative spend, not just the post-crash tail.
+    assert resumed.privacy_spent.epsilon_spent == pytest.approx(
+        full.privacy_spent.epsilon_spent
+    )
+    assert len(resumed.privacy_accountant.state_dict()["events"]) == len(
+        full.privacy_accountant.state_dict()["events"]
+    )
+
+
 def test_run_fault_tolerant_retries_through_crash(mlp, cd, tmp_path, devices):
     store = FileStateStore(tmp_path / "ckpt")
     crashed = {"done": False}

@@ -4,6 +4,7 @@ server; here the transport is the mesh itself)."""
 
 import json
 
+import jax
 import numpy as np
 import pytest
 
@@ -198,6 +199,55 @@ def test_central_privacy_accounts_at_realized_cohort_rate(mlp, tmp_path, devices
     coord.run()
     events = coord.privacy_accountant.state_dict()["events"]
     assert events == [[1.0, 1 / 8, 2.0]]
+
+
+def test_dp_cohort_sampling_uses_secret_randomness(mlp, tmp_path, devices):
+    """Amplification-by-subsampling requires SECRET sampling randomness: under central
+    DP the cohort must NOT be a deterministic function of the persisted config seed
+    (two identically-seeded coordinators draw different cohorts), while the no-DP path
+    stays reproducible from the seed."""
+    from nanofed_tpu.aggregation import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+
+    cd = federate(_data(n=256), num_clients=64, scheme="iid", batch_size=4)
+
+    def make(dp: bool, participation: float = 0.25):
+        return Coordinator(
+            model=mlp,
+            train_data=cd,
+            config=CoordinatorConfig(
+                num_rounds=1, participation_rate=participation, base_dir=tmp_path,
+                seed=7,
+            ),
+            training=TrainingConfig(batch_size=4),
+            central_privacy=PrivacyAwareAggregationConfig(
+                privacy=PrivacyConfig(max_gradient_norm=1.0, noise_multiplier=1.0)
+            ) if dp else None,
+        )
+
+    # No-DP: deterministic in the config seed.
+    plain = [sorted(make(False)._sample_cohort(0)) for _ in range(2)]
+    assert plain[0] == plain[1]
+    # DP: 16-of-64 cohorts from two identically-configured coordinators collide with
+    # probability 1/C(64,16) ~ 2e-15 — a match means the seed leaked into sampling.
+    dp = [sorted(make(True)._sample_cohort(0)) for _ in range(2)]
+    assert dp[0] != dp[1]
+    # And the DP draw is not the seed-derived draw either.
+    assert dp[0] != plain[0] and dp[1] != plain[0]
+
+    # The NOISE must be secret too: noise regenerable from the persisted seed could be
+    # subtracted from the released aggregate, voiding DP outright.  Full participation
+    # pins the cohort (all clients), so the noise key is the ONLY nondeterminism — two
+    # identically-seeded DP coordinators must still release different params.
+    a, b = make(True, participation=1.0), make(True, participation=1.0)
+    list(a.start_training())
+    list(b.start_training())
+    leaves_a, leaves_b = (np.asarray(jax.tree.leaves(c.params)[0]) for c in (a, b))
+    assert not np.array_equal(leaves_a, leaves_b)
+    # And the per-client detail block (weights = cohort membership; un-noised update
+    # norms) must not be persisted under DP.
+    payload = json.loads((tmp_path / "metrics" / "metrics_round_0.json").read_text())
+    assert "clients" not in payload
 
 
 def test_no_privacy_no_accounting(mlp, tmp_path, devices):
