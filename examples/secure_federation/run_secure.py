@@ -15,6 +15,15 @@ weighted mean:
        dequantizes, and that IS the new global model
 
 Run:  python examples/secure_federation/run_secure.py [--port 18765] [--rounds 3]
+
+With ``--dropout-tolerant`` the double-masking variant (Bonawitz §4) runs instead:
+clients additionally add a SELF mask and, at each round's start, Shamir-share that
+round's fresh ephemeral secrets
+(sealed blobs routed through — but unreadable by — the server); after each round's
+submissions, survivors answer the server's unmask request and the coordinator
+reconstructs any dropped client's orphaned masks.  Pass ``--drop-client 2 --drop-round 1``
+to watch client_2 vanish from round 1 on while the rounds keep completing as the
+weighted FedAvg of the survivors.
 """
 
 from __future__ import annotations
@@ -41,24 +50,33 @@ from nanofed_tpu.models import get_model
 from nanofed_tpu.security.secure_agg import (
     ClientKeyPair,
     SecureAggregationConfig,
+    build_unmask_reveals,
+    make_dropout_shares,
     mask_update,
+    open_share_inbox,
 )
 from nanofed_tpu.trainer import TrainingConfig
 from nanofed_tpu.trainer.local import make_local_fit
 
 
-async def run_client(client_id: str, url: str, local_fit, data, cfg, template):
-    """One secure federated client: enroll once, then mask + submit every round."""
+async def run_client(client_id: str, url: str, local_fit, data, cfg, template,
+                     drop_at_round: int | None = None):
+    """One secure federated client: enroll once, then mask + submit every round.
+
+    In dropout-tolerant mode the client also deposits sealed Shamir shares at
+    enrollment and answers the server's unmask requests as a survivor;
+    ``drop_at_round`` simulates a crash — the client vanishes from that round on.
+    """
     import hashlib
 
     # Deterministic per-client RNG base (Python's str hash is salted per process).
     client_seed = int.from_bytes(
         hashlib.sha256(client_id.encode()).digest()[:4], "little"
     )
-    keypair = ClientKeyPair.generate()
+    identity = ClientKeyPair.generate()
     num_samples = float(np.asarray(data.mask).sum())
     async with HTTPClient(url, client_id, timeout_s=60) as client:
-        assert await client.register_secagg(keypair.public_bytes(), num_samples)
+        assert await client.register_secagg(identity.public_bytes(), num_samples)
         roster = await client.fetch_secagg_roster(timeout_s=60)
         print(f"  {client_id}: enrolled; weight={roster.weights[client_id]:.3f}")
         while True:
@@ -69,24 +87,71 @@ async def run_client(client_id: str, url: str, local_fit, data, cfg, template):
                 continue
             if not active:
                 return
+            mask_index, mask_keypair, ordered_pks = (
+                roster.index_of(client_id), identity, roster.ordered_keys()
+            )
+            self_seed, held = None, None
+            if cfg.dropout_tolerant:
+                # Per-round secrets (Bonawitz §4 is per-execution): fresh ephemeral
+                # mask key + self seed, Shamir-shared across this round's ACTIVE
+                # cohort (dropped clients get evicted and stop being waited for).
+                participants = await client.fetch_secagg_participants()
+                if client_id not in participants:
+                    print(f"  {client_id}: evicted from cohort; stopping")
+                    return
+                import hashlib as _hashlib
+
+                mask_keypair = ClientKeyPair.generate()
+                context = f"{client.secagg_session}:{rnd}"
+                self_seed, sealed = make_dropout_shares(
+                    identity, mask_keypair, participants,
+                    {c: roster.public_keys[c] for c in participants},
+                    cfg.threshold, my_id=client_id, context=context,
+                )
+                assert await client.deposit_secagg_shares(
+                    rnd, mask_keypair.public_bytes(), sealed,
+                    self_seed_commitment=_hashlib.sha256(self_seed).digest(),
+                )
+                epks, inbox = await client.fetch_secagg_inbox(rnd, timeout_s=60)
+                held = open_share_inbox(
+                    identity, client_id, roster.public_keys, inbox, epks, context
+                )
+                mask_index = participants.index(client_id)
+                ordered_pks = [epks[c] for c in participants]
+            if drop_at_round is not None and rnd >= drop_at_round:
+                # The interesting crash in tolerant mode: AFTER the share barrier, so
+                # its pairwise masks are already baked into survivors' vectors.
+                print(f"  {client_id}: dropping out at round {rnd}")
+                return
             result = local_fit(jax.tree.map(jnp.asarray, params), data,
                                jax.random.fold_in(jax.random.key(client_seed), rnd))
             masked = mask_update(
-                result.params, roster.index_of(client_id), keypair,
-                roster.ordered_keys(), rnd, cfg, weight=roster.weights[client_id],
+                result.params, mask_index, mask_keypair,
+                ordered_pks, rnd, cfg, weight=roster.weights[client_id],
+                self_seed=self_seed,
             )
             await client.submit_masked_update(
                 masked, {"num_samples": num_samples}
             )
+            answered_unmask = False
             status = await client.check_server_status()
             while status["training_active"] and status["round"] == rnd:
+                if cfg.dropout_tolerant and not answered_unmask:
+                    request = await client.poll_unmask_request()
+                    if (request is not None and request["round"] == rnd
+                            and client_id in request["survivors"]):
+                        reveals = build_unmask_reveals(request, client_id, held)
+                        await client.submit_unmask_reveals(rnd, reveals)
+                        answered_unmask = True
                 await asyncio.sleep(0.05)
                 status = await client.check_server_status()
             if not status["training_active"]:
                 return
 
 
-async def main(port: int, rounds: int, num_clients: int) -> None:
+async def main(port: int, rounds: int, num_clients: int,
+               dropout_tolerant: bool = False, drop_client: int | None = None,
+               drop_round: int | None = None, round_timeout_s: float = 120.0) -> None:
     model = get_model("digits_mlp", hidden=64)
     train = load_digits_dataset("train")
     client_data = federate(train, num_clients=num_clients, scheme="iid",
@@ -94,7 +159,17 @@ async def main(port: int, rounds: int, num_clients: int) -> None:
     training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
     local_fit = jax.jit(make_local_fit(model.apply, training))
     init = model.init(jax.random.key(0))
-    cfg = SecureAggregationConfig(min_clients=num_clients)
+    # min_clients is the PRIVACY FLOOR — the smallest cohort a client will mask into
+    # (a tiny sum hides little).  In tolerant mode the active cohort shrinks as
+    # dropped clients are evicted, so the demo accepts one eviction's worth of
+    # shrinkage; a real deployment picks this floor from its privacy budget.
+    # threshold must exceed n/2 (split-view defense, see make_dropout_shares) and
+    # still be reachable after one eviction shrinks the cohort.
+    cfg = SecureAggregationConfig(
+        min_clients=max(2, num_clients - 1) if dropout_tolerant else num_clients,
+        dropout_tolerant=dropout_tolerant,
+        threshold=num_clients // 2 + 1,
+    )
 
     server = HTTPServer(port=port)
     await server.start()
@@ -102,13 +177,15 @@ async def main(port: int, rounds: int, num_clients: int) -> None:
         coordinator = NetworkCoordinator(
             server, init,
             NetworkRoundConfig(num_rounds=rounds, min_clients=num_clients,
-                               round_timeout_s=120),
+                               min_completion_rate=0.5 if dropout_tolerant else 1.0,
+                               round_timeout_s=round_timeout_s),
             secure=cfg,
         )
         clients = [
             run_client(
                 f"client_{i}", f"http://127.0.0.1:{port}", local_fit,
                 jax.tree.map(lambda x, i=i: x[i], client_data), cfg, init,
+                drop_at_round=(drop_round if i == drop_client else None),
             )
             for i in range(num_clients)
         ]
@@ -130,5 +207,16 @@ if __name__ == "__main__":
     ap.add_argument("--port", type=int, default=18765)
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--dropout-tolerant", action="store_true",
+                    help="double-masking SecAgg: rounds survive client dropouts")
+    ap.add_argument("--drop-client", type=int, default=None,
+                    help="index of a client that crashes mid-run (needs "
+                         "--dropout-tolerant to keep the rounds completing)")
+    ap.add_argument("--drop-round", type=int, default=1,
+                    help="round from which --drop-client vanishes")
+    ap.add_argument("--round-timeout", type=float, default=120.0)
     args = ap.parse_args()
-    asyncio.run(main(args.port, args.rounds, args.clients))
+    asyncio.run(main(args.port, args.rounds, args.clients,
+                     dropout_tolerant=args.dropout_tolerant,
+                     drop_client=args.drop_client, drop_round=args.drop_round,
+                     round_timeout_s=args.round_timeout))

@@ -37,18 +37,22 @@ class ClientEndpoints:
     status: str = "/status"
     secagg_register: str = "/secagg/register"
     secagg_roster: str = "/secagg/roster"
+    secagg_shares: str = "/secagg/shares"
+    secagg_unmask: str = "/secagg/unmask"
 
 
 @dataclass(frozen=True)
 class SecAggRoster:
     """The completed cohort roster a client needs to mask its update: canonical client
-    order (mask sign convention), everyone's X25519 public key, and this framework's
-    twist — server-computed NORMALIZED FedAvg weights, so the masked modular sum IS the
-    weighted mean and no per-client weight ever reaches the server next to a payload."""
+    order (mask sign convention), everyone's X25519 public key, the cohort's negotiated
+    mask backend, and this framework's twist — server-computed NORMALIZED FedAvg
+    weights, so the masked modular sum IS the weighted mean and no per-client weight
+    ever reaches the server next to a payload."""
 
     client_order: list[str]
     public_keys: dict[str, bytes]
     weights: dict[str, float]
+    backend: str = "host"
 
     def index_of(self, client_id: str) -> int:
         return self.client_order.index(client_id)
@@ -87,6 +91,13 @@ class HTTPClient:
         self._session: aiohttp.ClientSession | None = None
         self._log = Logger()
         self.current_round = 0
+        self._secagg_session = ""  # cohort session nonce, cached from the roster
+
+    @property
+    def secagg_session(self) -> str:
+        """The cohort session nonce (set by ``fetch_secagg_roster``) — the context
+        share-blob AADs and auxiliary-POST signatures bind to."""
+        return self._secagg_session
 
     async def __aenter__(self) -> "HTTPClient":
         self._session = aiohttp.ClientSession(timeout=self._timeout)
@@ -157,12 +168,17 @@ class HTTPClient:
     # Secure aggregation (Bonawitz pairwise masking over the wire)
     # ------------------------------------------------------------------
 
-    async def register_secagg(self, public_key: bytes, num_samples: float) -> bool:
-        """Enroll in the secure-aggregation cohort with this client's X25519 public key
-        and its FedAvg sample count.  With a ``security_manager``, the enrollment is
-        RSA-PSS-signed over the server's per-cohort session nonce (fetched from the
-        roster endpoint first) — required by ``require_signatures=True`` servers, and
-        what makes a captured enrollment unreplayable into a later cohort."""
+    async def register_secagg(
+        self, public_key: bytes, num_samples: float, backend: str = "host"
+    ) -> bool:
+        """Enroll in the secure-aggregation cohort with this client's X25519 public key,
+        its FedAvg sample count, and its mask-expansion ``backend`` ('host' numpy-Philox
+        or 'device' TPU-kernel — wire-incompatible streams, so the server pins the first
+        enrollment's backend and refuses mixed cohorts at registration).  With a
+        ``security_manager``, the enrollment is RSA-PSS-signed over the server's
+        per-cohort session nonce (fetched from the roster endpoint first) — required by
+        ``require_signatures=True`` servers, and what makes a captured enrollment
+        unreplayable into a later cohort."""
         import base64
 
         session = self._require_session()
@@ -179,17 +195,22 @@ class HTTPClient:
                     return False
                 cohort_session = (await resp.json()).get("session", "")
             signature = self.security_manager.sign_enrollment(
-                self.client_id, public_key, num_samples, cohort_session
+                self.client_id, public_key, num_samples, cohort_session, backend
             )
             headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
         async with session.post(
             url,
             json={"public_key": base64.b64encode(public_key).decode(),
-                  "num_samples": num_samples},
+                  "num_samples": num_samples, "backend": backend},
             headers=headers,
         ) as resp:
             if resp.status != 200:
-                self._log.warning("secagg registration rejected (HTTP %d)", resp.status)
+                try:
+                    message = (await resp.json()).get("message")
+                except Exception:
+                    message = ""
+                self._log.warning("secagg registration rejected (HTTP %d): %s",
+                                  resp.status, message)
                 return False
         return True
 
@@ -207,12 +228,14 @@ class HTTPClient:
                 if resp.status != 200:
                     raise NanoFedError(f"fetch_secagg_roster: HTTP {resp.status}")
                 payload = await resp.json()
+            self._secagg_session = str(payload.get("session", ""))
             if payload.get("complete"):
                 return SecAggRoster(
                     client_order=list(payload["client_order"]),
                     public_keys={c: base64.b64decode(k)
                                  for c, k in payload["public_keys"].items()},
                     weights={c: float(w) for c, w in payload["weights"].items()},
+                    backend=str(payload.get("backend", "host")),
                 )
             if asyncio.get_event_loop().time() > deadline:
                 raise NanoFedError(
@@ -220,6 +243,140 @@ class HTTPClient:
                     f"({payload.get('enrolled')}/{payload.get('expected')})"
                 )
             await asyncio.sleep(poll_interval_s)
+
+    async def fetch_secagg_participants(self) -> list[str]:
+        """This round's ACTIVE cohort (enrolled minus evicted) — what the per-round
+        shares must cover."""
+        session = self._require_session()
+        url = self.server_url + self.endpoints.secagg_shares
+        async with session.get(url, headers={HEADER_CLIENT: self.client_id}) as resp:
+            if resp.status != 200:
+                raise NanoFedError(f"fetch_secagg_participants: HTTP {resp.status}")
+            payload = await resp.json()
+        return list(payload["participants"])
+
+    async def deposit_secagg_shares(
+        self, round_number: int, ephemeral_public_key: bytes, blobs: dict[str, str],
+        self_seed_commitment: bytes | None = None,
+    ) -> bool:
+        """Deposit this client's ROUND secrets (dropout-tolerant mode, start of each
+        round): the fresh ephemeral mask public key, the sealed Shamir share blobs
+        covering the active cohort (see ``security.secure_agg.make_dropout_shares``),
+        and the sha256 commitment to the self-mask seed (lets recovery detect corrupt
+        shares instead of silently corrupting the model)."""
+        import base64
+
+        session = self._require_session()
+        payload: dict[str, Any] = {
+            "epk": base64.b64encode(ephemeral_public_key).decode(),
+            "blobs": blobs,
+        }
+        if self_seed_commitment is not None:
+            payload["bh"] = base64.b64encode(self_seed_commitment).decode()
+        body = json.dumps(payload).encode()
+        headers = {HEADER_CLIENT: self.client_id,
+                   HEADER_ROUND: str(round_number),
+                   "Content-Type": "application/json"}
+        if self.security_manager is not None:
+            signature = self.security_manager.sign_secagg_body(
+                "shares", body, self.client_id,
+                f"{self._secagg_session}:{round_number}",
+            )
+            headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
+        url = self.server_url + self.endpoints.secagg_shares
+        async with session.post(url, data=body, headers=headers) as resp:
+            if resp.status != 200:
+                try:
+                    message = (await resp.json()).get("message")
+                except Exception:
+                    message = (await resp.text())[:200]
+                self._log.warning("share deposit rejected (HTTP %d): %s",
+                                  resp.status, message)
+                return False
+        return True
+
+    async def fetch_secagg_inbox(
+        self, round_number: int | None = None,
+        poll_interval_s: float = 0.05, timeout_s: float = 30.0,
+    ) -> tuple[dict[str, bytes], dict[str, str]]:
+        """Poll the round's share exchange until every active member has deposited;
+        returns ``(ephemeral_public_keys, inbox)`` — everyone's round mask key and
+        this client's sealed blobs (open with ``open_share_inbox``).
+
+        ``round_number`` pins the exchange to the round this client deposited for: if
+        the server advances mid-poll (e.g. the round FAILED and evictions reset the
+        share state), the stale wait is cut short with an error the caller can treat
+        as "re-fetch the model and start the next round"."""
+        import base64
+
+        session = self._require_session()
+        url = self.server_url + self.endpoints.secagg_shares
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while True:
+            async with session.get(url, headers={HEADER_CLIENT: self.client_id}) as resp:
+                if resp.status != 200:
+                    raise NanoFedError(f"fetch_secagg_inbox: HTTP {resp.status}")
+                payload = await resp.json()
+            if round_number is not None and payload.get("round") != round_number:
+                raise NanoFedError(
+                    f"share exchange moved to round {payload.get('round')} while "
+                    f"waiting on round {round_number}"
+                )
+            if payload.get("complete"):
+                epks = {c: base64.b64decode(k)
+                        for c, k in payload["epks"].items()}
+                return epks, dict(payload["inbox"])
+            if asyncio.get_event_loop().time() > deadline:
+                raise NanoFedError(
+                    f"share deposits incomplete after {timeout_s}s "
+                    f"({payload.get('deposited')}/{payload.get('expected')})"
+                )
+            await asyncio.sleep(poll_interval_s)
+
+    async def poll_unmask_request(self) -> dict[str, Any] | None:
+        """One poll of the unmask endpoint: the active request dict (round / dropped /
+        survivors) or None."""
+        session = self._require_session()
+        async with session.get(
+            self.server_url + self.endpoints.secagg_unmask
+        ) as resp:
+            if resp.status != 200:
+                raise NanoFedError(f"poll_unmask_request: HTTP {resp.status}")
+            payload = await resp.json()
+        return payload if payload.get("status") == "pending" else None
+
+    async def submit_unmask_reveals(
+        self, round_number: int, reveals: dict[str, Any]
+    ) -> bool:
+        """POST this survivor's unmask reveals (built with
+        ``security.secure_agg.build_unmask_reveals`` — which enforces the
+        never-both-secrets refusals client-side)."""
+        import base64
+
+        session = self._require_session()
+        body = json.dumps(reveals).encode()
+        headers = {HEADER_CLIENT: self.client_id,
+                   HEADER_ROUND: str(round_number),
+                   "Content-Type": "application/json"}
+        if self.security_manager is not None:
+            # Bound to the cohort session nonce + round: a captured reveal cannot be
+            # replayed into a later cohort on the same server.
+            signature = self.security_manager.sign_secagg_body(
+                "unmask", body, self.client_id,
+                f"{self._secagg_session}:{round_number}",
+            )
+            headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
+        url = self.server_url + self.endpoints.secagg_unmask
+        async with session.post(url, data=body, headers=headers) as resp:
+            if resp.status != 200:
+                try:
+                    message = (await resp.json()).get("message")
+                except Exception:
+                    message = (await resp.text())[:200]
+                self._log.warning("unmask reveals rejected (HTTP %d): %s",
+                                  resp.status, message)
+                return False
+        return True
 
     async def submit_masked_update(
         self, masked: Any, metrics: dict[str, Any]

@@ -21,7 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Iterable
 
 import jax
 from aiohttp import web
@@ -53,6 +53,8 @@ class ServerEndpoints:
     test: str = "/test"
     secagg_register: str = "/secagg/register"
     secagg_roster: str = "/secagg/roster"
+    secagg_shares: str = "/secagg/shares"
+    secagg_unmask: str = "/secagg/unmask"
 
 
 class HTTPServer:
@@ -89,8 +91,22 @@ class HTTPServer:
         # (they are uniform uint32 vectors, not decodable params).
         self._secagg_expected: int | None = None
         self._secagg_session: str = ""
+        self._secagg_backend: str | None = None  # pinned by the first enrollment
         self._secagg_roster: dict[str, dict[str, Any]] = {}
         self._masked_updates: dict[str, tuple[Any, dict[str, Any]]] = {}
+        # Dropout-tolerant mode (all PER-ROUND, cleared on publish_model — Bonawitz §4
+        # is a per-execution protocol, so every round distributes fresh ephemeral mask
+        # keys and sealed Shamir share blobs): the server routes blobs it cannot read,
+        # collects each participant's round mask public key, and runs the unmask
+        # request/reveal exchange.  Clients declared dropped are EVICTED from the
+        # active cohort so later rounds stop waiting for them.
+        self._secagg_evicted: set[str] = set()
+        self._round_share_epks: dict[str, bytes] = {}
+        self._round_share_bhs: dict[str, bytes] = {}  # sha256 self-seed commitments
+        self._round_share_blobs: dict[str, dict[str, str]] = {}  # recipient -> sender -> blob
+        self._round_share_senders: dict[str, dict[str, str]] = {}  # sender -> its deposit
+        self._unmask_request: dict[str, Any] | None = None
+        self._unmask_reveals: dict[str, dict[str, Any]] = {}
         self._app = web.Application(client_max_size=max_request_size)
         self._app.router.add_get(self.endpoints.model, self._handle_get_model)
         self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
@@ -98,6 +114,10 @@ class HTTPServer:
         self._app.router.add_get(self.endpoints.test, self._handle_test)
         self._app.router.add_post(self.endpoints.secagg_register, self._handle_secagg_register)
         self._app.router.add_get(self.endpoints.secagg_roster, self._handle_secagg_roster)
+        self._app.router.add_post(self.endpoints.secagg_shares, self._handle_secagg_shares_post)
+        self._app.router.add_get(self.endpoints.secagg_shares, self._handle_secagg_shares_get)
+        self._app.router.add_get(self.endpoints.secagg_unmask, self._handle_unmask_get)
+        self._app.router.add_post(self.endpoints.secagg_unmask, self._handle_unmask_post)
         self._runner: web.AppRunner | None = None
 
     # ------------------------------------------------------------------
@@ -116,6 +136,14 @@ class HTTPServer:
             # into the next round: its masks are bound to the OLD round number and
             # would not cancel (unmask_sum would silently produce garbage).
             self._masked_updates.clear()
+            # Per-round dropout-tolerance state: fresh ephemeral keys and shares are
+            # distributed for every round.
+            self._round_share_epks.clear()
+            self._round_share_bhs.clear()
+            self._round_share_blobs.clear()
+            self._round_share_senders.clear()
+            self._unmask_request = None
+            self._unmask_reveals.clear()
 
     def num_updates(self) -> int:
         # Lock-free read is safe: len() is atomic under the GIL and all mutation happens
@@ -150,8 +178,16 @@ class HTTPServer:
 
         self._secagg_expected = int(expected_clients)
         self._secagg_session = secrets.token_hex(16)
+        self._secagg_backend = None
         self._secagg_roster.clear()
         self._masked_updates.clear()
+        self._secagg_evicted.clear()
+        self._round_share_epks.clear()
+        self._round_share_bhs.clear()
+        self._round_share_blobs.clear()
+        self._round_share_senders.clear()
+        self._unmask_request = None
+        self._unmask_reveals.clear()
 
     def secagg_roster_complete(self) -> bool:
         return (
@@ -172,6 +208,76 @@ class HTTPServer:
         async with self._lock:
             taken = {cid: vec for cid, (vec, _) in self._masked_updates.items()}
             self._masked_updates.clear()
+        return taken
+
+    def secagg_backend(self) -> str:
+        """The cohort's negotiated mask-expansion backend (pinned at first
+        enrollment; 'host' for an empty roster)."""
+        return self._secagg_backend or "host"
+
+    def secagg_public_keys(self) -> dict[str, bytes]:
+        return {c: e["public_key"] for c, e in self._secagg_roster.items()}
+
+    def secagg_weights(self) -> dict[str, float]:
+        """Normalized FedAvg weights over the FULL enrolled cohort (what clients
+        pre-scale by; dropout renormalization divides by the survivors' mass)."""
+        total = sum(e["num_samples"] for e in self._secagg_roster.values())
+        return {c: e["num_samples"] / total for c, e in self._secagg_roster.items()}
+
+    def secagg_active_order(self) -> list[str]:
+        """This round's active cohort: enrolled minus evicted, canonical order."""
+        return sorted(set(self._secagg_roster) - self._secagg_evicted)
+
+    def evict_secagg_clients(self, client_ids: Iterable[str]) -> None:
+        """Remove dropped clients from the active cohort (their round secrets were
+        revealed to recover the round; later rounds must not wait for them — a client
+        can only rejoin by enrolling in a fresh cohort).
+
+        The current round's share-exchange state is purged with them: shrinking the
+        active set would otherwise flip ``secagg_shares_complete()`` true for the
+        ROUND IN PROGRESS, serving surviving pollers an epk/inbox view inconsistent
+        with the participants list they deposited against."""
+        self._secagg_evicted.update(client_ids)
+        self._round_share_epks.clear()
+        self._round_share_bhs.clear()
+        self._round_share_blobs.clear()
+        self._round_share_senders.clear()
+
+    def secagg_shares_complete(self) -> bool:
+        """True once every ACTIVE cohort member has deposited this round's ephemeral
+        key + sealed share blobs (the per-round share barrier)."""
+        active = self.secagg_active_order()
+        return bool(active) and set(self._round_share_senders) >= set(active)
+
+    def secagg_round_epks(self) -> dict[str, bytes]:
+        """This round's ephemeral mask public keys (what pairwise seeds derive from)."""
+        return dict(self._round_share_epks)
+
+    def secagg_round_commitments(self) -> dict[str, bytes]:
+        """This round's sha256 self-seed commitments (recovery verifies reconstructed
+        seeds against these so a corrupt share fails the round instead of silently
+        corrupting the model)."""
+        return dict(self._round_share_bhs)
+
+    def open_unmask(self, round_number: int, dropped: list[str],
+                    survivors: list[str]) -> None:
+        """Publish the unmask request survivors poll for (dropout-tolerant mode)."""
+        self._unmask_request = {
+            "round": int(round_number),
+            "dropped": sorted(dropped),
+            "survivors": sorted(survivors),
+        }
+        self._unmask_reveals.clear()
+
+    def num_unmask_reveals(self) -> int:
+        return len(self._unmask_reveals)
+
+    async def drain_unmask_reveals(self) -> dict[str, dict[str, Any]]:
+        """Atomically take the buffered reveals and close the unmask request."""
+        async with self._lock:
+            taken = dict(self._unmask_reveals)
+            self._unmask_reveals.clear()
+            self._unmask_request = None
         return taken
 
     @property
@@ -366,11 +472,14 @@ class HTTPServer:
             body = await request.json()
             public_key = base64.b64decode(body["public_key"])
             num_samples = float(body["num_samples"])
+            backend = str(body.get("backend", "host"))
             if len(public_key) != 32:
                 raise ValueError("bad key length")
             if not (math.isfinite(num_samples) and num_samples > 0):
                 # Infinity would make every honest weight num/inf = 0 at the roster.
                 raise ValueError("sample count must be finite and positive")
+            if backend not in ("host", "device"):
+                raise ValueError(f"unknown mask backend {backend!r}")
         except Exception as e:
             return web.json_response(
                 {"status": "error", "message": f"bad registration: {e}"}, status=400
@@ -378,16 +487,36 @@ class HTTPServer:
         if self.require_signatures:
             # Enrollment must be as authentic as updates: an unsigned register would
             # let anyone claim a cohort slot (and its mask identity) for a known id.
-            # The signature binds this server's session nonce against replay.
+            # The signature binds this server's session nonce against replay, and the
+            # advertised backend against splicing.
             from nanofed_tpu.security.signing import verify_enrollment_signature
 
             verdict = await self._check_signature(
-                request, client_id, verify_enrollment_signature,
+                request, client_id,
+                lambda *a: verify_enrollment_signature(*a, backend=backend),
                 client_id, public_key, num_samples, self._secagg_session,
             )
             if verdict is not None:
                 return verdict
         async with self._lock:
+            # Mask-backend negotiation: host-Philox and device-PRNG expansions are
+            # wire-incompatible — a mixed cohort's pairwise masks would NOT cancel and
+            # the failure would surface only as garbage aggregates after dequantize.
+            # The first enrollment pins the cohort backend; a mismatch is refused HERE,
+            # at registration, with the reason in the error.
+            if self._secagg_backend is not None and backend != self._secagg_backend:
+                return web.json_response(
+                    {
+                        "status": "error",
+                        "message": (
+                            f"mask backend {backend!r} conflicts with this cohort's "
+                            f"negotiated backend {self._secagg_backend!r}: host and "
+                            "device PRG streams are wire-incompatible (mixed masks "
+                            "would not cancel); re-enroll with the cohort backend"
+                        ),
+                    },
+                    status=409,
+                )
             existing = self._secagg_roster.get(client_id)
             if existing is not None:
                 if (existing["public_key"] == public_key
@@ -404,11 +533,13 @@ class HTTPServer:
                 return web.json_response(
                     {"status": "error", "message": "cohort is full"}, status=403
                 )
+            if self._secagg_backend is None:
+                self._secagg_backend = backend
             self._secagg_roster[client_id] = {
                 "public_key": public_key, "num_samples": num_samples
             }
-        self._log.info("secagg enrollment: %s (%d/%d)", client_id,
-                       len(self._secagg_roster), self._secagg_expected)
+        self._log.info("secagg enrollment: %s (%d/%d, backend=%s)", client_id,
+                       len(self._secagg_roster), self._secagg_expected, backend)
         return web.json_response({"status": "success", "message": "enrolled"})
 
     async def _handle_secagg_roster(self, request: web.Request) -> web.StreamResponse:
@@ -429,6 +560,7 @@ class HTTPServer:
             "expected": self._secagg_expected,
             "enrolled": len(self._secagg_roster),
             "session": self._secagg_session,
+            "backend": self.secagg_backend(),
         }
         if complete:
             order = self.secagg_client_order()
@@ -444,6 +576,227 @@ class HTTPServer:
                 },
             )
         return web.json_response(payload)
+
+    async def _handle_secagg_shares_post(self, request: web.Request) -> web.StreamResponse:
+        """Deposit one active client's ROUND secrets (dropout-tolerant mode, start of
+        every round): body ``{"epk": b64, "blobs": {recipient_id: sealed_b64}}`` —
+        the round's fresh ephemeral mask public key plus sealed Shamir share blobs
+        covering the active cohort exactly.  The server routes the blobs but cannot
+        read them (AES-GCM under pairwise identity keys)."""
+        client_id = request.headers.get(HEADER_CLIENT)
+        round_header = request.headers.get(HEADER_ROUND, "")
+        if not client_id:
+            return web.json_response(
+                {"status": "error", "message": "missing client header"}, status=400
+            )
+        if not self.secagg_roster_complete():
+            return web.json_response(
+                {"status": "error",
+                 "message": "roster incomplete: shares seal to the final cohort"},
+                status=403,
+            )
+        active = self.secagg_active_order()
+        if client_id not in active:
+            return web.json_response(
+                {"status": "error",
+                 "message": f"{client_id!r} not in the active cohort"}, status=403
+            )
+        if round_header != str(self._round):
+            return web.json_response(
+                {"status": "error",
+                 "message": f"shares for round {round_header!r}, server is on "
+                            f"{self._round}"},
+                status=400,
+            )
+        body = await request.read()
+        if self.require_signatures:
+            from nanofed_tpu.security.signing import verify_secagg_body_signature
+
+            verdict = await self._check_signature(
+                request, client_id, verify_secagg_body_signature,
+                "shares", body, client_id, f"{self._secagg_session}:{self._round}",
+            )
+            if verdict is not None:
+                return verdict
+        import base64
+
+        try:
+            payload = json.loads(body)
+            epk = base64.b64decode(payload["epk"])
+            bh = base64.b64decode(payload.get("bh", ""))
+            blobs = payload["blobs"]
+            if len(epk) != 32:
+                raise ValueError("bad ephemeral key length")
+            if bh and len(bh) != 32:
+                raise ValueError("bad self-seed commitment length")
+            if set(blobs) != set(active):
+                raise ValueError(
+                    f"blobs must cover the active cohort exactly "
+                    f"(got {len(blobs)}, expected {len(active)})"
+                )
+            if not all(isinstance(v, str) for v in blobs.values()):
+                raise ValueError("each blob must be a base64 string")
+        except Exception as e:
+            return web.json_response(
+                {"status": "error", "message": f"bad share deposit: {e}"}, status=400
+            )
+        async with self._lock:
+            # Re-validate the round under the lock: publish_model may have advanced
+            # the round (clearing the per-round state) while we awaited the body read
+            # or the threaded signature verify — a stale round's epk/blobs recorded
+            # into the new round's maps would derive masks that never cancel.
+            if round_header != str(self._round):
+                return web.json_response(
+                    {"status": "error",
+                     "message": f"shares for round {round_header!r}, server moved to "
+                                f"{self._round}"},
+                    status=409,
+                )
+            existing = self._round_share_senders.get(client_id)
+            if existing is not None:
+                if existing == blobs and self._round_share_epks.get(client_id) == epk:
+                    return web.json_response(
+                        {"status": "success", "message": "already deposited"}
+                    )
+                # A re-deposit with different content would desynchronize recipients
+                # that already fetched their inbox.
+                return web.json_response(
+                    {"status": "error",
+                     "message": "shares already deposited with different content"},
+                    status=409,
+                )
+            self._round_share_senders[client_id] = dict(blobs)
+            self._round_share_epks[client_id] = epk
+            if bh:
+                self._round_share_bhs[client_id] = bh
+            for recipient, blob in blobs.items():
+                self._round_share_blobs.setdefault(recipient, {})[client_id] = blob
+        self._log.info("secagg round-%s shares deposited by %s (%d/%d)",
+                       round_header, client_id,
+                       len(self._round_share_senders), len(active))
+        return web.json_response({"status": "success", "message": "shares deposited"})
+
+    async def _handle_secagg_shares_get(self, request: web.Request) -> web.StreamResponse:
+        """This round's share exchange state: the active participant list (what a
+        client needs BEFORE depositing), and — once every active member has deposited
+        — everyone's ephemeral mask key plus this client's sealed-blob inbox.  The
+        all-deposited barrier matters: masking must not start until recovery is
+        possible for any dropout pattern."""
+        import base64
+
+        client_id = request.headers.get(HEADER_CLIENT)
+        if not client_id:
+            return web.json_response(
+                {"status": "error", "message": "missing client header"}, status=400
+            )
+        if client_id not in self._secagg_roster:
+            return web.json_response(
+                {"status": "error", "message": f"{client_id!r} not enrolled"}, status=403
+            )
+        active = self.secagg_active_order()
+        complete = self.secagg_shares_complete()
+        payload: dict[str, Any] = {
+            "status": "success",
+            "round": self._round,
+            "participants": active,
+            "complete": complete,
+            "deposited": len(self._round_share_senders),
+            "expected": len(active),
+        }
+        if complete:
+            payload["epks"] = {
+                c: base64.b64encode(k).decode()
+                for c, k in self._round_share_epks.items()
+            }
+            payload["inbox"] = dict(self._round_share_blobs.get(client_id, {}))
+        return web.json_response(payload)
+
+    async def _handle_unmask_get(self, request: web.Request) -> web.StreamResponse:
+        """Survivors poll here after submitting: ``{"status": "none"}`` or the active
+        unmask request (round, dropped ids, survivor ids)."""
+        if self._unmask_request is None:
+            return web.json_response({"status": "none"})
+        return web.json_response({"status": "pending", **self._unmask_request})
+
+    async def _handle_unmask_post(self, request: web.Request) -> web.StreamResponse:
+        """Buffer one survivor's unmask reveals (Shamir shares of dropped clients'
+        X25519 keys and survivors' self-mask seeds)."""
+        client_id = request.headers.get(HEADER_CLIENT)
+        round_header = request.headers.get(HEADER_ROUND, "")
+        if not client_id:
+            return web.json_response(
+                {"status": "error", "message": "missing client header"}, status=400
+            )
+        if self._unmask_request is None:
+            return web.json_response(
+                {"status": "error", "message": "no unmask round active"}, status=403
+            )
+        if client_id not in self._unmask_request["survivors"]:
+            return web.json_response(
+                {"status": "error",
+                 "message": f"{client_id!r} is not a survivor of this round"},
+                status=403,
+            )
+        # Snapshot the request: every await below can interleave with
+        # drain_unmask_reveals clearing it (the under-lock re-validation is the
+        # authority; dereferencing self._unmask_request after an await would 500).
+        snapshot = self._unmask_request
+        try:
+            if int(round_header) != snapshot["round"]:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {"status": "error",
+                 "message": f"reveal for round {round_header!r}, unmask round is "
+                            f"{snapshot['round']}"},
+                status=400,
+            )
+        body = await request.read()
+        if self.require_signatures:
+            from nanofed_tpu.security.signing import verify_secagg_body_signature
+
+            # Context binds the cohort session nonce AND the round: a reveal captured
+            # from an earlier cohort on this server must not verify here (it would
+            # carry shares of the OLD cohort's secrets and corrupt recovery).
+            verdict = await self._check_signature(
+                request, client_id, verify_secagg_body_signature,
+                "unmask", body, client_id,
+                f"{self._secagg_session}:{snapshot['round']}",
+            )
+            if verdict is not None:
+                return verdict
+        try:
+            reveals = json.loads(body)
+            if not isinstance(reveals.get("sk"), dict) or not isinstance(
+                reveals.get("b"), dict
+            ):
+                raise ValueError("reveals must carry 'sk' and 'b' share maps")
+        except Exception as e:
+            return web.json_response(
+                {"status": "error", "message": f"bad reveals: {e}"}, status=400
+            )
+        async with self._lock:
+            active = self._unmask_request
+            # Re-validate EVERYTHING the pre-read checks covered: the request may have
+            # been drained and a NEW round's request opened while we awaited the body
+            # read / threaded signature verify — a stale round's reveal must not be
+            # buffered into the new round (it was validated against a different
+            # request).
+            if (
+                active is None
+                or int(round_header) != active["round"]
+                or client_id not in active["survivors"]
+            ):
+                return web.json_response(
+                    {"status": "error",
+                     "message": "unmask round changed while processing this reveal"},
+                    status=409,
+                )
+            self._unmask_reveals[client_id] = reveals
+            count, expected = len(self._unmask_reveals), len(active["survivors"])
+        self._log.info("unmask reveals from %s (%d/%d survivors)", client_id, count,
+                       expected)
+        return web.json_response({"status": "success", "message": "reveals accepted"})
 
     async def _handle_masked_update(
         self, request: web.Request, client_id: str, round_number: int,
@@ -464,6 +817,15 @@ class HTTPServer:
         if client_id not in self._secagg_roster:
             return web.json_response(
                 {"status": "error", "message": f"{client_id!r} not enrolled"}, status=403
+            )
+        if client_id in self._secagg_evicted:
+            # An evicted client's round secrets were revealed (its masks are
+            # compromised) and the active cohort no longer includes it — accepting
+            # its vector would inflate the masked-update count and let it push a
+            # slow-but-alive member past the round barrier into eviction.
+            return web.json_response(
+                {"status": "error",
+                 "message": f"{client_id!r} was evicted from this cohort"}, status=403
             )
         body = await request.read()
         if self.require_signatures:

@@ -104,12 +104,16 @@ class NetworkCoordinator:
     enroll (X25519 keys + sample counts) via ``/secagg/register``, pre-scale their
     update by the server-published normalized weight, mask with pairwise PRG streams,
     and the coordinator modular-sums + dequantizes — it only ever observes uniformly
-    masked vectors and the cohort's weighted mean.  This is the single-round
-    no-dropout SecAgg variant: every enrolled client must report or the round FAILS
-    (a missing client's pairwise masks would not cancel).  Per-update validation is
-    impossible by construction in this mode — masked vectors are indistinguishable
-    from noise; range enforcement must come from quantization bounds and DP clipping
-    client-side.
+    masked vectors and the cohort's weighted mean.  By default this is the
+    single-round no-dropout SecAgg variant: every enrolled client must report or the
+    round FAILS (a missing client's pairwise masks would not cancel).  With
+    ``secure.dropout_tolerant=True`` the double-masking variant runs instead
+    (Bonawitz §4): clients Shamir-share fresh per-round secrets and an unmask
+    round reconstructs orphaned masks, so a round with dropouts completes as the
+    weighted FedAvg of the survivors (see ``_tolerant_secure_round``).  Per-update
+    validation is impossible by construction in either mode — masked vectors are
+    indistinguishable from noise; range enforcement must come from quantization
+    bounds and DP clipping client-side.
     """
 
     def __init__(
@@ -172,8 +176,140 @@ class NetworkCoordinator:
             survivors = kept
         return survivors
 
+    async def _tolerant_secure_round(
+        self, round_number: int, required: int
+    ) -> dict[str, Any]:
+        """One dropout-tolerant masked round (Bonawitz §4 double masking): wait for the
+        cohort until the timeout, then run the UNMASK round — survivors reveal Shamir
+        shares of dropped clients' pair keys and survivors' self-mask seeds, the
+        coordinator reconstructs and removes the orphaned masks, and the round
+        completes as the weighted FedAvg of the survivors."""
+        from nanofed_tpu.security.secure_agg import recover_unmasked_sum
+        from nanofed_tpu.utils.trees import tree_ravel
+
+        cohort = self.server.secagg_active_order()
+        expected = len(cohort)
+        threshold = self.secure.threshold
+        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        while (
+            self.server.num_masked_updates() < expected
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(self.config.poll_interval_s)
+        masked = await self.server.drain_masked_updates()
+        survivors = [c for c in cohort if c in masked]
+        dropped = [c for c in cohort if c not in masked]
+
+        def fail(reason: str) -> dict[str, Any]:
+            self._log.warning("secure round %d FAILED: %s", round_number, reason)
+            record = {"round": round_number, "status": "FAILED",
+                      "num_clients": len(survivors), "num_dropped": len(dropped),
+                      "secure": True, "reason": reason}
+            self.history.append(record)
+            return record
+
+        # Gate BEFORE the unmask phase: min_clients is the privacy floor (a smaller
+        # revealed sum would expose updates below the crowd size clients consented
+        # to), and reveals must not be solicited for a round that cannot complete.
+        floor = self.secure.min_clients
+        if len(survivors) < max(required, threshold, floor, 1):
+            reason = (
+                f"{len(survivors)}/{expected} masked updates (need "
+                f"max(required={required}, threshold={threshold}, "
+                f"min_clients={floor}))"
+            )
+            # Evict clients known dead — FAILED rounds must shed them too, or every
+            # subsequent round stalls a full timeout waiting for a corpse:
+            # * shares incomplete: the non-depositors stalled the share barrier
+            #   (nobody could mask; the depositors are alive and blameless);
+            # * shares complete: the non-submitters went silent after depositing.
+            # Never evict everyone — a total stall is systemic (e.g. clients cannot
+            # reach us), and emptying the cohort would end recovery for good.
+            if not self.server.secagg_shares_complete():
+                alive = set(self.server.secagg_round_epks())
+                gone = [c for c in cohort if c not in alive]
+            else:
+                gone = dropped
+            if gone and len(gone) < len(cohort):
+                self.server.evict_secagg_clients(gone)
+                reason += f"; evicted unresponsive clients {gone}"
+            return fail(reason)
+        # This round's ephemeral mask keys (pairwise seeds derive from these; a
+        # survivor could only have masked after the share barrier, so the epk map
+        # covers everyone who matters).
+        epks = self.server.secagg_round_epks()
+        missing_epks = [c for c in cohort if c not in epks]
+        if any(c in survivors for c in missing_epks):
+            return fail(f"survivors without ephemeral keys: {missing_epks}")
+        # A client that dropped BEFORE depositing its round shares left nothing to
+        # recover — but also added no masks anywhere (nobody could mask before the
+        # share barrier), so it is simply excluded.
+        dropped_after_shares = [c for c in dropped if c in epks]
+        # Unmask round: even with zero dropouts the survivors' SELF masks must be
+        # removed, so this phase always runs in tolerant mode.
+        self.server.open_unmask(round_number, dropped_after_shares, survivors)
+        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        while (
+            self.server.num_unmask_reveals() < len(survivors)
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(self.config.poll_interval_s)
+        reveals = await self.server.drain_unmask_reveals()
+        if len(reveals) < threshold:
+            # The non-submitters are known dead either way; shed them so the next
+            # round's barrier stops waiting (non-REVEALING survivors stay — they are
+            # provably alive, their reveal may just be late).
+            if dropped and len(dropped) < len(cohort):
+                self.server.evict_secagg_clients(dropped)
+            return fail(
+                f"only {len(reveals)}/{len(survivors)} unmask reveals "
+                f"(threshold {threshold})"
+            )
+        try:
+            total = recover_unmasked_sum(
+                masked,
+                [c for c in cohort if c in epks],
+                epks,
+                round_number,
+                reveals,
+                self.secure,
+                backend=self.server.secagg_backend(),
+                self_seed_commitments=self.server.secagg_round_commitments(),
+            )
+        except Exception as e:
+            return fail(f"mask recovery failed: {e}")
+        # Clients pre-scaled by full-cohort enrollment weights; renormalize to the
+        # survivors' weight mass so the result is the weighted mean of who reported.
+        from nanofed_tpu.security.secure_agg import dequantize
+
+        weights = self.server.secagg_weights()
+        survivor_mass = sum(weights[s] for s in survivors)
+        flat = dequantize(total, self.secure.frac_bits) / survivor_mass
+        _, unravel = tree_ravel(self.params)
+        self.params = unravel(jnp.asarray(flat, jnp.float32))
+        if dropped:
+            # Their round secrets were revealed; evict so later rounds neither wait
+            # for them nor accept a compromised-mask submission.  Rejoining requires
+            # a fresh cohort.
+            self.server.evict_secagg_clients(dropped)
+        record = {
+            "round": round_number,
+            "status": "COMPLETED",
+            "num_clients": len(survivors),
+            "num_dropped": len(dropped),
+            "secure": True,
+        }
+        self.history.append(record)
+        self._log.info(
+            "secure round %d: recovered aggregate from %d survivors (%d dropped)",
+            round_number, len(survivors), len(dropped),
+        )
+        return record
+
     async def _secure_round(self, round_number: int, required: int) -> dict[str, Any]:
         """One masked round: wait for the FULL cohort, modular-sum, unmask."""
+        if self.secure.dropout_tolerant:
+            return await self._tolerant_secure_round(round_number, required)
         cohort = self.server.secagg_client_order()
         expected = len(cohort)
         deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
@@ -267,6 +403,9 @@ class NetworkCoordinator:
                 raise TimeoutError(
                     "secure-aggregation cohort incomplete before round 0"
                 )
+            # (Dropout-tolerant share distribution is PER-ROUND — fresh ephemeral
+            # secrets every round, see _tolerant_secure_round — so there is no
+            # enrollment-time share barrier.)
         for r in range(self.config.num_rounds):
             await self.train_round(r)
         self.server.stop_training()

@@ -16,10 +16,19 @@ _CRYPTO_EXPORTS = {
     "ThresholdSecureAggregator": "secure_agg",
     "TransportBox": "secure_agg",
     "add_shares": "secure_agg",
+    "build_unmask_reveals": "secure_agg",
     "dequantize": "secure_agg",
+    "expand_mask": "secure_agg",
+    "make_dropout_shares": "secure_agg",
     "mask_update": "secure_agg",
+    "open_share_inbox": "secure_agg",
+    "open_share_payload": "secure_agg",
     "quantize": "secure_agg",
+    "reconstruct_secret_bytes": "secure_agg",
     "reconstruct_vector": "secure_agg",
+    "recover_unmasked_sum": "secure_agg",
+    "seal_share_payload": "secure_agg",
+    "share_secret_bytes": "secure_agg",
     "share_vector": "secure_agg",
     "unmask_sum": "secure_agg",
     "SecurityManager": "signing",
@@ -61,12 +70,21 @@ __all__ = [
     "ValidationResult",
     "add_shares",
     "apply_validation_mask",
+    "build_unmask_reveals",
     "canonical_bytes",
     "dequantize",
+    "expand_mask",
+    "make_dropout_shares",
     "mask_update",
+    "open_share_inbox",
+    "open_share_payload",
     "quantize",
+    "reconstruct_secret_bytes",
     "reconstruct_vector",
+    "recover_unmasked_sum",
     "reference_shapes",
+    "seal_share_payload",
+    "share_secret_bytes",
     "share_vector",
     "unmask_sum",
     "validate_client_updates",

@@ -57,11 +57,27 @@ class SecureAggregationConfig:
     ring is uint32.  The sum of all clients' scaled values must stay within ±2^31·2^-frac_bits
     to avoid wraparound — with the default 16 fractional bits that is ±32768 total mass,
     far above any normalized model update.
+
+    ``dropout_tolerant=True`` switches masked rounds to the double-masking SecAgg
+    variant (Bonawitz et al. §4): every client adds a SELF mask on top of the pairwise
+    masks, and at the START OF EVERY ROUND draws a fresh ephemeral mask key + self
+    seed and Shamir-shares both with the round's cohort (per-execution freshness —
+    see ``make_dropout_shares``).  When a client drops mid-round, any ``threshold``
+    survivors' shares let the server reconstruct the dropped client's round pairwise
+    seeds (cancelling its orphaned masks) and the survivors' self-mask seeds — the
+    round completes as the weighted FedAvg of the survivors instead of failing.  The
+    self mask is what keeps a *delivered-but-presumed-dropped* update private:
+    reconstructing a client's pairwise seeds alone never exposes its update.  Default
+    False = the single-round no-dropout variant (any missing cohort member fails the
+    round).  In tolerant mode ``min_clients`` doubles as the recovery privacy floor
+    (no sum over fewer survivors is ever revealed) and ``threshold`` must exceed half
+    the cohort (split-view defense).
     """
 
     min_clients: int = 3
     frac_bits: int = 16
     threshold: int = 2  # Shamir reconstruction threshold
+    dropout_tolerant: bool = False
 
 
 # ---------------------------------------------------------------------------------------
@@ -129,6 +145,44 @@ def _prg_uint32(seed: bytes, size: int) -> np.ndarray:
     )
 
 
+def _self_mask_seed(self_seed: bytes, round_context: bytes) -> bytes:
+    """Per-round self-mask seed: the enrollment-time 32-byte secret ``b_i`` is shared
+    ONCE, so each round's self mask must be a fresh derivation bound to the round."""
+    return HKDF(
+        algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg-self",
+        info=round_context,
+    ).derive(self_seed)
+
+
+def _fold_seed_words(seed: bytes) -> np.ndarray:
+    """256-bit seed -> the device kernel's 4 int32 words (endian-independent
+    two's-complement centering; a .view would reinterpret in NATIVE byte order and
+    break cross-endian mask cancellation — the invariant _prg_uint32 pins for the
+    host path)."""
+    words = np.frombuffer(seed, dtype="<u4")
+    folded = (words[:4] ^ words[4:]).astype(np.int64)
+    return np.where(folded >= 1 << 31, folded - (1 << 32), folded).astype(np.int32)
+
+
+def expand_mask(seed: bytes, size: int, backend: str = "host") -> np.ndarray:
+    """Expand a 32-byte seed into the uint32 mask stream a client with this
+    ``backend`` would have added — the server-side primitive for dropout recovery
+    (reconstructed seeds must expand the SAME stream the clients used)."""
+    if backend == "host":
+        return _prg_uint32(seed, size)
+    if backend != "device":
+        raise ValueError(f"unknown backend {backend!r}; use 'host' or 'device'")
+    import jax
+    import jax.numpy as jnp
+
+    from nanofed_tpu.ops import add_mask
+
+    zeros = jnp.zeros((size,), jnp.uint32)
+    return np.asarray(
+        jax.device_get(add_mask(zeros, jnp.asarray(_fold_seed_words(seed)), jnp.int32(1)))
+    )
+
+
 def mask_update(
     params: Params,
     client_index: int,
@@ -138,6 +192,7 @@ def mask_update(
     config: SecureAggregationConfig | None = None,
     weight: float = 1.0,
     backend: str = "host",
+    self_seed: bytes | None = None,
 ) -> np.ndarray:
     """Client side: quantize ``weight · params`` and add the pairwise masks.
 
@@ -145,13 +200,19 @@ def mask_update(
     weighting survive secure aggregation: clients pre-scale by (their weight / total) so the
     server-side sum IS the weighted mean.
 
+    ``self_seed`` (dropout-tolerant mode) additionally adds the per-round SELF mask
+    ``PRG(HKDF(self_seed, round))``: it keeps the update private even if the server
+    later reconstructs this client's pairwise seeds, and is removed during the unmask
+    round via the Shamir shares the client distributed at the round's start.
+
     ``backend="device"`` runs quantization and mask expansion on the accelerator via the
     ``ops.quantize`` Pallas kernels — for large models this replaces several
     host-memory passes per pair with on-chip PRNG expansion, and the masked vector
     round-trips to the host exactly once for the wire.  The device PRNG stream differs
     from the host Philox stream, so the WHOLE cohort must use the same backend for the
     pairwise masks to cancel (the seeds are the same HKDF pair seeds either way; only
-    the expansion differs).  ``unmask_sum`` is stream-agnostic.
+    the expansion differs) — the roster pins one backend per cohort and registration
+    rejects mixed cohorts.  ``unmask_sum`` is stream-agnostic.
     """
     config = config or SecureAggregationConfig()
     if len(all_public_keys) < config.min_clients:
@@ -161,7 +222,7 @@ def mask_update(
     ctx = f"round:{round_number}".encode()
     if backend == "device":
         return _mask_update_device(
-            params, client_index, my_key, all_public_keys, ctx, config, weight
+            params, client_index, my_key, all_public_keys, ctx, config, weight, self_seed
         )
     if backend != "host":
         raise ValueError(f"unknown backend {backend!r}; use 'host' or 'device'")
@@ -175,6 +236,8 @@ def mask_update(
             vec = vec + mask  # uint32 wraps mod 2^32 by construction
         else:
             vec = vec - mask
+    if self_seed is not None:
+        vec = vec + _prg_uint32(_self_mask_seed(self_seed, ctx), vec.size)
     return vec
 
 
@@ -186,6 +249,7 @@ def _mask_update_device(
     ctx: bytes,
     config: SecureAggregationConfig,
     weight: float,
+    self_seed: bytes | None = None,
 ) -> np.ndarray:
     """Device-backend masking: ``ops.quantize`` kernels + on-core PRNG expansion.
 
@@ -202,15 +266,11 @@ def _mask_update_device(
     for j, peer_pk in enumerate(all_public_keys):
         if j == client_index:
             continue
-        seed = np.frombuffer(_pair_seed(my_key, peer_pk, ctx), dtype="<u4")
-        # Endian-independent two's-complement centering (a .view would reinterpret in
-        # NATIVE byte order and break cross-endian mask cancellation — the invariant
-        # _prg_uint32 pins for the host path).
-        folded = (seed[:4] ^ seed[4:]).astype(np.int64)
-        words = jnp.asarray(
-            np.where(folded >= 1 << 31, folded - (1 << 32), folded).astype(np.int32)
-        )
+        words = jnp.asarray(_fold_seed_words(_pair_seed(my_key, peer_pk, ctx)))
         vec = add_mask(vec, words, jnp.int32(1 if j > client_index else -1))
+    if self_seed is not None:
+        words = jnp.asarray(_fold_seed_words(_self_mask_seed(self_seed, ctx)))
+        vec = add_mask(vec, words, jnp.int32(1))
     return np.asarray(jax.device_get(vec))
 
 
@@ -255,17 +315,33 @@ class Share:
     values: np.ndarray  # int64 residues mod _PRIME
 
 
+def _csprng_residues(shape: tuple[int, ...]) -> np.ndarray:
+    """Uniform residues mod p straight from OS entropy.  Shamir's secrecy is
+    information-theoretic ONLY if the polynomial coefficients are unpredictable: a
+    64-bit-seeded PCG64 draw would let an attacker holding a single share (plus the
+    published ephemeral public key to verify guesses against) brute-force the seed and
+    recover the secret.  The 2^64-mod-p bias is ~2^-33 — negligible."""
+    n = int(np.prod(shape)) if shape else 1
+    words = np.frombuffer(os.urandom(8 * n), dtype="<u8")
+    return (words % np.uint64(_PRIME)).astype(np.int64).reshape(shape)
+
+
 def share_vector(
     values: np.ndarray, num_shares: int, threshold: int, rng: np.random.Generator | None = None
 ) -> list[Share]:
     """Split an int64 vector (entries in (−2^30, 2^30), negatives encoded mod p) into
-    ``num_shares`` Shamir shares with reconstruction threshold ``threshold``."""
+    ``num_shares`` Shamir shares with reconstruction threshold ``threshold``.
+
+    Coefficients come from OS entropy (see ``_csprng_residues``); pass ``rng`` only
+    for deterministic tests — never when sharing real key material."""
     if not 1 <= threshold <= num_shares:
         raise AggregationError(f"invalid threshold {threshold} for {num_shares} shares")
-    rng = rng or np.random.default_rng(secrets.randbits(64))
     secret = _mod(np.asarray(values, np.int64))
     # Random degree-(t-1) polynomial per element with constant term = secret.
-    coeffs = rng.integers(0, _PRIME, size=(threshold - 1, secret.size), dtype=np.int64)
+    if rng is None:
+        coeffs = _csprng_residues((threshold - 1, secret.size))
+    else:
+        coeffs = rng.integers(0, _PRIME, size=(threshold - 1, secret.size), dtype=np.int64)
     shares = []
     for x in range(1, num_shares + 1):
         acc = np.zeros_like(secret)
@@ -349,6 +425,335 @@ class ThresholdSecureAggregator:
         return unravel(
             jnp.asarray(total.astype(np.float64) / (1 << self._config.frac_bits), jnp.float32)
         )
+
+
+# ---------------------------------------------------------------------------------------
+# Dropout-tolerant SecAgg (Bonawitz et al. §4: double masking + share-based recovery)
+# ---------------------------------------------------------------------------------------
+
+
+def _bytes_to_words(secret: bytes) -> np.ndarray:
+    """32-byte secret -> 16 little-endian uint16 words as int64 (every word < 2^16 ≪ p,
+    so Shamir over GF(2^31−1) shares it losslessly)."""
+    if len(secret) != 32:
+        raise AggregationError(f"expected a 32-byte secret, got {len(secret)}")
+    return np.frombuffer(secret, dtype="<u2").astype(np.int64)
+
+
+def _words_to_bytes(words: np.ndarray) -> bytes:
+    return np.asarray(words, dtype="<u2").tobytes()
+
+
+def share_secret_bytes(
+    secret: bytes, num_shares: int, threshold: int,
+    rng: np.random.Generator | None = None,
+) -> list[Share]:
+    """Shamir-share a 32-byte secret (an X25519 private key or a self-mask seed)."""
+    return share_vector(_bytes_to_words(secret), num_shares, threshold, rng)
+
+
+def reconstruct_secret_bytes(shares: Sequence[Share], threshold: int) -> bytes:
+    """Recover a 32-byte secret from any ``threshold`` shares."""
+    words = reconstruct_vector(shares, threshold)
+    if words.shape != (16,) or (words < 0).any() or (words >= 1 << 16).any():
+        raise AggregationError("reconstructed share vector is not a 32-byte secret")
+    return _words_to_bytes(words)
+
+
+def _transport_key(my_key: ClientKeyPair, peer_public: bytes) -> bytes:
+    """Pairwise AES-256 key for share transport through the (untrusted-for-content)
+    server — an HKDF derivation of the same X25519 agreement as the mask seeds, under
+    a DIFFERENT salt so transport keys and mask seeds are cryptographically independent."""
+    shared = my_key.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
+    return HKDF(
+        algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg-share",
+        info=b"share-transport",
+    ).derive(shared)
+
+
+def _share_aad(context: str, sender: str, recipient: str) -> bytes:
+    """AES-GCM associated data binding a sealed share blob to its cohort session,
+    round, sender, and recipient.  Without this a malicious server could replay a
+    PRIOR round's inbox (whose self seeds it already learned in that round's unmask)
+    and harvest the matching mask keys this round — collecting both secrets of a
+    victim across two rounds."""
+    return f"secagg-share|{context}|{sender}|{recipient}".encode()
+
+
+def seal_share_payload(
+    my_key: ClientKeyPair, peer_public: bytes, payload: dict,
+    aad: bytes = b"secagg-share",
+) -> str:
+    """Encrypt a share payload to one cohort peer (``TransportBox`` under the pairwise
+    transport key, base64 wire form; ``aad`` from ``_share_aad`` binds it to the wire
+    context).  The server stores and routes these blobs but cannot read them."""
+    import base64
+    import json
+
+    box = TransportBox(_transport_key(my_key, peer_public))
+    return base64.b64encode(
+        box.encrypt(json.dumps(payload).encode(), aad)
+    ).decode()
+
+
+def open_share_payload(
+    my_key: ClientKeyPair, sender_public: bytes, blob: str,
+    aad: bytes = b"secagg-share",
+) -> dict:
+    """Decrypt a share blob addressed to this client (raises on tamper or on a wire
+    context mismatch — AES-GCM authenticates ``aad``)."""
+    import base64
+    import json
+
+    box = TransportBox(_transport_key(my_key, sender_public))
+    return json.loads(box.decrypt(base64.b64decode(blob), aad))
+
+
+def open_share_inbox(
+    identity_key: ClientKeyPair,
+    my_id: str,
+    identity_public_keys: dict[str, bytes],
+    inbox: dict[str, str],
+    epks: dict[str, bytes],
+    context: str,
+) -> dict[str, dict]:
+    """Open this client's full share inbox with replay-bound AADs and cross-check the
+    server-relayed ephemeral keys against each sender's SEALED attestation.
+
+    The epk map travels in an unsigned GET response; a server substituting its own
+    keypairs could compute every pair seed and strip the pairwise masks, reducing
+    double-masking to the self mask alone.  Each sender therefore seals its epk
+    inside the authenticated blob; a mismatch with the relayed map aborts the round
+    client-side before anything is masked.
+    """
+    import base64
+
+    held = {}
+    for sender, blob in inbox.items():
+        payload = open_share_payload(
+            identity_key, identity_public_keys[sender], blob,
+            aad=_share_aad(context, sender, my_id),
+        )
+        attested = base64.b64decode(payload.get("epk", ""))
+        if attested != epks.get(sender):
+            raise AggregationError(
+                f"server-relayed ephemeral key for {sender!r} does not match its "
+                "sealed attestation — refusing to mask (possible epk substitution)"
+            )
+        held[sender] = payload
+    return held
+
+
+def make_dropout_shares(
+    identity_key: ClientKeyPair,
+    mask_key: ClientKeyPair,
+    client_order: Sequence[str],
+    identity_public_keys: dict[str, bytes],
+    threshold: int,
+    *,
+    my_id: str,
+    context: str,
+    rng: np.random.Generator | None = None,
+) -> tuple[bytes, dict[str, str]]:
+    """Client side, start of each round: draw the round's self-mask secret ``b_i^r``
+    and Shamir-share it and the round's EPHEMERAL mask key across the active cohort.
+
+    Freshness is the security (Bonawitz §4 is a per-execution protocol): revealing a
+    dropped client's mask key burns only THIS round's pairwise seeds, and revealing a
+    survivor's self seed burns only this round's self mask — earlier and later rounds
+    used different secrets, so the server can never retroactively combine a key reveal
+    with an old self-seed reveal to unmask a delivered update.  The long-lived
+    ``identity_key`` (enrollment) is used only to SEAL the share blobs to each peer;
+    the shared secrets are the per-round ``mask_key`` and ``b``.
+
+    ``my_id`` + ``context`` (cohort session + round, e.g. ``"<session>:<round>"``)
+    bind each sealed blob's AAD to the wire context (see ``_share_aad``) — recipients
+    open with the same binding, so a replayed blob from another round/cohort fails
+    authentication.  The blob also carries this client's ephemeral PUBLIC key as a
+    sealed attestation recipients cross-check against the server-relayed epk map
+    (``open_share_inbox``).
+
+    Returns ``(self_seed, {recipient_id: sealed_blob})``: the blob for round-roster
+    member j carries share x=j+1 of each secret, sealed to j's identity key.  The self
+    share (to our own id) keeps the share-count invariant — every cohort member holds
+    exactly one share of every secret.
+    """
+    n = len(client_order)
+    if 2 * threshold <= n:
+        # With t <= n/2 a MALICIOUS server could partition the cohort into two
+        # disjoint groups of >= t survivors, feed each a different unmask request,
+        # and collect t shares of a victim's mask KEY from one group and t shares of
+        # its SELF seed from the other — both secrets, one round, every per-request
+        # refusal in build_unmask_reveals satisfied.  t > n/2 makes two disjoint
+        # threshold-sized reveal sets impossible, so the invariant holds against an
+        # actively-misbehaving server, not just an honest-but-curious one.
+        raise AggregationError(
+            f"dropout-tolerance threshold {threshold} must exceed half the cohort "
+            f"({n}): smaller thresholds allow a split-view unmask attack"
+        )
+    self_seed = secrets.token_bytes(32)
+    sk_raw = mask_key.private.private_bytes(
+        encoding=serialization.Encoding.Raw,
+        format=serialization.PrivateFormat.Raw,
+        encryption_algorithm=serialization.NoEncryption(),
+    )
+    sk_shares = share_secret_bytes(sk_raw, n, threshold, rng)
+    b_shares = share_secret_bytes(self_seed, n, threshold, rng)
+    import base64
+
+    epk_b64 = base64.b64encode(mask_key.public_bytes()).decode()
+    sealed = {}
+    for j, cid in enumerate(client_order):
+        payload = {
+            "x": j + 1,
+            "sk": sk_shares[j].values.tolist(),
+            "b": b_shares[j].values.tolist(),
+            "epk": epk_b64,
+        }
+        sealed[cid] = seal_share_payload(
+            identity_key, identity_public_keys[cid], payload,
+            aad=_share_aad(context, my_id, cid),
+        )
+    return self_seed, sealed
+
+
+def build_unmask_reveals(
+    request: dict, my_id: str, held_shares: dict[str, dict]
+) -> dict:
+    """Client side, unmask round: assemble this survivor's reveals for the server's
+    request — shares of SELF-mask seeds for survivors, shares of X25519 KEYS for
+    dropped clients.
+
+    Safety refusals (the Bonawitz §4 invariant — never both secrets of one client):
+    a request listing any id as both dropped and survivor, or listing *this* client as
+    dropped (it is alive and submitted), is rejected outright.
+    """
+    dropped, survivors = set(request["dropped"]), set(request["survivors"])
+    if dropped & survivors:
+        raise AggregationError(
+            "refusing unmask request: ids listed as both dropped and survivor "
+            "(revealing both secrets of one client would unmask its update)"
+        )
+    if my_id in dropped:
+        raise AggregationError(
+            "refusing unmask request that lists this live client as dropped"
+        )
+    if my_id not in survivors:
+        raise AggregationError("this client is not in the request's survivor set")
+    if (dropped | survivors) != set(held_shares):
+        # The request must PARTITION the exact round cohort this client distributed
+        # shares to — a subset/superset view is a server trying to carve the cohort
+        # into inconsistent reveal groups (see make_dropout_shares on why t > n/2
+        # closes the remaining split-partition angle).
+        raise AggregationError(
+            "refusing unmask request: dropped+survivors must partition the round "
+            f"cohort exactly (request covers {sorted(dropped | survivors)}, "
+            f"cohort is {sorted(held_shares)})"
+        )
+    return {
+        "sk": {d: {"x": held_shares[d]["x"], "values": held_shares[d]["sk"]}
+               for d in sorted(dropped)},
+        "b": {s: {"x": held_shares[s]["x"], "values": held_shares[s]["b"]}
+              for s in sorted(survivors)},
+    }
+
+
+def recover_unmasked_sum(
+    masked_updates: dict[str, np.ndarray],
+    client_order: Sequence[str],
+    public_keys: dict[str, bytes],
+    round_number: int,
+    reveals: dict[str, dict],
+    config: SecureAggregationConfig | None = None,
+    backend: str = "host",
+    self_seed_commitments: dict[str, bytes] | None = None,
+) -> np.ndarray:
+    """Server side, dropout-tolerant unmask: modular sum of the survivors' vectors with
+    the orphaned masks reconstructed and removed.
+
+    ``client_order`` / ``public_keys`` are THIS ROUND's active roster and EPHEMERAL
+    mask public keys (see ``make_dropout_shares`` on per-round freshness).
+
+    Correction terms (all from ≥ ``threshold`` Shamir shares in ``reveals``):
+    * every survivor's SELF mask ``PRG(HKDF(b_s, round))`` is subtracted;
+    * for every dropped client d, its pairwise masks with each survivor i are
+      re-derived from d's reconstructed ephemeral X25519 key and removed with the sign
+      i originally applied (+ if d follows i in the roster order, − otherwise).
+
+    Returns the corrected uint32 sum = the quantized weighted sum of the SURVIVORS'
+    updates; the caller dequantizes and renormalizes by the survivors' weight mass.
+    """
+    config = config or SecureAggregationConfig()
+    t = config.threshold
+    survivors = [c for c in client_order if c in masked_updates]
+    dropped = [c for c in client_order if c not in masked_updates]
+    if len(survivors) < config.min_clients:
+        # min_clients is the privacy floor every client enforced at mask time: a
+        # client that consented to hide in a crowd of >= min_clients must not have
+        # its update exposed in a smaller recovered sum.
+        raise AggregationError(
+            f"only {len(survivors)} survivors; refusing to reveal a sum below the "
+            f"min_clients={config.min_clients} privacy floor"
+        )
+    ctx = f"round:{round_number}".encode()
+    size = next(iter(masked_updates.values())).size
+
+    def collect(kind: str, target: str) -> list[Share]:
+        shares, seen_x = [], set()
+        for rv in reveals.values():
+            entry = rv.get(kind, {}).get(target)
+            if entry is None:
+                continue
+            x = int(entry["x"])
+            if x in seen_x:
+                continue  # duplicate evaluation point adds nothing
+            seen_x.add(x)
+            shares.append(Share(x=x, values=np.asarray(entry["values"], np.int64)))
+        if len(shares) < t:
+            raise AggregationError(
+                f"only {len(shares)} shares revealed for {kind}:{target}; need {t}"
+            )
+        return shares
+
+    total = np.zeros_like(next(iter(masked_updates.values())))
+    for s in survivors:
+        total = total + masked_updates[s]
+    # Remove survivors' self masks.  A corrupt/malicious share would make Lagrange
+    # interpolation yield a WRONG seed silently (any 32 bytes are "valid"), and the
+    # garbage-corrected sum would be installed as the global model with no error —
+    # verify each reconstruction against the commitment deposited with the epk.
+    for s in survivors:
+        b = reconstruct_secret_bytes(collect("b", s), t)
+        commit = (self_seed_commitments or {}).get(s)
+        if commit is not None:
+            digest = hashes.Hash(hashes.SHA256())
+            digest.update(b)
+            if digest.finalize() != commit:
+                raise AggregationError(
+                    f"reconstructed self seed for {s!r} fails its commitment "
+                    "(corrupt or malicious share) — failing the round"
+                )
+        total = total - expand_mask(_self_mask_seed(b, ctx), size, backend)
+    # Remove dropped clients' orphaned pairwise masks.
+    index = {c: i for i, c in enumerate(client_order)}
+    for d in dropped:
+        sk_raw = reconstruct_secret_bytes(collect("sk", d), t)
+        d_key = ClientKeyPair(private=X25519PrivateKey.from_private_bytes(sk_raw))
+        # Same silent-corruption hazard: verify the reconstructed key against the
+        # client's deposited ephemeral PUBLIC key before trusting its pair seeds.
+        if d_key.public_bytes() != public_keys[d]:
+            raise AggregationError(
+                f"reconstructed mask key for {d!r} does not match its deposited "
+                "ephemeral public key (corrupt or malicious share) — failing the round"
+            )
+        for s in survivors:
+            seed = _pair_seed(d_key, public_keys[s], ctx)
+            mask = expand_mask(seed, size, backend)
+            if index[d] > index[s]:
+                total = total - mask  # survivor s had ADDED this mask
+            else:
+                total = total + mask  # survivor s had SUBTRACTED it
+    return total
 
 
 # ---------------------------------------------------------------------------------------

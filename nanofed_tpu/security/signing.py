@@ -126,7 +126,8 @@ def verify_masked_signature(
 
 
 def enrollment_signing_bytes(client_id: str, x25519_public_key: bytes,
-                             num_samples: float, session: str) -> bytes:
+                             num_samples: float, session: str,
+                             backend: str = "host") -> bytes:
     """Byte string a secure-aggregation ENROLLMENT signature covers.
 
     Without this, a server enforcing signatures on updates would still accept a forged
@@ -144,6 +145,8 @@ def enrollment_signing_bytes(client_id: str, x25519_public_key: bytes,
         f"&client={client_id}&x25519={base64.b64encode(x25519_public_key).decode()}"
         f"&num_samples={float(num_samples)!r}"  # normalized: int 10 and float 10.0
         # must sign identically, since JSON round-trips both to float
+        f"&backend={backend}"  # the mask-expansion backend is part of the identity:
+        # a spliced backend would silently break cohort-wide mask cancellation
     ).encode()
 
 
@@ -154,12 +157,41 @@ def verify_enrollment_signature(
     session: str,
     signature: bytes,
     public_key: bytes,
+    backend: str = "host",
 ) -> bool:
     """Verify a secure-aggregation enrollment (see :func:`enrollment_signing_bytes`)."""
     return _verify_bytes(
-        enrollment_signing_bytes(client_id, x25519_public_key, num_samples, session),
+        enrollment_signing_bytes(
+            client_id, x25519_public_key, num_samples, session, backend
+        ),
         signature,
         public_key,
+    )
+
+
+def secagg_body_signing_bytes(
+    kind: str, body: bytes, client_id: str, context: str
+) -> bytes:
+    """Byte string a secure-aggregation auxiliary POST signature covers (share deposits
+    ``kind="shares"`` bound to the session nonce; unmask reveals ``kind="unmask"``
+    bound to the round).  Binds the verbatim JSON body: a forged share blob would make
+    some recipient's decryption fail at unmask time, and a forged reveal could
+    reconstruct garbage masks and corrupt the aggregate."""
+    return f"secagg-{kind}:client={client_id}&ctx={context}&body=".encode() + body
+
+
+def verify_secagg_body_signature(
+    kind: str,
+    body: bytes,
+    client_id: str,
+    context: str,
+    signature: bytes,
+    public_key: bytes,
+) -> bool:
+    """Verify a share-deposit or unmask-reveal body (see
+    :func:`secagg_body_signing_bytes`)."""
+    return _verify_bytes(
+        secagg_body_signing_bytes(kind, body, client_id, context), signature, public_key
     )
 
 
@@ -203,12 +235,19 @@ class SecurityManager:
 
     def sign_enrollment(
         self, client_id: str, x25519_public_key: bytes, num_samples: float,
-        session: str,
+        session: str, backend: str = "host",
     ) -> bytes:
         """Sign a secure-aggregation enrollment (see :func:`enrollment_signing_bytes`)."""
         data = enrollment_signing_bytes(
-            client_id, x25519_public_key, num_samples, session
+            client_id, x25519_public_key, num_samples, session, backend
         )
+        return self._private_key.sign(data, _PSS, hashes.SHA256())
+
+    def sign_secagg_body(self, kind: str, body: bytes, client_id: str,
+                         context: str) -> bytes:
+        """Sign a share-deposit (``kind="shares"``) or unmask-reveal
+        (``kind="unmask"``) body (see :func:`secagg_body_signing_bytes`)."""
+        data = secagg_body_signing_bytes(kind, body, client_id, context)
         return self._private_key.sign(data, _PSS, hashes.SHA256())
 
     def verify_signature(self, params: Params, signature: bytes, public_key: bytes) -> bool:

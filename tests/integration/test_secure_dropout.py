@@ -1,0 +1,302 @@
+"""Dropout-tolerant secure aggregation over the real HTTP transport, and mask-backend
+negotiation at registration.
+
+The reference gestures at threshold tolerance (``nanofed/server/aggregator/
+privacy.py:72-110``: Shamir-style share verification) but its transport cannot carry a
+masked round at all.  Here the full Bonawitz double-masking protocol (CCS 2017, §4)
+runs over real aiohttp sockets: enroll -> deposit sealed Shamir shares -> mask (pairwise
++ self) -> POST -> unmask round (survivors reveal shares) -> reconstruct orphaned masks
+-> weighted FedAvg of the survivors.  One flaky client no longer kills the cohort's
+round, while a delivered-but-presumed-dropped update stays private behind its self mask.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+
+from nanofed_tpu.aggregation.fedavg import fedavg_combine
+from nanofed_tpu.communication import (
+    HTTPClient,
+    HTTPServer,
+    NetworkCoordinator,
+    NetworkRoundConfig,
+)
+from nanofed_tpu.communication.network_coordinator import stack_model_updates
+from nanofed_tpu.core.types import ModelUpdate
+from nanofed_tpu.models import get_model
+from nanofed_tpu.security.secure_agg import (
+    ClientKeyPair,
+    SecureAggregationConfig,
+    build_unmask_reveals,
+    make_dropout_shares,
+    mask_update,
+    open_share_inbox,
+)
+
+PORT = 18560
+
+
+def _client_params(model, seed):
+    return model.init(jax.random.key(seed))
+
+
+async def _fetch_model_retry(client, like, attempts=100, delay=0.05):
+    from nanofed_tpu.core.exceptions import NanoFedError
+
+    for _ in range(attempts):
+        try:
+            return await client.fetch_global_model(like=like)
+        except NanoFedError:
+            await asyncio.sleep(delay)
+    raise TimeoutError("model never published")
+
+
+async def _run_tolerant_client(
+    port, cid, local_params, num_samples, cfg, drop_before_submit=False
+):
+    """Full dropout-tolerant client flow (per-round ephemeral secrets): enroll, then
+    each round — deposit fresh mask key + sealed shares, fetch the round's epks +
+    inbox, mask (pairwise + self), submit, answer the unmask round as a survivor.
+
+    ``drop_before_submit`` vanishes AFTER the share barrier (its pairwise masks are
+    baked into the survivors' vectors — the case recovery exists for)."""
+    identity = ClientKeyPair.generate()
+    async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as client:
+        assert await client.register_secagg(identity.public_bytes(), num_samples)
+        roster = await client.fetch_secagg_roster()
+        identity_pks = dict(roster.public_keys)
+        params, rnd, active = await _fetch_model_retry(client, local_params)
+        assert active
+        participants = await client.fetch_secagg_participants()
+        mask_key = ClientKeyPair.generate()
+        context = f"{client.secagg_session}:{rnd}"
+        self_seed, sealed = make_dropout_shares(
+            identity, mask_key, participants,
+            {c: identity_pks[c] for c in participants}, cfg.threshold,
+            my_id=cid, context=context,
+        )
+        import hashlib
+
+        assert await client.deposit_secagg_shares(
+            rnd, mask_key.public_bytes(), sealed,
+            self_seed_commitment=hashlib.sha256(self_seed).digest(),
+        )
+        epks, inbox = await client.fetch_secagg_inbox(rnd)
+        held = open_share_inbox(identity, cid, identity_pks, inbox, epks, context)
+        if drop_before_submit:
+            return  # shares distributed, then vanishes mid-round
+        masked = mask_update(
+            local_params,
+            participants.index(cid),
+            mask_key,
+            [epks[c] for c in participants],
+            rnd,
+            cfg,
+            weight=roster.weights[cid],
+            self_seed=self_seed,
+        )
+        assert await client.submit_masked_update(masked, {"num_samples": num_samples})
+        # Unmask round: poll until the server publishes the request, then reveal.
+        for _ in range(400):
+            request = await client.poll_unmask_request()
+            if request is not None and cid in request["survivors"]:
+                reveals = build_unmask_reveals(request, cid, held)
+                assert await client.submit_unmask_reveals(request["round"], reveals)
+                return
+            status = await client.check_server_status()
+            if not status.get("training_active", True):
+                return
+            await asyncio.sleep(0.05)
+
+
+def _run_round(port, cfg, clients, num_rounds=1, min_clients=None,
+               completion_rate=1.0, timeout=3.0):
+    """clients: list of (cid, params, num_samples, drops)."""
+    model_like = clients[0][1]
+
+    async def main():
+        server = HTTPServer(port=port)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, model_like,
+                NetworkRoundConfig(
+                    num_rounds=num_rounds,
+                    min_clients=min_clients or len(clients),
+                    min_completion_rate=completion_rate,
+                    round_timeout_s=timeout,
+                ),
+                secure=cfg,
+            )
+            await asyncio.gather(
+                coordinator.run(),
+                *(
+                    _run_tolerant_client(port, cid, p, n, cfg, drop)
+                    for cid, p, n, drop in clients
+                ),
+            )
+            return coordinator
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def test_dropout_round_completes_with_survivor_fedavg():
+    """THE VERDICT scenario: 1 of 5 enrolled clients drops mid-round (after its
+    pairwise masks are baked into everyone's vectors); the round still COMPLETES and
+    the aggregate equals the plain weighted FedAvg of the 4 survivors."""
+    model = get_model("linear", in_features=6, num_classes=2)
+    # min_clients=4 is the privacy floor: the recovered sum after one dropout still
+    # covers a crowd of 4, which every client consented to.
+    cfg = SecureAggregationConfig(
+        min_clients=4, frac_bits=16, threshold=3, dropout_tolerant=True
+    )
+    num_samples = {"c1": 30.0, "c2": 10.0, "c3": 20.0, "c4": 40.0, "c5": 25.0}
+    local = {c: _client_params(model, s) for s, c in enumerate(num_samples, start=1)}
+    clients = [(c, local[c], num_samples[c], c == "c3") for c in num_samples]
+
+    coordinator = _run_round(PORT, cfg, clients, completion_rate=0.5, timeout=2.5)
+    record = coordinator.history[0]
+    assert record["status"] == "COMPLETED"
+    assert record["num_clients"] == 4
+    assert record["num_dropped"] == 1
+
+    survivors = [c for c in num_samples if c != "c3"]
+    expected = fedavg_combine(stack_model_updates([
+        ModelUpdate(client_id=c, round_number=0, params=local[c],
+                    metrics={"num_samples": num_samples[c]}, timestamp="")
+        for c in survivors
+    ]))
+    for got, want in zip(jax.tree.leaves(coordinator.params), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_tolerant_mode_without_dropout_matches_fedavg():
+    """Zero dropouts in tolerant mode: the unmask round removes only self masks and
+    the aggregate equals plain weighted FedAvg of the full cohort."""
+    model = get_model("linear", in_features=4, num_classes=2)
+    cfg = SecureAggregationConfig(
+        min_clients=3, frac_bits=16, threshold=2, dropout_tolerant=True
+    )
+    num_samples = {"c1": 12.0, "c2": 24.0, "c3": 6.0}
+    local = {c: _client_params(model, s) for s, c in enumerate(num_samples, start=4)}
+    clients = [(c, local[c], num_samples[c], False) for c in num_samples]
+
+    coordinator = _run_round(PORT + 1, cfg, clients, timeout=3.0)
+    record = coordinator.history[0]
+    assert record["status"] == "COMPLETED"
+    assert record["num_dropped"] == 0
+    expected = fedavg_combine(stack_model_updates([
+        ModelUpdate(client_id=c, round_number=0, params=local[c],
+                    metrics={"num_samples": num_samples[c]}, timestamp="")
+        for c in num_samples
+    ]))
+    for got, want in zip(jax.tree.leaves(coordinator.params), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_too_many_dropouts_fail_closed():
+    """Survivors below max(required, threshold) must FAIL the round and leave params
+    untouched — recovery never degrades below the Shamir threshold."""
+    model = get_model("linear", in_features=4, num_classes=2)
+    init = _client_params(model, 0)
+    cfg = SecureAggregationConfig(
+        min_clients=5, frac_bits=16, threshold=4, dropout_tolerant=True
+    )
+    num_samples = {f"c{i}": 10.0 for i in range(1, 6)}
+    # 2 of 5 drop -> 3 survivors < threshold=4.
+    clients = [(c, init, num_samples[c], c in ("c2", "c4")) for c in num_samples]
+
+    coordinator = _run_round(PORT + 2, cfg, clients, completion_rate=0.5, timeout=1.5)
+    record = coordinator.history[0]
+    assert record["status"] == "FAILED"
+    assert record["num_dropped"] == 2
+    for got, want in zip(jax.tree.leaves(coordinator.params), jax.tree.leaves(init)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mixed_backend_cohort_refused_at_registration():
+    """Mask-backend negotiation (host-Philox vs device-PRNG streams are
+    wire-incompatible): the first enrollment pins the cohort backend and a mismatched
+    registration is refused with 409 AT REGISTRATION — not discovered post-hoc as a
+    garbage aggregate at dequantize."""
+
+    async def scenario():
+        server = HTTPServer(port=PORT + 3)
+        server.open_secagg(3)
+        await server.start()
+        try:
+            k1, k2 = ClientKeyPair.generate(), ClientKeyPair.generate()
+            async with HTTPClient(f"http://127.0.0.1:{PORT + 3}", "c1",
+                                  timeout_s=10) as c1:
+                assert await c1.register_secagg(k1.public_bytes(), 10.0,
+                                                backend="host")
+            async with HTTPClient(f"http://127.0.0.1:{PORT + 3}", "c2",
+                                  timeout_s=10) as c2:
+                # Mismatched backend -> refused at registration.
+                assert not await c2.register_secagg(k2.public_bytes(), 10.0,
+                                                    backend="device")
+                # Same client re-enrolls with the negotiated backend -> accepted.
+                assert await c2.register_secagg(k2.public_bytes(), 10.0,
+                                                backend="host")
+                roster_resp = await c2.check_server_status()
+                assert roster_resp["status"] == "success"
+            assert server.secagg_backend() == "host"
+            assert len(server.secagg_client_order()) == 2
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_evicted_client_cannot_submit_or_deposit():
+    """Eviction is enforced at the wire: an evicted client's masked update and share
+    deposit are refused with 403 (its round secrets were revealed — accepting its
+    vector would let it push slow-but-alive members past the round barrier)."""
+    import base64
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        server = HTTPServer(port=0)
+        server.open_secagg(3)
+        model = get_model("linear", in_features=3, num_classes=2)
+        await server.publish_model(_client_params(model, 0), 0)
+        client = TestClient(TestServer(server._app))
+        await client.start_server()
+        try:
+            for cid in ("c1", "c2", "c3"):
+                pk = ClientKeyPair.generate().public_bytes()
+                r = await client.post(
+                    "/secagg/register",
+                    json={"public_key": base64.b64encode(pk).decode(),
+                          "num_samples": 10.0},
+                    headers={"X-NanoFed-Client": cid},
+                )
+                assert r.status == 200
+            server.evict_secagg_clients(["c2"])
+            assert server.secagg_active_order() == ["c1", "c3"]
+            # Masked update from the evicted client: refused.
+            r = await client.post(
+                "/update", data=b"whatever",
+                headers={"X-NanoFed-Client": "c2", "X-NanoFed-Round": "0",
+                         "X-NanoFed-SecAgg": "masked"},
+            )
+            assert r.status == 403
+            assert "evicted" in (await r.json())["message"]
+            # Share deposit from the evicted client: refused (not in active cohort).
+            r = await client.post(
+                "/secagg/shares",
+                data=json.dumps({"epk": base64.b64encode(bytes(32)).decode(),
+                                 "blobs": {"c1": "x", "c3": "x"}}).encode(),
+                headers={"X-NanoFed-Client": "c2", "X-NanoFed-Round": "0",
+                         "Content-Type": "application/json"},
+            )
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())

@@ -230,3 +230,160 @@ class TestDeviceBackendMasking:
         pks = [k.public_bytes() for k in keys]
         with pytest.raises(ValueError, match="backend"):
             mask_update(_client_params(0), 0, keys[0], pks, 0, cfg, backend="gpu")
+
+
+class TestDropoutRecovery:
+    """Bonawitz §4 double masking: self masks + Shamir recovery of orphaned masks."""
+
+    CTX = "session0:0"
+
+    def _cohort(self, n, threshold, seed0=10):
+        from nanofed_tpu.security import make_dropout_shares, open_share_inbox
+
+        order = [f"c{i}" for i in range(n)]
+        # Long-lived identity keys seal the share transport; FRESH per-round mask
+        # keys carry the pairwise seeds (per-execution freshness is the security —
+        # revealing a dropped client's mask key burns only this round).
+        identity = {c: ClientKeyPair.generate() for c in order}
+        idpks = {c: identity[c].public_bytes() for c in order}
+        mask_keys = {c: ClientKeyPair.generate() for c in order}
+        epks = {c: mask_keys[c].public_bytes() for c in order}
+        params = {c: _client_params(seed0 + i) for i, c in enumerate(order)}
+        # Round start: every client shares its round secrets; "server" routes blobs.
+        self_seeds, outbox = {}, {}
+        for c in order:
+            self_seeds[c], outbox[c] = make_dropout_shares(
+                identity[c], mask_keys[c], order, idpks, threshold,
+                my_id=c, context=self.CTX,
+            )
+        # Each client opens its inbox (blob from every sender, self included),
+        # cross-checking the relayed epks against the sealed attestations.
+        held = {
+            c: open_share_inbox(
+                identity[c], c, idpks,
+                {sender: outbox[sender][c] for sender in order}, epks, self.CTX,
+            )
+            for c in order
+        }
+        return order, mask_keys, epks, params, self_seeds, held
+
+    def test_secret_bytes_share_roundtrip(self):
+        import secrets as pysecrets
+
+        from nanofed_tpu.security import reconstruct_secret_bytes, share_secret_bytes
+
+        secret = pysecrets.token_bytes(32)
+        shares = share_secret_bytes(secret, 5, 3)
+        assert reconstruct_secret_bytes(shares[1:4], 3) == secret
+        with pytest.raises(AggregationError):
+            reconstruct_secret_bytes(shares[:2], 3)
+
+    def test_sealed_share_transport(self):
+        from nanofed_tpu.security import open_share_payload, seal_share_payload
+
+        a, b = ClientKeyPair.generate(), ClientKeyPair.generate()
+        payload = {"x": 2, "sk": [1, 2], "b": [3, 4]}
+        blob = seal_share_payload(a, b.public_bytes(), payload)
+        assert open_share_payload(b, a.public_bytes(), blob) == payload
+        # A third party (the routing server) cannot open it.
+        eve = ClientKeyPair.generate()
+        with pytest.raises(InvalidTag):
+            open_share_payload(eve, a.public_bytes(), blob)
+
+    def test_dropout_round_recovers_survivor_sum(self):
+        from nanofed_tpu.security import (
+            build_unmask_reveals,
+            mask_update,
+            recover_unmasked_sum,
+        )
+        from nanofed_tpu.utils.trees import tree_ravel
+
+        cfg = SecureAggregationConfig(min_clients=3, threshold=3, dropout_tolerant=True)
+        order, keys, pks, params, self_seeds, held = self._cohort(5, cfg.threshold)
+        ordered_pks = [pks[c] for c in order]
+        # c3 drops AFTER enrollment (its pairwise masks are baked into everyone's
+        # vectors) — it never submits.
+        dropped, survivors = ["c3"], [c for c in order if c != "c3"]
+        masked = {
+            c: mask_update(params[c], order.index(c), keys[c], ordered_pks, 7, cfg,
+                           self_seed=self_seeds[c])
+            for c in survivors
+        }
+        request = {"round": 7, "dropped": dropped, "survivors": survivors}
+        reveals = {c: build_unmask_reveals(request, c, held[c]) for c in survivors}
+        total = recover_unmasked_sum(masked, order, pks, 7, reveals, cfg)
+        expected = np.zeros(total.size)
+        for c in survivors:
+            flat, _ = tree_ravel(params[c])
+            expected = expected + np.asarray(flat, np.float64)
+        np.testing.assert_allclose(
+            dequantize(total, cfg.frac_bits), expected, atol=1e-3
+        )
+
+    def test_no_dropout_still_needs_self_mask_removal(self):
+        from nanofed_tpu.security import (
+            build_unmask_reveals,
+            mask_update,
+            recover_unmasked_sum,
+        )
+        from nanofed_tpu.utils.trees import tree_ravel
+
+        cfg = SecureAggregationConfig(min_clients=3, threshold=2, dropout_tolerant=True)
+        order, keys, pks, params, self_seeds, held = self._cohort(3, cfg.threshold)
+        ordered_pks = [pks[c] for c in order]
+        masked = {
+            c: mask_update(params[c], order.index(c), keys[c], ordered_pks, 0, cfg,
+                           self_seed=self_seeds[c])
+            for c in order
+        }
+        # Pairwise masks cancel in the full sum, but self masks remain: the raw
+        # modular sum must NOT dequantize to the true sum.
+        raw = np.zeros_like(masked[order[0]])
+        for v in masked.values():
+            raw = raw + v
+        expected = np.zeros(raw.size)
+        for c in order:
+            flat, _ = tree_ravel(params[c])
+            expected = expected + np.asarray(flat, np.float64)
+        assert np.abs(dequantize(raw, cfg.frac_bits) - expected).max() > 1.0
+        request = {"round": 0, "dropped": [], "survivors": order}
+        reveals = {c: build_unmask_reveals(request, c, held[c]) for c in order}
+        total = recover_unmasked_sum(masked, order, pks, 0, reveals, cfg)
+        np.testing.assert_allclose(
+            dequantize(total, cfg.frac_bits), expected, atol=1e-3
+        )
+
+    def test_reveal_refusals(self):
+        from nanofed_tpu.security import build_unmask_reveals
+
+        held = {"c0": {"x": 1, "sk": [0] * 16, "b": [0] * 16},
+                "c1": {"x": 1, "sk": [0] * 16, "b": [0] * 16}}
+        # Overlapping dropped/survivor sets: would reveal both secrets of one client.
+        with pytest.raises(AggregationError):
+            build_unmask_reveals(
+                {"dropped": ["c1"], "survivors": ["c0", "c1"]}, "c0", held
+            )
+        # A live client listed as dropped refuses (it submitted this round).
+        with pytest.raises(AggregationError):
+            build_unmask_reveals({"dropped": ["c0"], "survivors": ["c1"]}, "c0", held)
+
+    def test_below_threshold_reveals_fail_closed(self):
+        from nanofed_tpu.security import (
+            build_unmask_reveals,
+            mask_update,
+            recover_unmasked_sum,
+        )
+
+        cfg = SecureAggregationConfig(min_clients=3, threshold=4, dropout_tolerant=True)
+        order, keys, pks, params, self_seeds, held = self._cohort(5, cfg.threshold)
+        ordered_pks = [pks[c] for c in order]
+        survivors = order[:3]  # 3 < threshold=4
+        masked = {
+            c: mask_update(params[c], order.index(c), keys[c], ordered_pks, 1, cfg,
+                           self_seed=self_seeds[c])
+            for c in survivors
+        }
+        request = {"round": 1, "dropped": order[3:], "survivors": survivors}
+        reveals = {c: build_unmask_reveals(request, c, held[c]) for c in survivors}
+        with pytest.raises(AggregationError):
+            recover_unmasked_sum(masked, order, pks, 1, reveals, cfg)
