@@ -99,8 +99,6 @@ async def run_client(client_id: str, url: str, local_fit, data, cfg, template,
                 if client_id not in participants:
                     print(f"  {client_id}: evicted from cohort; stopping")
                     return
-                import hashlib as _hashlib
-
                 mask_keypair = ClientKeyPair.generate()
                 context = f"{client.secagg_session}:{rnd}"
                 self_seed, sealed = make_dropout_shares(
@@ -110,7 +108,7 @@ async def run_client(client_id: str, url: str, local_fit, data, cfg, template,
                 )
                 assert await client.deposit_secagg_shares(
                     rnd, mask_keypair.public_bytes(), sealed,
-                    self_seed_commitment=_hashlib.sha256(self_seed).digest(),
+                    self_seed_commitment=hashlib.sha256(self_seed).digest(),
                 )
                 epks, inbox = await client.fetch_secagg_inbox(rnd, timeout_s=60)
                 held = open_share_inbox(
