@@ -111,13 +111,31 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
 
+    if args.dropout_tolerant and not args.secure:
+        print("error: --dropout-tolerant requires --secure (it is a secure-"
+              "aggregation mode)", file=sys.stderr)
+        return 2
+
     model = get_model(args.model)
     params = model.init(jax.random.key(args.seed))
     secure = None
     if args.secure:
         from nanofed_tpu.security.secure_agg import SecureAggregationConfig
 
-        secure = SecureAggregationConfig(min_clients=args.min_clients)
+        # Dropout-tolerant mode: threshold > n/2 (split-view defense), and the
+        # privacy floor must sit BELOW the enrolled cohort size or the survivor gate
+        # fails every round that has a dropout — the whole point of the mode.  One
+        # eviction's worth of slack mirrors the secure-federation example; operators
+        # wanting more tolerance lower --completion-rate.
+        floor = (
+            max(2, args.min_clients - 1) if args.dropout_tolerant
+            else args.min_clients
+        )
+        secure = SecureAggregationConfig(
+            min_clients=floor,
+            dropout_tolerant=args.dropout_tolerant,
+            threshold=args.min_clients // 2 + 1,
+        )
     validation = None
     if args.validate:
         from nanofed_tpu.security.validation import ValidationConfig
@@ -229,6 +247,12 @@ def main(argv: list[str] | None = None) -> int:
         "--secure", action="store_true",
         help="secure-aggregation rounds: clients enroll via /secagg and submit "
         "pairwise-masked updates; the server only ever sees the cohort sum",
+    )
+    serve.add_argument(
+        "--dropout-tolerant", action="store_true",
+        help="with --secure: Bonawitz double-masking — per-round ephemeral secrets, "
+        "Shamir share recovery of dropped clients' masks, survivor-only FedAvg "
+        "(threshold is set to min_clients//2+1)",
     )
     serve.add_argument(
         "--validate", action="store_true",

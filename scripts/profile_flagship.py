@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Profile the 1000-client flagship round on the real chip and attack the MFU
+(VERDICT r3 item 2: the round beats the target 17x yet leaves ~94% of the chip idle —
+5.84% MFU at client_chunk=125, batch 64, bf16).
+
+Three instruments, one artifact (``runs/profile_flagship_<tag>.json``):
+
+1. **Config sweep** — the knobs round time actually depends on:
+   ``client_chunk`` x {125, 250, 500, 1000} (scan trip count vs per-chunk width: fewer,
+   wider chunks amortize scan overhead and feed the MXU bigger batched convs, at the
+   cost of activation memory) crossed with per-client ``batch_size`` {60, 64} (each
+   client holds exactly 60 samples, so batch 64 pads every client's single batch with
+   4 dead rows — ~6.7% wasted compute — while batch 60 fits exactly).
+2. **Fixed-vs-compute decomposition** — rounds at local_epochs {2, 4} for the best
+   config: t(E) = fixed + E*per_epoch separates the per-epoch training compute from
+   per-round overhead (weight broadcast/donation, the psum-mean reduce, server-optax
+   step, metrics transfers).
+3. **Static MXU shape analysis** — the ceiling the model's own shapes impose: per-layer
+   FLOP shares x systolic-array utilization bounds from contraction/output-channel
+   padding to the 128-lane MXU (conv1 contracts 3x3x1=9 of 128 lanes; conv2 288/384
+   with 64/128 output channels; fc1 is near-ideal).  The measured MFU is judged
+   against THIS ceiling, not against 100%.
+
+Optionally captures a ``jax.profiler`` trace of one steady-state round of the best
+config (``--trace``; the trace dir is large and stays untracked — the JSON artifact
+records its path and the top-level timing split).
+
+Run on the real chip (default env).  CPU runs are refused unless ``--allow-cpu``
+(plumbing checks only — CPU timings say nothing about MXU behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Analytic per-sample training FLOPs (fwd 2*MACs, bwd ~2x fwd => 3x fwd), batch-60
+# basis; the padded batch-64 configs do 64/60 of this per sample-slot.
+_LAYERS = [
+    # (name, fwd MACs/sample, contraction K, output channels N)
+    ("conv1 3x3x1->32", 26 * 26 * 32 * 9 * 1, 9, 32),
+    ("conv2 3x3x32->64", 24 * 24 * 64 * 9 * 32, 9 * 32, 64),
+    ("fc1 9216->128", 9216 * 128, 9216, 128),
+    ("fc2 128->10", 128 * 10, 128, 10),
+]
+CNN_FWD_FLOPS = 2 * sum(m for _, m, _, _ in _LAYERS)
+CNN_TRAIN_FLOPS = 3 * CNN_FWD_FLOPS
+V5E_BF16_PEAK = 197e12
+MXU_LANES = 128
+
+
+def mxu_shape_analysis() -> dict:
+    """Static per-layer MXU utilization bound from shape padding (both matmul
+    operand dims pad to 128 lanes on the systolic array)."""
+    import math
+
+    total = sum(m for _, m, _, _ in _LAYERS)
+    layers, weighted = [], 0.0
+    for name, macs, k, n in _LAYERS:
+        util_k = k / (MXU_LANES * math.ceil(k / MXU_LANES))
+        util_n = n / (MXU_LANES * math.ceil(n / MXU_LANES))
+        util = util_k * util_n
+        share = macs / total
+        weighted += share * util
+        layers.append({
+            "layer": name, "flop_share": round(share, 4),
+            "contraction": k, "out_channels": n,
+            "mxu_utilization_bound": round(util, 4),
+        })
+    return {
+        "per_layer": layers,
+        "flop_weighted_mxu_ceiling": round(weighted, 4),
+        "note": (
+            "upper bound on achievable MFU from the model's own shapes: the MXU "
+            "contracts 128 lanes x 128 lanes, so a conv with 1 input channel "
+            "(contraction 9) can never use more than 9/128 of the array regardless "
+            "of scheduling; measured MFU should be read against this ceiling"
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--round-tag", default="r04")
+    ap.add_argument("--chunks", default="125,250,500,1000")
+    ap.add_argument("--batches", default="60,64")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--trace", action="store_true",
+                    help="capture a jax.profiler trace of the best config")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="plumbing check only — CPU timings are meaningless here")
+    ap.add_argument("--clients", type=int, default=1000)
+    ap.add_argument("--samples", type=int, default=60,
+                    help="samples per client (reduce for CPU plumbing checks)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+    from nanofed_tpu.data import pack_clients, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.parallel import (
+        build_round_step,
+        init_server_state,
+        make_mesh,
+        pad_client_count,
+        pad_clients,
+        replicated_sharding,
+        shard_client_data,
+    )
+    from nanofed_tpu.trainer import TrainingConfig, stack_rngs
+    from nanofed_tpu.utils.platform import enable_compilation_cache, log_stage
+
+    if jax.default_backend() != "tpu" and not args.allow_cpu:
+        print("refusing: not a TPU backend (pass --allow-cpu for a plumbing check)")
+        return 2
+    enable_compilation_cache()
+
+    n_clients, n_samples = args.clients, args.samples
+    mesh = make_mesh()
+    n_dev = len(mesh.devices.flat)
+    repl = replicated_sharding(mesh)
+    model = get_model("mnist_cnn")
+    strategy = fedavg_strategy()
+    t_start = time.time()
+
+    def run_config(chunk: int, batch: int, epochs: int, reps: int):
+        """Build + warm + time one (client_chunk, batch_size, local_epochs) config;
+        returns per-round times and the compile wall-clock."""
+        ds = synthetic_classification(n_samples * n_clients, 10, (28, 28, 1), seed=0)
+        data = pack_clients(
+            ds, [np.arange(i * n_samples, (i + 1) * n_samples) for i in range(n_clients)],
+            batch_size=batch,
+        )
+        padded = pad_client_count(n_clients, n_dev)
+        data = shard_client_data(pad_clients(data, padded), mesh)
+        num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1))
+        weights = compute_weights(num_samples) * (num_samples > 0)
+        training = TrainingConfig(batch_size=batch, local_epochs=epochs,
+                                  learning_rate=0.1, compute_dtype="bfloat16")
+        step = build_round_step(model.apply, training, mesh, strategy,
+                                client_chunk=chunk, donate=True)
+        params = jax.device_put(model.init(jax.random.key(0)), repl)
+        sos = jax.device_put(init_server_state(strategy, params), repl)
+        tc = time.perf_counter()
+        res = step(params, sos, data, weights, stack_rngs(jax.random.key(0), padded))
+        params, sos = res.params, res.server_opt_state
+        jax.block_until_ready(params)
+        compile_s = time.perf_counter() - tc
+        times = []
+        for r in range(1, reps + 1):
+            t = time.perf_counter()
+            res = step(params, sos, data, weights,
+                       stack_rngs(jax.random.key(r), padded))
+            params, sos = res.params, res.server_opt_state
+            jax.block_until_ready(params)
+            times.append(time.perf_counter() - t)
+        return times, compile_s, (step, params, sos, data, weights, padded)
+
+    def mfu(value_s: float, epochs: int, batch: int) -> float:
+        # Useful FLOPs (60 real samples/client); padded batch rows burn extra chip
+        # time but do no useful work, so they lower MFU rather than inflating FLOPs.
+        flops = CNN_TRAIN_FLOPS * epochs * n_samples * n_clients
+        return flops / value_s / (V5E_BF16_PEAK * n_dev)
+
+    sweep = []
+    best = None
+    for chunk in (int(c) for c in args.chunks.split(",")):
+        if n_clients % chunk and chunk < n_clients:
+            continue
+        for batch in (int(b) for b in args.batches.split(",")):
+            label = f"chunk={chunk} batch={batch}"
+            log_stage(f"sweep {label}: compiling + timing {args.reps} rounds",
+                      t0=t_start)
+            try:
+                times, compile_s, handles = run_config(chunk, batch, 2, args.reps)
+            except Exception as e:  # OOM at wide chunks is a finding, not a crash
+                log_stage(f"sweep {label}: FAILED ({type(e).__name__}: {e})",
+                          t0=t_start)
+                sweep.append({"client_chunk": chunk, "batch_size": batch,
+                              "error": f"{type(e).__name__}: {e}"})
+                continue
+            value = float(np.median(times))
+            row = {
+                "client_chunk": chunk, "batch_size": batch,
+                "round_s": round(value, 4),
+                "round_times_s": [round(t, 4) for t in times],
+                "compile_s": round(compile_s, 1),
+                "est_mfu_pct": round(100 * mfu(value, 2, batch), 2),
+            }
+            sweep.append(row)
+            log_stage(f"sweep {label}: {value:.4f}s/round "
+                      f"(MFU {row['est_mfu_pct']}%)", t0=t_start)
+            if best is None or value < best[0]:
+                best = (value, chunk, batch, handles)
+
+    if best is None:
+        print("no config completed")
+        return 1
+    best_value, best_chunk, best_batch, handles = best
+
+    # Fixed-vs-compute decomposition at the best config: t(E) = fixed + E*per_epoch.
+    log_stage(f"decomposition: best config chunk={best_chunk} batch={best_batch}; "
+              "timing local_epochs=4", t0=t_start)
+    times4, _, _ = run_config(best_chunk, best_batch, 4, args.reps)
+    t4 = float(np.median(times4))
+    per_epoch = max((t4 - best_value) / 2.0, 0.0)
+    fixed = max(best_value - 2 * per_epoch, 0.0)
+    decomposition = {
+        "round_s_at_2_epochs": round(best_value, 4),
+        "round_s_at_4_epochs": round(t4, 4),
+        "per_epoch_compute_s": round(per_epoch, 4),
+        "fixed_overhead_s": round(fixed, 4),
+        "fixed_share_pct": round(100 * fixed / best_value, 1),
+        "note": (
+            "fixed = per-round cost independent of training epochs (broadcast, "
+            "reduce+psum, server step, metric transfers, scan setup); per_epoch = "
+            "the MXU-bound local-SGD compute"
+        ),
+    }
+
+    trace_dir = None
+    if args.trace:
+        step, params, sos, data, weights, padded = handles
+        trace_dir = str(REPO / "runs" / f"profile_trace_{args.round_tag}")
+        log_stage(f"capturing jax.profiler trace to {trace_dir}", t0=t_start)
+        with jax.profiler.trace(trace_dir):
+            res = step(params, sos, data, weights,
+                       stack_rngs(jax.random.key(99), padded))
+            jax.block_until_ready(res.params)
+
+    ok = [r for r in sweep if "round_s" in r]
+    baseline = next((r for r in ok
+                     if r["client_chunk"] == 125 and r["batch_size"] == 64), None)
+    shape = mxu_shape_analysis()
+    artifact = {
+        "artifact": f"profile_flagship_{args.round_tag}",
+        "purpose": "VERDICT r3 item 2: where does the flagship round's time go, and "
+                   "how far is the measured MFU from the shape-imposed ceiling",
+        "workload": {"num_clients": n_clients, "samples_per_client": n_samples,
+                     "local_epochs": 2, "compute_dtype": "bfloat16",
+                     "model": "mnist_cnn"},
+        "device": str(jax.devices()[0]),
+        "platform": str(jax.devices()[0].platform),
+        "sweep": sweep,
+        "best": {"client_chunk": best_chunk, "batch_size": best_batch,
+                 "round_s": round(best_value, 4),
+                 "est_mfu_pct": round(100 * mfu(best_value, 2, best_batch), 2)},
+        "round3_baseline": {"client_chunk": 125, "batch_size": 64,
+                            "round_s_r03": 0.7502, "est_mfu_pct_r03": 5.84,
+                            "swept_here": baseline},
+        "decomposition": decomposition,
+        "mxu_shape_analysis": shape,
+        "trace_dir": trace_dir,
+        "mfu_basis": f"useful FLOPs only ({n_samples} samples/client x {n_clients} clients x "
+                     f"2 epochs x {CNN_TRAIN_FLOPS / 1e6:.1f} MFLOP/sample-pass) at "
+                     f"{V5E_BF16_PEAK / 1e12:.0f} TFLOP/s bf16 peak per chip",
+    }
+    out = REPO / "runs" / f"profile_flagship_{args.round_tag}.json"
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(artifact, indent=2))
+    print(json.dumps({k: artifact[k] for k in
+                      ("best", "decomposition", "round3_baseline")}, indent=2))
+    print(f"shape ceiling: {shape['flop_weighted_mxu_ceiling']:.1%} "
+          f"(measured best MFU {artifact['best']['est_mfu_pct']}%)")
+    log_stage(f"artifact written to {out}", t0=t_start)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

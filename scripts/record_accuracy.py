@@ -43,6 +43,12 @@ def main() -> int:
         help="evidence model when MNIST is unavailable: digits_mlp on native 8x8, or "
         "the flagship MNIST CNN on the real digits bilinearly upsampled to 28x28",
     )
+    ap.add_argument(
+        "--clients", type=int, default=None,
+        help="override the client count (north-star configs: 100/1000; with the "
+        "1,797-image digits set, 100 clients is a realistic ~18-images-per-client "
+        "cross-device regime — the artifact name and body record the count)",
+    )
     args = ap.parse_args()
 
     from nanofed_tpu.utils.platform import (
@@ -99,6 +105,14 @@ def main() -> int:
         training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
         num_clients, batch_eval = 8, 128
 
+    if args.clients is not None:
+        import dataclasses
+
+        num_clients = args.clients
+        dataset = f"{dataset}_{num_clients}c"
+        if num_clients * 2 > len(train):
+            # Degenerate shards (< 2 images/client) — keep batches meaningful.
+            training = dataclasses.replace(training, batch_size=2)
     log_stage(f"dataset={train.name}: {len(train)} train / {len(test)} test (REAL data)")
     cd = federate(train, num_clients=num_clients, scheme="iid",
                   batch_size=training.batch_size, seed=0)

@@ -59,7 +59,7 @@ def _write(name: str, artifact: dict) -> Path:
     return out
 
 
-def run_dp(tag: str) -> int:
+def run_dp(tag: str, model_name: str = "linear") -> int:
     """DP-FedAvg privacy-utility curve on REAL digits.
 
     Central DP only pays off in the many-clients regime: per-round SNR of the noised
@@ -68,6 +68,12 @@ def run_dp(tag: str) -> int:
     runs — uses many clients, a small model, and client-subsampling amplification.
     Arms: no-DP control + ε ∈ {1, 4, 8}, each σ calibrated for the full run via RDP
     with q = participation_rate.
+
+    ``model_name="cnn"`` runs the same arms with the FLAGSHIP MNIST CNN on the real
+    digits upsampled to 28x28 (VERDICT r3 item 7): DP noise hurts a 1.2M-parameter
+    model differently than logistic regression — noise ℓ2 grows with √d — so the
+    utility half of "privacy-utility" is measured on the model the framework
+    headlines, not a stand-in.
     """
     import jax
 
@@ -89,8 +95,18 @@ def run_dp(tag: str) -> int:
     clip = 0.5
     train = load_digits_dataset("train")
     test = load_digits_dataset("test")
-    model = get_model("linear", in_features=64, num_classes=10)
-    training = TrainingConfig(batch_size=6, local_epochs=4, learning_rate=0.3)
+    if model_name == "cnn":
+        from nanofed_tpu.data.datasets import resize_images
+
+        train = resize_images(train, 28, 28)
+        test = resize_images(test, 28, 28)
+        model = get_model("mnist_cnn")
+        model_desc = "mnist_cnn (flagship ~1.2M params) on digits@28x28"
+        training = TrainingConfig(batch_size=4, local_epochs=4, learning_rate=0.1)
+    else:
+        model = get_model("linear", in_features=64, num_classes=10)
+        model_desc = "linear(64->10)"
+        training = TrainingConfig(batch_size=6, local_epochs=4, learning_rate=0.3)
 
     def make_coord(central_privacy, seed=0):
         return Coordinator(
@@ -135,14 +151,17 @@ def run_dp(tag: str) -> int:
         print(f"eps={budget_eps:g}: sigma={sigma:.3f} final acc={final_acc} "
               f"(spent {spent.epsilon_spent:.3f})", flush=True)
 
-    _write(f"dp_fedavg_{tag}", {
-        "artifact": f"dp_fedavg_{tag}",
+    name = f"dp_fedavg_{tag}" if model_name != "cnn" else f"dp_fedavg_cnn_{tag}"
+    _write(name, {
+        "artifact": name,
         "benchmark": "dp_fedavg_mnist (BASELINE.json config #4): privacy-utility curve",
         "dataset": train.name,
         "real_data": True,
-        "data_note": "REAL sklearn digits (8x8; MNIST unfetchable here — see "
-                     "runs/mnist_fetch_attempt_*.log)",
-        "model": "linear(64->10)",
+        "data_note": "REAL sklearn digits (MNIST unfetchable here — see "
+                     "runs/mnist_fetch_attempt_*.log)"
+                     + ("; upsampled 8x8 -> 28x28 for the flagship CNN input"
+                        if model_name == "cnn" else ""),
+        "model": model_desc,
         "regime": {"num_clients": num_clients, "participation_rate": participation,
                    "cohort_size": cohort,
                    "num_rounds": num_rounds, "clip_norm": clip,
@@ -276,14 +295,19 @@ def main() -> int:
         "the artifact records the platform either way)",
     )
     ap.add_argument("--n-devices", type=int, default=8)
+    ap.add_argument(
+        "--model", choices=["linear", "cnn"], default="linear",
+        help="dp mode only: 'cnn' runs the arms with the flagship MNIST CNN on "
+        "digits@28x28 (VERDICT r3 item 7)",
+    )
     args = ap.parse_args()
     if args.platform == "cpu":
         from nanofed_tpu.utils.platform import force_cpu_mesh
 
         force_cpu_mesh(args.n_devices)
-    return {"dp": run_dp, "fedprox": run_fedprox, "labelskew": run_labelskew}[
-        args.mode
-    ](args.round_tag)
+    if args.mode == "dp":
+        return run_dp(args.round_tag, model_name=args.model)
+    return {"fedprox": run_fedprox, "labelskew": run_labelskew}[args.mode](args.round_tag)
 
 
 if __name__ == "__main__":
