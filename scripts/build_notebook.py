@@ -102,6 +102,24 @@ the pairwise masks cancel *exactly* — and dequantizes the cohort's weighted me
 never sees an individual update. (This is the single-round no-dropout Bonawitz variant;
 a missing client fails the round closed.)""",
     # 11
+    """## 10. Dropout-tolerant secure aggregation (double masking)
+
+In a real federation, dropout is the common case — one flaky phone must not kill the
+cohort's round. `dropout_tolerant=True` runs the Bonawitz §4 double-masking variant:
+
+1. each round, every client draws a **fresh ephemeral mask key + self-mask seed** and
+   Shamir-shares both across the cohort (sealed blobs routed through — but unreadable
+   by — the server; per-round freshness means a reveal burns only that round);
+2. clients mask with pairwise streams **plus a self mask** and submit;
+3. whoever misses the timeout is *dropped*: survivors answer the server's **unmask
+   request** with shares of the dropped clients' mask keys and the survivors' self
+   seeds — never both secrets of one client;
+4. the coordinator reconstructs the orphaned masks, completes the round as the
+   **weighted FedAvg of the survivors**, and evicts the dropped client.
+
+Below, `c3` vanishes mid-round (after the share barrier — its masks are already baked
+into everyone's vectors) and the round still completes from 3 survivors.""",
+    # 12
     """## Where to go next
 
 - **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
@@ -109,7 +127,9 @@ a missing client fails the round closed.)""",
   Measured on ONE real v5e chip: 0.75 s for a 1000-client round (`runs/bench_tpu_r03.json`).
 - **Real networks**: `nanofed_tpu.communication` has a binary-payload HTTP server/client
   with RSA-PSS-signed updates; `examples/secure_federation/run_secure.py` is the full
-  secure-aggregation protocol as a runnable script.
+  secure-aggregation protocol as a runnable script (`--dropout-tolerant --drop-client 2`
+  demos multi-round recovery + eviction), and `nanofed-tpu serve --secure
+  --dropout-tolerant` hosts it from the CLI.
 - **Profiling**: `nanofed_tpu.utils.profiling.trace` captures TensorBoard/Perfetto
   device traces of a round.
 - **Benchmarks**: `nanofed-tpu bench --list`; accuracy evidence in
@@ -270,6 +290,83 @@ print("history:", nc.history)
 delta = jax.tree.map(lambda a, b: float(np.abs(np.asarray(a - b)).max()),
                      nc.params, init)
 print("aggregate moved (max |leaf delta|):", delta)""",
+    # K (after MD 11) — dropout-tolerant double masking with a mid-round crash
+    """import hashlib
+from nanofed_tpu.security.secure_agg import (build_unmask_reveals,
+                                             make_dropout_shares, open_share_inbox)
+
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    PORT2 = s.getsockname()[1]
+
+# threshold > n/2 (split-view defense); min_clients=3 is the privacy floor the
+# 3 survivors still satisfy.
+cfg_t = SecureAggregationConfig(min_clients=3, threshold=3, dropout_tolerant=True)
+order4 = [f"c{i}" for i in range(4)]
+local4 = {c: model.init(jax.random.key(20 + i)) for i, c in enumerate(order4)}
+
+async def tolerant_client(cid, n_samples, drops=False):
+    identity = ClientKeyPair.generate()
+    async with HTTPClient(f"http://127.0.0.1:{PORT2}", cid, timeout_s=30) as c:
+        assert await c.register_secagg(identity.public_bytes(), n_samples)
+        roster = await c.fetch_secagg_roster()
+        for _ in range(200):
+            try:
+                params, rnd, active = await c.fetch_global_model(like=init)
+                break
+            except Exception:
+                await asyncio.sleep(0.05)
+        # Round start: fresh ephemeral secrets, Shamir-shared across the cohort.
+        participants = await c.fetch_secagg_participants()
+        mask_key = ClientKeyPair.generate()
+        ctx = f"{c.secagg_session}:{rnd}"
+        self_seed, sealed = make_dropout_shares(
+            identity, mask_key, participants,
+            {p: roster.public_keys[p] for p in participants}, cfg_t.threshold,
+            my_id=cid, context=ctx)
+        assert await c.deposit_secagg_shares(
+            rnd, mask_key.public_bytes(), sealed,
+            self_seed_commitment=hashlib.sha256(self_seed).digest())
+        epks, inbox = await c.fetch_secagg_inbox(rnd)
+        held = open_share_inbox(identity, cid, roster.public_keys, inbox, epks, ctx)
+        if drops:
+            print(f"  {cid}: crashing mid-round (after the share barrier)")
+            return
+        masked = mask_update(local4[cid], participants.index(cid), mask_key,
+                             [epks[p] for p in participants], rnd, cfg_t,
+                             weight=roster.weights[cid], self_seed=self_seed)
+        await c.submit_masked_update(masked, {"num_samples": n_samples})
+        for _ in range(600):                       # answer the unmask round
+            request = await c.poll_unmask_request()
+            if request is not None and cid in request["survivors"]:
+                await c.submit_unmask_reveals(
+                    request["round"], build_unmask_reveals(request, cid, held))
+                return
+            status = await c.check_server_status()
+            if not status.get("training_active", True):
+                return
+            await asyncio.sleep(0.05)
+
+async def tolerant_round():
+    server = HTTPServer(port=PORT2)
+    await server.start()
+    try:
+        nc = NetworkCoordinator(server, init,
+                                NetworkRoundConfig(num_rounds=1, min_clients=4,
+                                                   min_completion_rate=0.5,
+                                                   round_timeout_s=2.5),
+                                secure=cfg_t)
+        await asyncio.gather(nc.run(),
+                             tolerant_client("c0", 30.0), tolerant_client("c1", 10.0),
+                             tolerant_client("c2", 20.0),
+                             tolerant_client("c3", 40.0, drops=True))
+        return nc
+    finally:
+        await server.stop()
+
+nc2 = await tolerant_round()
+print("history:", nc2.history)
+assert nc2.history[0]["status"] == "COMPLETED" and nc2.history[0]["num_dropped"] == 1""",
 ]
 
 
@@ -279,11 +376,11 @@ def build() -> nbf.NotebookNode:
                                  "language": "python"}
     cells = [nbf.v4.new_markdown_cell(MD[0])]
     pairs = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4), (6, 5), (7, 6), (8, 7),
-             (9, 8), (10, 9)]
+             (9, 8), (10, 9), (11, 10)]
     for md_i, code_i in pairs:
         cells.append(nbf.v4.new_markdown_cell(MD[md_i]))
         cells.append(nbf.v4.new_code_cell(CODE[code_i]))
-    cells.append(nbf.v4.new_markdown_cell(MD[11]))
+    cells.append(nbf.v4.new_markdown_cell(MD[12]))
     nb.cells = cells
     return nb
 
