@@ -211,8 +211,13 @@ for m in dp_history:
           f"ε spent={m.agg_metrics['privacy_epsilon']:.3f} "
           f"(δ={m.agg_metrics['privacy_delta']:.0e})")""",
     # H (after MD 8)
-    """from nanofed_tpu.persistence import FileStateStore
+    """import shutil
 
+from nanofed_tpu.persistence import FileStateStore
+
+# Fresh store: a leftover checkpoint from an earlier run would make BOTH
+# coordinators resume instead of demonstrating train -> crash -> resume.
+shutil.rmtree("runs/tutorial_ckpt", ignore_errors=True)
 store = FileStateStore("runs/tutorial_ckpt")
 c1 = Coordinator(model=model, train_data=client_data,
                  config=CoordinatorConfig(num_rounds=2, seed=0,
@@ -316,6 +321,8 @@ async def tolerant_client(cid, n_samples, drops=False):
                 break
             except Exception:
                 await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("model never published")
         # Round start: fresh ephemeral secrets, Shamir-shared across the cohort.
         participants = await c.fetch_secagg_participants()
         mask_key = ClientKeyPair.generate()

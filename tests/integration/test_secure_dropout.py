@@ -54,16 +54,23 @@ async def _fetch_model_retry(client, like, attempts=100, delay=0.05):
 
 
 async def _run_tolerant_client(
-    port, cid, local_params, num_samples, cfg, drop_before_submit=False
+    port, cid, local_params, num_samples, cfg, drop_before_submit=False,
+    security_manager=None, pre_deposit_hook=None,
 ):
     """Full dropout-tolerant client flow (per-round ephemeral secrets): enroll, then
     each round — deposit fresh mask key + sealed shares, fetch the round's epks +
     inbox, mask (pairwise + self), submit, answer the unmask round as a survivor.
 
     ``drop_before_submit`` vanishes AFTER the share barrier (its pairwise masks are
-    baked into the survivors' vectors — the case recovery exists for)."""
+    baked into the survivors' vectors — the case recovery exists for).
+    ``security_manager`` signs every request (for require_signatures servers);
+    ``pre_deposit_hook(client, rnd, mask_key, sealed, commitment)`` runs before the
+    honest deposit (e.g. to attempt a forged one)."""
+    import hashlib
+
     identity = ClientKeyPair.generate()
-    async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as client:
+    async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30,
+                          security_manager=security_manager) as client:
         assert await client.register_secagg(identity.public_bytes(), num_samples)
         roster = await client.fetch_secagg_roster()
         identity_pks = dict(roster.public_keys)
@@ -77,11 +84,11 @@ async def _run_tolerant_client(
             {c: identity_pks[c] for c in participants}, cfg.threshold,
             my_id=cid, context=context,
         )
-        import hashlib
-
+        commitment = hashlib.sha256(self_seed).digest()
+        if pre_deposit_hook is not None:
+            await pre_deposit_hook(client, rnd, mask_key, sealed, commitment)
         assert await client.deposit_secagg_shares(
-            rnd, mask_key.public_bytes(), sealed,
-            self_seed_commitment=hashlib.sha256(self_seed).digest(),
+            rnd, mask_key.public_bytes(), sealed, self_seed_commitment=commitment,
         )
         epks, inbox = await client.fetch_secagg_inbox(rnd)
         held = open_share_inbox(identity, cid, identity_pks, inbox, epks, context)
@@ -300,3 +307,83 @@ def test_evicted_client_cannot_submit_or_deposit():
             await client.close()
 
     asyncio.run(scenario())
+
+
+def test_signed_tolerant_round_with_dropout():
+    """require_signatures=True covers the dropout-tolerant aux endpoints too: share
+    deposits sign over session:round, unmask reveals over session:round — and the
+    full signed round with a dropout still completes.  An unsigned deposit from an
+    enrolled id bounces with 403."""
+    from nanofed_tpu.security.signing import SecurityManager
+
+    model = get_model("linear", in_features=4, num_classes=2)
+    cfg = SecureAggregationConfig(
+        min_clients=3, frac_bits=16, threshold=3, dropout_tolerant=True
+    )
+    ids = ["c1", "c2", "c3", "c4"]
+    managers = {c: SecurityManager(key_size=1024) for c in ids}
+    num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
+    local = {c: _client_params(model, 30 + i) for i, c in enumerate(ids)}
+    deposit_rejected = {}
+
+    async def forge_deposit(client, rnd, mask_key, sealed, commitment):
+        # Same payload, no signature: must bounce 403 and never count toward the
+        # share barrier.  finally: an exception here (e.g. transient socket error)
+        # must not leave the client unsigned for its HONEST requests.
+        manager = client.security_manager
+        client.security_manager = None
+        try:
+            ok = await client.deposit_secagg_shares(
+                rnd, mask_key.public_bytes(), sealed,
+                self_seed_commitment=commitment,
+            )
+            deposit_rejected[client.client_id] = not ok
+        finally:
+            client.security_manager = manager
+
+    async def main():
+        server = HTTPServer(
+            port=PORT + 5,
+            client_keys={c: m.get_public_key() for c, m in managers.items()},
+            require_signatures=True,
+        )
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, _client_params(model, 0),
+                NetworkRoundConfig(num_rounds=1, min_clients=4,
+                                   min_completion_rate=0.5, round_timeout_s=2.5),
+                secure=cfg,
+            )
+            await asyncio.gather(
+                coordinator.run(),
+                _run_tolerant_client(PORT + 5, "c1", local["c1"], num_samples["c1"],
+                                     cfg, security_manager=managers["c1"],
+                                     pre_deposit_hook=forge_deposit),
+                _run_tolerant_client(PORT + 5, "c2", local["c2"], num_samples["c2"],
+                                     cfg, security_manager=managers["c2"]),
+                _run_tolerant_client(PORT + 5, "c3", local["c3"], num_samples["c3"],
+                                     cfg, security_manager=managers["c3"]),
+                _run_tolerant_client(PORT + 5, "c4", local["c4"], num_samples["c4"],
+                                     cfg, security_manager=managers["c4"],
+                                     drop_before_submit=True),
+            )
+            return coordinator
+        finally:
+            await server.stop()
+
+    coordinator = asyncio.run(main())
+    assert deposit_rejected == {"c1": True}
+    record = coordinator.history[0]
+    assert record["status"] == "COMPLETED"
+    assert record["num_clients"] == 3
+    assert record["num_dropped"] == 1
+    survivors = ["c1", "c2", "c3"]
+    expected = fedavg_combine(stack_model_updates([
+        ModelUpdate(client_id=c, round_number=0, params=local[c],
+                    metrics={"num_samples": num_samples[c]}, timestamp="")
+        for c in survivors
+    ]))
+    for got, want in zip(jax.tree.leaves(coordinator.params),
+                         jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
