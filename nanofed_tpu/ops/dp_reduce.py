@@ -27,8 +27,13 @@ all — these kernels target the stacked host/materializing paths, and exist to 
 SURVEY.md §2's native-performance-layer mandate with measured numbers
 (``scripts/measure_pallas.py`` writes ``runs/pallas_reduce_*.json``).
 
-MEASURED (fill in by scripts/measure_pallas.py on the real chip): see module-level
-``MEASURED`` note appended to the artifact.
+Measurement status: ``scripts/measure_pallas.py`` (run standalone or as the
+``pallas`` stage of ``scripts/tpu_campaign.py``) writes ``runs/pallas_reduce_*.json``
+with the kernel-vs-XLA timings at the 1000 x 1.2M flagship shape and a verdict on
+which implementation the stacked central-DP paths should use.  Round-4 note: the
+accelerator tunnel was down for the builder session (``bench.py`` appends each failed
+attempt's diagnostics to ``runs/bench_accel_failure.log`` when that happens); the
+campaign captures this artifact automatically the moment the chip answers.
 """
 
 from __future__ import annotations

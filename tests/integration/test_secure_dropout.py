@@ -53,69 +53,83 @@ async def _fetch_model_retry(client, like, attempts=100, delay=0.05):
     raise TimeoutError("model never published")
 
 
-async def _run_tolerant_client(
-    port, cid, local_params, num_samples, cfg, drop_before_submit=False,
-    security_manager=None, pre_deposit_hook=None,
-):
-    """Full dropout-tolerant client flow (per-round ephemeral secrets): enroll, then
-    each round — deposit fresh mask key + sealed shares, fetch the round's epks +
-    inbox, mask (pairwise + self), submit, answer the unmask round as a survivor.
+async def _participate_once(client, identity, roster, cid, local_params,
+                            num_samples, cfg, rnd, drop_after_shares=False,
+                            pre_deposit_hook=None):
+    """ONE round of dropout-tolerant participation (the wire protocol, shared by the
+    single-round and multi-round drivers so it exists in exactly one place): fetch the
+    active roster, distribute fresh ephemeral secrets, mask (pairwise + self), submit,
+    answer the unmask round.  Returns 'evicted', 'dropped', or 'done'.
 
-    ``drop_before_submit`` vanishes AFTER the share barrier (its pairwise masks are
-    baked into the survivors' vectors — the case recovery exists for).
-    ``security_manager`` signs every request (for require_signatures servers);
+    ``drop_after_shares`` vanishes AFTER the share barrier (its pairwise masks are
+    baked into the survivors' vectors — the case recovery exists for);
     ``pre_deposit_hook(client, rnd, mask_key, sealed, commitment)`` runs before the
     honest deposit (e.g. to attempt a forged one)."""
     import hashlib
 
+    participants = await client.fetch_secagg_participants()
+    if cid not in participants:
+        return "evicted"
+    mask_key = ClientKeyPair.generate()
+    context = f"{client.secagg_session}:{rnd}"
+    self_seed, sealed = make_dropout_shares(
+        identity, mask_key, participants,
+        {c: roster.public_keys[c] for c in participants}, cfg.threshold,
+        my_id=cid, context=context,
+    )
+    commitment = hashlib.sha256(self_seed).digest()
+    if pre_deposit_hook is not None:
+        await pre_deposit_hook(client, rnd, mask_key, sealed, commitment)
+    assert await client.deposit_secagg_shares(
+        rnd, mask_key.public_bytes(), sealed, self_seed_commitment=commitment,
+    )
+    epks, inbox = await client.fetch_secagg_inbox(rnd)
+    held = open_share_inbox(identity, cid, roster.public_keys, inbox, epks, context)
+    if drop_after_shares:
+        return "dropped"
+    masked = mask_update(
+        local_params,
+        participants.index(cid),
+        mask_key,
+        [epks[c] for c in participants],
+        rnd,
+        cfg,
+        weight=roster.weights[cid],
+        self_seed=self_seed,
+    )
+    assert await client.submit_masked_update(masked, {"num_samples": num_samples})
+    # Unmask round: poll until the server publishes the request, then reveal (or the
+    # round resolves without needing this reveal / training ends).
+    for _ in range(400):
+        request = await client.poll_unmask_request()
+        if (request is not None and request["round"] == rnd
+                and cid in request["survivors"]):
+            reveals = build_unmask_reveals(request, cid, held)
+            assert await client.submit_unmask_reveals(rnd, reveals)
+            return "done"
+        status = await client.check_server_status()
+        if not status.get("training_active", True) or status["round"] != rnd:
+            return "done"
+        await asyncio.sleep(0.05)
+    return "done"
+
+
+async def _run_tolerant_client(
+    port, cid, local_params, num_samples, cfg, drop_before_submit=False,
+    security_manager=None, pre_deposit_hook=None,
+):
+    """Single-round dropout-tolerant client: enroll, then one _participate_once."""
     identity = ClientKeyPair.generate()
     async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30,
                           security_manager=security_manager) as client:
         assert await client.register_secagg(identity.public_bytes(), num_samples)
         roster = await client.fetch_secagg_roster()
-        identity_pks = dict(roster.public_keys)
         params, rnd, active = await _fetch_model_retry(client, local_params)
         assert active
-        participants = await client.fetch_secagg_participants()
-        mask_key = ClientKeyPair.generate()
-        context = f"{client.secagg_session}:{rnd}"
-        self_seed, sealed = make_dropout_shares(
-            identity, mask_key, participants,
-            {c: identity_pks[c] for c in participants}, cfg.threshold,
-            my_id=cid, context=context,
+        await _participate_once(
+            client, identity, roster, cid, local_params, num_samples, cfg, rnd,
+            drop_after_shares=drop_before_submit, pre_deposit_hook=pre_deposit_hook,
         )
-        commitment = hashlib.sha256(self_seed).digest()
-        if pre_deposit_hook is not None:
-            await pre_deposit_hook(client, rnd, mask_key, sealed, commitment)
-        assert await client.deposit_secagg_shares(
-            rnd, mask_key.public_bytes(), sealed, self_seed_commitment=commitment,
-        )
-        epks, inbox = await client.fetch_secagg_inbox(rnd)
-        held = open_share_inbox(identity, cid, identity_pks, inbox, epks, context)
-        if drop_before_submit:
-            return  # shares distributed, then vanishes mid-round
-        masked = mask_update(
-            local_params,
-            participants.index(cid),
-            mask_key,
-            [epks[c] for c in participants],
-            rnd,
-            cfg,
-            weight=roster.weights[cid],
-            self_seed=self_seed,
-        )
-        assert await client.submit_masked_update(masked, {"num_samples": num_samples})
-        # Unmask round: poll until the server publishes the request, then reveal.
-        for _ in range(400):
-            request = await client.poll_unmask_request()
-            if request is not None and cid in request["survivors"]:
-                reveals = build_unmask_reveals(request, cid, held)
-                assert await client.submit_unmask_reveals(request["round"], reveals)
-                return
-            status = await client.check_server_status()
-            if not status.get("training_active", True):
-                return
-            await asyncio.sleep(0.05)
 
 
 def _run_round(port, cfg, clients, num_rounds=1, min_clients=None,
@@ -387,3 +401,104 @@ def test_signed_tolerant_round_with_dropout():
     for got, want in zip(jax.tree.leaves(coordinator.params),
                          jax.tree.leaves(expected)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_multiround_eviction_keeps_later_rounds_fast():
+    """Across rounds: round 0 completes with the full cohort, the round-1 dropout is
+    EVICTED, and round 2 completes promptly with the shrunk cohort (no stall waiting
+    for the corpse).  Pins the per-round fresh-secrets + eviction lifecycle the
+    example demonstrates."""
+    import time
+
+    model = get_model("linear", in_features=4, num_classes=2)
+    cfg = SecureAggregationConfig(
+        min_clients=2, frac_bits=16, threshold=2, dropout_tolerant=True
+    )
+    ids = ["c1", "c2", "c3"]
+    num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
+    local = {c: _client_params(model, 40 + i) for i, c in enumerate(ids)}
+
+    async def multi_round_client(cid, drop_at_round=None):
+        """Loops rounds via the shared _participate_once, honoring eviction."""
+        identity = ClientKeyPair.generate()
+        async with HTTPClient(f"http://127.0.0.1:{PORT + 6}", cid,
+                              timeout_s=30) as client:
+            assert await client.register_secagg(
+                identity.public_bytes(), num_samples[cid]
+            )
+            roster = await client.fetch_secagg_roster()
+            seen_round = -1
+            fetch_failures = 0
+            while True:
+                try:
+                    params, rnd, active = await client.fetch_global_model(
+                        like=local[cid]
+                    )
+                    fetch_failures = 0
+                except Exception:
+                    # Bounded like _fetch_model_retry: a persistent fetch failure
+                    # must surface HERE, not as a far-away round-status assert.
+                    fetch_failures += 1
+                    if fetch_failures > 100:
+                        raise
+                    await asyncio.sleep(0.05)
+                    continue
+                if not active:
+                    return
+                if rnd == seen_round:
+                    await asyncio.sleep(0.05)
+                    continue
+                seen_round = rnd
+                outcome = await _participate_once(
+                    client, identity, roster, cid, local[cid], num_samples[cid],
+                    cfg, rnd,
+                    drop_after_shares=(drop_at_round is not None
+                                       and rnd >= drop_at_round),
+                )
+                if outcome in ("evicted", "dropped"):
+                    return
+
+    durations = {}
+
+    async def main():
+        server = HTTPServer(port=PORT + 6)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, _client_params(model, 0),
+                NetworkRoundConfig(num_rounds=3, min_clients=3,
+                                   min_completion_rate=0.5, round_timeout_s=2.0),
+                secure=cfg,
+            )
+
+            async def run_and_time():
+                original = coordinator.train_round
+
+                async def wrapped(round_number):
+                    t = time.monotonic()
+                    record = await original(round_number)
+                    durations[round_number] = time.monotonic() - t
+                    return record
+
+                coordinator.train_round = wrapped
+                return await coordinator.run()
+
+            await asyncio.gather(
+                run_and_time(),
+                multi_round_client("c1"),
+                multi_round_client("c2"),
+                multi_round_client("c3", drop_at_round=1),
+            )
+            return coordinator
+        finally:
+            await server.stop()
+
+    coordinator = asyncio.run(main())
+    statuses = [(h["round"], h["status"], h["num_dropped"])
+                for h in coordinator.history]
+    assert statuses == [(0, "COMPLETED", 0), (1, "COMPLETED", 1),
+                        (2, "COMPLETED", 0)]
+    # Round 1 pays the detection timeout for the dropped client; round 2 must NOT
+    # (c3 was evicted, so the shrunk cohort completes well under the 2s timeout).
+    assert durations[1] >= 2.0
+    assert durations[2] < durations[1]
