@@ -1,0 +1,43 @@
+"""Shared secure-aggregation test scaffolding."""
+
+from types import SimpleNamespace
+
+import pytest
+
+
+@pytest.fixture
+def tolerant_cohort():
+    """Factory for the dropout-tolerant cohort bootstrap (identity keys, per-round
+    ephemeral mask keys, Shamir share distribution, opened inboxes) — the one place
+    this scaffold lives, so a wire-protocol change is fixed once."""
+
+    def build(order, threshold, context, rng=None):
+        from nanofed_tpu.security.secure_agg import (
+            ClientKeyPair,
+            make_dropout_shares,
+            open_share_inbox,
+        )
+
+        identity = {c: ClientKeyPair.generate() for c in order}
+        idpks = {c: identity[c].public_bytes() for c in order}
+        mask_keys = {c: ClientKeyPair.generate() for c in order}
+        epks = {c: mask_keys[c].public_bytes() for c in order}
+        self_seeds, outbox = {}, {}
+        for c in order:
+            self_seeds[c], outbox[c] = make_dropout_shares(
+                identity[c], mask_keys[c], order, idpks, threshold,
+                my_id=c, context=context, rng=rng,
+            )
+        held = {
+            c: open_share_inbox(
+                identity[c], c, idpks,
+                {sender: outbox[sender][c] for sender in order}, epks, context,
+            )
+            for c in order
+        }
+        return SimpleNamespace(
+            order=order, identity=identity, idpks=idpks, mask_keys=mask_keys,
+            epks=epks, self_seeds=self_seeds, held=held,
+        )
+
+    return build

@@ -237,35 +237,16 @@ class TestDropoutRecovery:
 
     CTX = "session0:0"
 
-    def _cohort(self, n, threshold, seed0=10):
-        from nanofed_tpu.security import make_dropout_shares, open_share_inbox
-
-        order = [f"c{i}" for i in range(n)]
+    def _cohort(self, tolerant_cohort, n, threshold, seed0=10):
         # Long-lived identity keys seal the share transport; FRESH per-round mask
         # keys carry the pairwise seeds (per-execution freshness is the security —
-        # revealing a dropped client's mask key burns only this round).
-        identity = {c: ClientKeyPair.generate() for c in order}
-        idpks = {c: identity[c].public_bytes() for c in order}
-        mask_keys = {c: ClientKeyPair.generate() for c in order}
-        epks = {c: mask_keys[c].public_bytes() for c in order}
+        # revealing a dropped client's mask key burns only this round).  The
+        # scaffold itself lives in the shared tolerant_cohort fixture.
+        order = [f"c{i}" for i in range(n)]
+        cohort = tolerant_cohort(order, threshold, self.CTX)
         params = {c: _client_params(seed0 + i) for i, c in enumerate(order)}
-        # Round start: every client shares its round secrets; "server" routes blobs.
-        self_seeds, outbox = {}, {}
-        for c in order:
-            self_seeds[c], outbox[c] = make_dropout_shares(
-                identity[c], mask_keys[c], order, idpks, threshold,
-                my_id=c, context=self.CTX,
-            )
-        # Each client opens its inbox (blob from every sender, self included),
-        # cross-checking the relayed epks against the sealed attestations.
-        held = {
-            c: open_share_inbox(
-                identity[c], c, idpks,
-                {sender: outbox[sender][c] for sender in order}, epks, self.CTX,
-            )
-            for c in order
-        }
-        return order, mask_keys, epks, params, self_seeds, held
+        return (order, cohort.mask_keys, cohort.epks, params, cohort.self_seeds,
+                cohort.held)
 
     def test_secret_bytes_share_roundtrip(self):
         import secrets as pysecrets
@@ -290,7 +271,7 @@ class TestDropoutRecovery:
         with pytest.raises(InvalidTag):
             open_share_payload(eve, a.public_bytes(), blob)
 
-    def test_dropout_round_recovers_survivor_sum(self):
+    def test_dropout_round_recovers_survivor_sum(self, tolerant_cohort):
         from nanofed_tpu.security import (
             build_unmask_reveals,
             mask_update,
@@ -299,7 +280,7 @@ class TestDropoutRecovery:
         from nanofed_tpu.utils.trees import tree_ravel
 
         cfg = SecureAggregationConfig(min_clients=3, threshold=3, dropout_tolerant=True)
-        order, keys, pks, params, self_seeds, held = self._cohort(5, cfg.threshold)
+        order, keys, pks, params, self_seeds, held = self._cohort(tolerant_cohort, 5, cfg.threshold)
         ordered_pks = [pks[c] for c in order]
         # c3 drops AFTER enrollment (its pairwise masks are baked into everyone's
         # vectors) — it never submits.
@@ -320,7 +301,7 @@ class TestDropoutRecovery:
             dequantize(total, cfg.frac_bits), expected, atol=1e-3
         )
 
-    def test_no_dropout_still_needs_self_mask_removal(self):
+    def test_no_dropout_still_needs_self_mask_removal(self, tolerant_cohort):
         from nanofed_tpu.security import (
             build_unmask_reveals,
             mask_update,
@@ -329,7 +310,7 @@ class TestDropoutRecovery:
         from nanofed_tpu.utils.trees import tree_ravel
 
         cfg = SecureAggregationConfig(min_clients=3, threshold=2, dropout_tolerant=True)
-        order, keys, pks, params, self_seeds, held = self._cohort(3, cfg.threshold)
+        order, keys, pks, params, self_seeds, held = self._cohort(tolerant_cohort, 3, cfg.threshold)
         ordered_pks = [pks[c] for c in order]
         masked = {
             c: mask_update(params[c], order.index(c), keys[c], ordered_pks, 0, cfg,
@@ -367,7 +348,7 @@ class TestDropoutRecovery:
         with pytest.raises(AggregationError):
             build_unmask_reveals({"dropped": ["c0"], "survivors": ["c1"]}, "c0", held)
 
-    def test_below_threshold_reveals_fail_closed(self):
+    def test_below_threshold_reveals_fail_closed(self, tolerant_cohort):
         from nanofed_tpu.security import (
             build_unmask_reveals,
             mask_update,
@@ -375,7 +356,7 @@ class TestDropoutRecovery:
         )
 
         cfg = SecureAggregationConfig(min_clients=3, threshold=4, dropout_tolerant=True)
-        order, keys, pks, params, self_seeds, held = self._cohort(5, cfg.threshold)
+        order, keys, pks, params, self_seeds, held = self._cohort(tolerant_cohort, 5, cfg.threshold)
         ordered_pks = [pks[c] for c in order]
         survivors = order[:3]  # 3 < threshold=4
         masked = {
@@ -411,12 +392,10 @@ class TestDeviceBackendDropoutRecovery:
         # And host vs device streams genuinely differ (wire-incompatibility is real).
         assert not np.array_equal(mask, expand_mask(seed, size, backend="host"))
 
-    def test_device_cohort_dropout_recovery(self):
+    def test_device_cohort_dropout_recovery(self, tolerant_cohort):
         from nanofed_tpu.security import (
             build_unmask_reveals,
-            make_dropout_shares,
             mask_update,
-            open_share_inbox,
             recover_unmasked_sum,
         )
         from nanofed_tpu.utils.trees import tree_ravel
@@ -424,25 +403,10 @@ class TestDeviceBackendDropoutRecovery:
         cfg = SecureAggregationConfig(min_clients=3, threshold=3,
                                       dropout_tolerant=True)
         order = [f"c{i}" for i in range(4)]
-        identity = {c: ClientKeyPair.generate() for c in order}
-        idpks = {c: identity[c].public_bytes() for c in order}
-        mask_keys = {c: ClientKeyPair.generate() for c in order}
-        epks = {c: mask_keys[c].public_bytes() for c in order}
+        cohort = tolerant_cohort(order, cfg.threshold, "sess:3")
+        mask_keys, epks = cohort.mask_keys, cohort.epks
+        self_seeds, held = cohort.self_seeds, cohort.held
         params = {c: _client_params(20 + i) for i, c in enumerate(order)}
-        ctx = "sess:3"
-        self_seeds, outbox = {}, {}
-        for c in order:
-            self_seeds[c], outbox[c] = make_dropout_shares(
-                identity[c], mask_keys[c], order, idpks, cfg.threshold,
-                my_id=c, context=ctx,
-            )
-        held = {
-            c: open_share_inbox(
-                identity[c], c, idpks,
-                {s: outbox[s][c] for s in order}, epks, ctx,
-            )
-            for c in order
-        }
         survivors = [c for c in order if c != "c1"]
         masked = {
             c: mask_update(params[c], order.index(c), mask_keys[c],
