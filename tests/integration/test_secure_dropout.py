@@ -502,3 +502,97 @@ def test_multiround_eviction_keeps_later_rounds_fast():
     # (c3 was evicted, so the shrunk cohort completes well under the 2s timeout).
     assert durations[1] >= 2.0
     assert durations[2] < durations[1]
+
+
+def test_drop_before_share_barrier_fails_round_and_evicts():
+    """A client that vanishes BEFORE depositing its round shares stalls the share
+    barrier (nobody can mask), so that round FAILS — but the non-depositor is
+    evicted and the NEXT round completes from the shrunk cohort.  (Dropping after
+    the barrier is the recoverable case covered elsewhere.)"""
+    model = get_model("linear", in_features=4, num_classes=2)
+    cfg = SecureAggregationConfig(
+        min_clients=2, frac_bits=16, threshold=2, dropout_tolerant=True
+    )
+    ids = ["c1", "c2", "c3"]
+    num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
+    local = {c: _client_params(model, 50 + i) for i, c in enumerate(ids)}
+
+    async def vanishing_client(cid):
+        """Enrolls, then never deposits round shares (crash before the barrier)."""
+        identity = ClientKeyPair.generate()
+        async with HTTPClient(f"http://127.0.0.1:{PORT + 7}", cid,
+                              timeout_s=30) as client:
+            assert await client.register_secagg(
+                identity.public_bytes(), num_samples[cid]
+            )
+            await client.fetch_secagg_roster()
+
+    async def persistent_client(cid):
+        """Participates across rounds; tolerates the failed round 0 (its inbox wait
+        errors when the round advances) and completes round 1."""
+        identity = ClientKeyPair.generate()
+        async with HTTPClient(f"http://127.0.0.1:{PORT + 7}", cid,
+                              timeout_s=30) as client:
+            assert await client.register_secagg(
+                identity.public_bytes(), num_samples[cid]
+            )
+            roster = await client.fetch_secagg_roster()
+            seen_round = -1
+            while True:
+                try:
+                    params, rnd, active = await client.fetch_global_model(
+                        like=local[cid]
+                    )
+                except Exception:
+                    await asyncio.sleep(0.05)
+                    continue
+                if not active:
+                    return
+                if rnd == seen_round:
+                    await asyncio.sleep(0.05)
+                    continue
+                seen_round = rnd
+                try:
+                    outcome = await _participate_once(
+                        client, identity, roster, cid, local[cid],
+                        num_samples[cid], cfg, rnd,
+                    )
+                except Exception:
+                    continue  # round failed under us (share barrier stalled)
+                if outcome == "evicted":
+                    return
+
+    async def main():
+        server = HTTPServer(port=PORT + 7)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, _client_params(model, 0),
+                NetworkRoundConfig(num_rounds=2, min_clients=3,
+                                   min_completion_rate=0.5, round_timeout_s=2.0),
+                secure=cfg,
+            )
+            await asyncio.gather(
+                coordinator.run(),
+                persistent_client("c1"),
+                persistent_client("c2"),
+                vanishing_client("c3"),
+            )
+            return coordinator
+        finally:
+            await server.stop()
+
+    coordinator = asyncio.run(main())
+    assert [h["status"] for h in coordinator.history] == ["FAILED", "COMPLETED"]
+    # Round 0's failure record names the eviction; round 1 ran without c3.
+    assert "evicted" in coordinator.history[0]["reason"]
+    assert coordinator.history[1]["num_clients"] == 2
+    assert coordinator.history[1]["num_dropped"] == 0
+    expected = fedavg_combine(stack_model_updates([
+        ModelUpdate(client_id=c, round_number=1, params=local[c],
+                    metrics={"num_samples": num_samples[c]}, timestamp="")
+        for c in ["c1", "c2"]
+    ]))
+    for got, want in zip(jax.tree.leaves(coordinator.params),
+                         jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
