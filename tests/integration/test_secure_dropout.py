@@ -132,6 +132,56 @@ async def _run_tolerant_client(
         )
 
 
+async def _run_multi_round_client(port, cid, local_params, num_samples, cfg,
+                                  drop_at_round=None, tolerate_failed_rounds=False):
+    """Multi-round dropout-tolerant client: loops rounds via _participate_once,
+    honoring eviction.  Model fetches are bounded (a persistent fetch failure must
+    surface HERE, not as a far-away round-status assert).  With
+    ``tolerate_failed_rounds`` a participation error is swallowed ONLY when the
+    server has actually moved past the round (a stalled/failed round being cleaned
+    up); an error during a live round always surfaces."""
+    identity = ClientKeyPair.generate()
+    async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as client:
+        assert await client.register_secagg(identity.public_bytes(), num_samples)
+        roster = await client.fetch_secagg_roster()
+        seen_round = -1
+        fetch_failures = 0
+        while True:
+            try:
+                params, rnd, active = await client.fetch_global_model(
+                    like=local_params
+                )
+                fetch_failures = 0
+            except Exception:
+                fetch_failures += 1
+                if fetch_failures > 100:
+                    raise
+                await asyncio.sleep(0.05)
+                continue
+            if not active:
+                return
+            if rnd == seen_round:
+                await asyncio.sleep(0.05)
+                continue
+            seen_round = rnd
+            try:
+                outcome = await _participate_once(
+                    client, identity, roster, cid, local_params, num_samples,
+                    cfg, rnd,
+                    drop_after_shares=(drop_at_round is not None
+                                       and rnd >= drop_at_round),
+                )
+            except Exception:
+                if not tolerate_failed_rounds:
+                    raise
+                status = await client.check_server_status()
+                if status.get("training_active", True) and status.get("round") == rnd:
+                    raise  # a live-round failure, not a failed round's cleanup
+                continue
+            if outcome in ("evicted", "dropped"):
+                return
+
+
 def _run_round(port, cfg, clients, num_rounds=1, min_clients=None,
                completion_rate=1.0, timeout=3.0):
     """clients: list of (cid, params, num_samples, drops)."""
@@ -418,46 +468,6 @@ def test_multiround_eviction_keeps_later_rounds_fast():
     num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
     local = {c: _client_params(model, 40 + i) for i, c in enumerate(ids)}
 
-    async def multi_round_client(cid, drop_at_round=None):
-        """Loops rounds via the shared _participate_once, honoring eviction."""
-        identity = ClientKeyPair.generate()
-        async with HTTPClient(f"http://127.0.0.1:{PORT + 6}", cid,
-                              timeout_s=30) as client:
-            assert await client.register_secagg(
-                identity.public_bytes(), num_samples[cid]
-            )
-            roster = await client.fetch_secagg_roster()
-            seen_round = -1
-            fetch_failures = 0
-            while True:
-                try:
-                    params, rnd, active = await client.fetch_global_model(
-                        like=local[cid]
-                    )
-                    fetch_failures = 0
-                except Exception:
-                    # Bounded like _fetch_model_retry: a persistent fetch failure
-                    # must surface HERE, not as a far-away round-status assert.
-                    fetch_failures += 1
-                    if fetch_failures > 100:
-                        raise
-                    await asyncio.sleep(0.05)
-                    continue
-                if not active:
-                    return
-                if rnd == seen_round:
-                    await asyncio.sleep(0.05)
-                    continue
-                seen_round = rnd
-                outcome = await _participate_once(
-                    client, identity, roster, cid, local[cid], num_samples[cid],
-                    cfg, rnd,
-                    drop_after_shares=(drop_at_round is not None
-                                       and rnd >= drop_at_round),
-                )
-                if outcome in ("evicted", "dropped"):
-                    return
-
     durations = {}
 
     async def main():
@@ -485,9 +495,12 @@ def test_multiround_eviction_keeps_later_rounds_fast():
 
             await asyncio.gather(
                 run_and_time(),
-                multi_round_client("c1"),
-                multi_round_client("c2"),
-                multi_round_client("c3", drop_at_round=1),
+                _run_multi_round_client(PORT + 6, "c1", local["c1"],
+                                        num_samples["c1"], cfg),
+                _run_multi_round_client(PORT + 6, "c2", local["c2"],
+                                        num_samples["c2"], cfg),
+                _run_multi_round_client(PORT + 6, "c3", local["c3"],
+                                        num_samples["c3"], cfg, drop_at_round=1),
             )
             return coordinator
         finally:
@@ -527,41 +540,6 @@ def test_drop_before_share_barrier_fails_round_and_evicts():
             )
             await client.fetch_secagg_roster()
 
-    async def persistent_client(cid):
-        """Participates across rounds; tolerates the failed round 0 (its inbox wait
-        errors when the round advances) and completes round 1."""
-        identity = ClientKeyPair.generate()
-        async with HTTPClient(f"http://127.0.0.1:{PORT + 7}", cid,
-                              timeout_s=30) as client:
-            assert await client.register_secagg(
-                identity.public_bytes(), num_samples[cid]
-            )
-            roster = await client.fetch_secagg_roster()
-            seen_round = -1
-            while True:
-                try:
-                    params, rnd, active = await client.fetch_global_model(
-                        like=local[cid]
-                    )
-                except Exception:
-                    await asyncio.sleep(0.05)
-                    continue
-                if not active:
-                    return
-                if rnd == seen_round:
-                    await asyncio.sleep(0.05)
-                    continue
-                seen_round = rnd
-                try:
-                    outcome = await _participate_once(
-                        client, identity, roster, cid, local[cid],
-                        num_samples[cid], cfg, rnd,
-                    )
-                except Exception:
-                    continue  # round failed under us (share barrier stalled)
-                if outcome == "evicted":
-                    return
-
     async def main():
         server = HTTPServer(port=PORT + 7)
         await server.start()
@@ -574,8 +552,12 @@ def test_drop_before_share_barrier_fails_round_and_evicts():
             )
             await asyncio.gather(
                 coordinator.run(),
-                persistent_client("c1"),
-                persistent_client("c2"),
+                _run_multi_round_client(PORT + 7, "c1", local["c1"],
+                                        num_samples["c1"], cfg,
+                                        tolerate_failed_rounds=True),
+                _run_multi_round_client(PORT + 7, "c2", local["c2"],
+                                        num_samples["c2"], cfg,
+                                        tolerate_failed_rounds=True),
                 vanishing_client("c3"),
             )
             return coordinator
