@@ -113,6 +113,68 @@ def _timed_rounds(step, params, sos, data, weights, stack_rngs, padded, log_stag
     return np.asarray(times)
 
 
+def finalize_measurements(measurements, ref_s, payload: dict) -> dict:
+    """Fill value/vs_baseline/scaling fields from ``[(scale, times), ...]``
+    (primary scale first; on CPU a larger distinct workload last).  A single
+    scale yields an extrapolation WITHOUT a linearity certificate — never a
+    fake ratio-1.0 from comparing a measurement against itself.
+
+    Module-level (pure, numpy-only) so the two-scale arithmetic is unit-testable
+    without a 20-minute measurement run."""
+    import numpy as np
+
+    scale0, times0 = measurements[0]
+    value0 = float(np.median(times0))
+    if scale0 == 1:
+        payload.update(
+            value=round(value0, 4),
+            vs_baseline=round(ref_s / value0, 2),
+            round_times_s=[round(float(x), 4) for x in times0],
+            aggregation=f"median of {len(times0)} steady-state rounds",
+        )
+        return payload
+    scale1, times1 = measurements[-1]
+    value1 = float(np.median(times1))
+    value = value1 * scale1  # headline from the LARGEST measured workload
+    payload.update(
+        value=round(value, 4),
+        vs_baseline=round(ref_s / value, 2),
+        aggregation="; ".join(
+            f"median of {len(t)} round(s) at 1/{s} scale" for s, t in measurements
+        ),
+        measured_s={f"1/{s}": round(float(np.median(t)), 4)
+                    for s, t in measurements},
+        round_times_s={f"1/{s}": [round(float(x) * s, 4) for x in t]
+                       for s, t in measurements},
+        scale=scale1,
+    )
+    if len(measurements) >= 2 and scale0 != scale1:
+        extrap = [round(float(np.median(t)) * s, 2) for s, t in measurements]
+        payload.update(
+            extrapolated=(
+                f"measured at {', '.join(f'1/{s}' for s, _ in measurements)} "
+                f"sample scale; headline extrapolated linearly from the largest "
+                f"(1/{scale1}) workload (full-scale CPU rounds exceed any "
+                "driver budget)"
+            ),
+            linearity_check={
+                "scales": [s for s, _ in measurements],
+                "extrapolated_s": extrap,
+                "ratio": round(extrap[-1] / extrap[0], 3),
+                "note": (
+                    "per-unit cost across the workload-scale change; ratio ~1.0 "
+                    "means the linear extrapolation is self-consistent"
+                ),
+            },
+        )
+    else:
+        payload["extrapolated"] = (
+            f"measured at 1/{scale1} sample scale only, extrapolated linearly "
+            "(NO cross-scale linearity check at this configuration)"
+        )
+    return payload
+
+
 def run_probe() -> None:
     """Short-budget backend probe: init jax's backend under a watchdog and print one
     machine-readable line.  The orchestrator uses this to distinguish a transient
@@ -199,62 +261,6 @@ def run_worker(platform: str, workloads: list[str]) -> None:
     )
     reps = 2 if on_cpu else 3
 
-    def finalize(measurements, ref_s, payload: dict) -> dict:
-        """Fill value/vs_baseline/scaling fields from ``[(scale, times), ...]``
-        (primary scale first; on CPU a larger distinct workload last).  A single
-        scale yields an extrapolation WITHOUT a linearity certificate — never a
-        fake ratio-1.0 from comparing a measurement against itself."""
-        scale0, times0 = measurements[0]
-        value0 = float(np.median(times0))
-        if scale0 == 1:
-            payload.update(
-                value=round(value0, 4),
-                vs_baseline=round(ref_s / value0, 2),
-                round_times_s=[round(float(x), 4) for x in times0],
-                aggregation=f"median of {len(times0)} steady-state rounds",
-            )
-            return payload
-        scale1, times1 = measurements[-1]
-        value1 = float(np.median(times1))
-        value = value1 * scale1  # headline from the LARGEST measured workload
-        payload.update(
-            value=round(value, 4),
-            vs_baseline=round(ref_s / value, 2),
-            aggregation="; ".join(
-                f"median of {len(t)} round(s) at 1/{s} scale" for s, t in measurements
-            ),
-            measured_s={f"1/{s}": round(float(np.median(t)), 4)
-                        for s, t in measurements},
-            round_times_s={f"1/{s}": [round(float(x) * s, 4) for x in t]
-                           for s, t in measurements},
-            scale=scale1,
-        )
-        if len(measurements) >= 2 and scale0 != scale1:
-            extrap = [round(float(np.median(t)) * s, 2) for s, t in measurements]
-            payload.update(
-                extrapolated=(
-                    f"measured at {', '.join(f'1/{s}' for s, _ in measurements)} "
-                    f"sample scale; headline extrapolated linearly from the largest "
-                    f"(1/{scale1}) workload (full-scale CPU rounds exceed any "
-                    "driver budget)"
-                ),
-                linearity_check={
-                    "scales": [s for s, _ in measurements],
-                    "extrapolated_s": extrap,
-                    "ratio": round(extrap[-1] / extrap[0], 3),
-                    "note": (
-                        "per-unit cost across the workload-scale change; ratio ~1.0 "
-                        "means the linear extrapolation is self-consistent"
-                    ),
-                },
-            )
-        else:
-            payload["extrapolated"] = (
-                f"measured at 1/{scale1} sample scale only, extrapolated linearly "
-                "(NO cross-scale linearity check at this configuration)"
-            )
-        return payload
-
     def prepare(total, parts, batch):
         ds = synthetic_classification(total, 10, (28, 28, 1), seed=0)
         data = pack_clients(ds, parts, batch_size=batch)
@@ -295,7 +301,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             times = measure(f"parity@1/{scale}", METRIC_PARITY, step, data, weights,
                             padded, reps if i == 0 else 1)
             measurements.append((scale, times))
-        out = finalize(measurements, REFERENCE_ROUND_S, {
+        out = finalize_measurements(measurements, REFERENCE_ROUND_S, {
             "metric": METRIC_PARITY,
             "unit": "s",
             "platform": str(devices[0].platform),
@@ -339,7 +345,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
                 f"scaled to {FLAGSHIP_SAMPLE_PASSES} passes = {REFERENCE_FLAGSHIP_S:.2f}s CPU"
             ),
         }
-        out = finalize(measurements, REFERENCE_FLAGSHIP_S, out)
+        out = finalize_measurements(measurements, REFERENCE_FLAGSHIP_S, out)
         value = out["value"]
         out["rounds_per_sec"] = round(1.0 / value, 3)
         if on_cpu:

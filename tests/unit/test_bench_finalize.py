@@ -1,0 +1,69 @@
+"""Unit coverage for bench.py's measurement-finalization arithmetic.
+
+The driver records whatever JSON line bench.py prints last; these pin the
+scale-handling rules (accelerator single-scale, CPU two-scale linearity audit,
+degraded single-scale labeling) without a 20-minute measurement run — bench.py's
+module level imports no jax, so this is pure-host arithmetic testing.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+from bench import finalize_measurements  # noqa: E402
+
+
+def test_accelerator_single_full_scale():
+    out = finalize_measurements(
+        [(1, np.array([0.75, 0.73, 0.76]))], 200.55, {"metric": "m", "unit": "s"}
+    )
+    assert out["value"] == 0.75  # median
+    assert out["vs_baseline"] == pytest.approx(267.4, abs=0.1)
+    assert out["round_times_s"] == [0.75, 0.73, 0.76]
+    assert "linearity_check" not in out
+    assert "scale" not in out
+
+
+def test_cpu_two_scale_extrapolates_from_larger_and_audits_linearity():
+    # 1/200 rounds ~60s; 1/100 round ~121s -> per-unit nearly constant.
+    out = finalize_measurements(
+        [(200, np.array([60.0, 62.0])), (100, np.array([121.0]))],
+        200.55, {"metric": "m", "unit": "s"},
+    )
+    # Headline from the LARGER workload (1/100): 121 * 100.
+    assert out["value"] == 12100.0
+    assert out["scale"] == 100
+    lc = out["linearity_check"]
+    assert lc["scales"] == [200, 100]
+    # extrapolated: [median(60,62)*200=12200, 121*100=12100] -> ratio ~0.992
+    assert lc["extrapolated_s"] == [12200.0, 12100.0]
+    assert lc["ratio"] == pytest.approx(0.992, abs=0.001)
+    # Per-scale round times are reported scaled (auditable spread).
+    assert out["round_times_s"]["1/200"] == [12000.0, 12400.0]
+    assert out["round_times_s"]["1/100"] == [12100.0]
+    assert out["vs_baseline"] == 0.02  # round(200.55/12100, 2)
+
+
+def test_single_cpu_scale_never_fakes_a_linearity_certificate():
+    out = finalize_measurements(
+        [(50, np.array([124.6, 125.1]))], 53.48, {"metric": "m", "unit": "s"}
+    )
+    assert out["value"] == pytest.approx(124.85 * 50)
+    assert "linearity_check" not in out
+    assert "NO cross-scale linearity check" in out["extrapolated"]
+
+
+def test_nonlinear_scaling_is_visible_in_the_ratio():
+    # Fixed overhead dominating at the small scale -> extrapolation from it would
+    # overestimate; the ratio must expose the discrepancy, not hide it.
+    out = finalize_measurements(
+        [(400, np.array([30.0])), (200, np.array([33.0]))],
+        53.48, {"metric": "m", "unit": "s"},
+    )
+    assert out["linearity_check"]["ratio"] == pytest.approx(6600.0 / 12000.0, abs=1e-3)
+    # Headline still comes from the larger (less overhead-dominated) workload.
+    assert out["value"] == 6600.0
