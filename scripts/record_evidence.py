@@ -59,7 +59,8 @@ def _write(name: str, artifact: dict) -> Path:
     return out
 
 
-def run_dp(tag: str, model_name: str = "linear") -> int:
+def run_dp(tag: str, model_name: str = "linear", num_rounds: int = 40,
+           eval_every: int = 1) -> int:
     """DP-FedAvg privacy-utility curve on REAL digits.
 
     Central DP only pays off in the many-clients regime: per-round SNR of the noised
@@ -87,7 +88,7 @@ def run_dp(tag: str, model_name: str = "linear") -> int:
 
     from nanofed_tpu.orchestration import cohort_size
 
-    num_rounds, budget_delta = 40, 1e-5
+    budget_delta = 1e-5
     num_clients, participation = 240, 0.1  # cohort K=24, q=0.1 (amplification regime)
     cohort = cohort_size(num_clients, participation)
     # Realized per-client inclusion probability (= what the coordinator accounts at).
@@ -115,7 +116,7 @@ def run_dp(tag: str, model_name: str = "linear") -> int:
                                 batch_size=training.batch_size, seed=seed),
             config=CoordinatorConfig(num_rounds=num_rounds, seed=seed,
                                      participation_rate=participation,
-                                     base_dir="runs/dp_run", eval_every=1,
+                                     base_dir="runs/dp_run", eval_every=eval_every,
                                      save_metrics=False),
             training=training,
             eval_data=pack_eval(test, batch_size=256),
@@ -164,7 +165,8 @@ def run_dp(tag: str, model_name: str = "linear") -> int:
         "model": model_desc,
         "regime": {"num_clients": num_clients, "participation_rate": participation,
                    "cohort_size": cohort,
-                   "num_rounds": num_rounds, "clip_norm": clip,
+                   "num_rounds": num_rounds, "eval_every": eval_every,
+                   "clip_norm": clip,
                    "batch_size": training.batch_size,
                    "local_epochs": training.local_epochs,
                    "learning_rate": training.learning_rate},
@@ -300,13 +302,19 @@ def main() -> int:
         help="dp mode only: 'cnn' runs the arms with the flagship MNIST CNN on "
         "digits@28x28 (VERDICT r3 item 7)",
     )
+    ap.add_argument("--rounds", type=int, default=40,
+                    help="dp mode only: rounds per arm (sigma is calibrated for "
+                    "exactly this count, so it stays a valid budget experiment)")
+    ap.add_argument("--eval-every", type=int, default=1,
+                    help="dp mode only: eval cadence (sparser = cheaper on CPU)")
     args = ap.parse_args()
     if args.platform == "cpu":
         from nanofed_tpu.utils.platform import force_cpu_mesh
 
         force_cpu_mesh(args.n_devices)
     if args.mode == "dp":
-        return run_dp(args.round_tag, model_name=args.model)
+        return run_dp(args.round_tag, model_name=args.model,
+                      num_rounds=args.rounds, eval_every=args.eval_every)
     return {"fedprox": run_fedprox, "labelskew": run_labelskew}[args.mode](args.round_tag)
 
 
