@@ -123,13 +123,19 @@ def run_dp(tag: str, model_name: str = "linear", num_rounds: int = 40,
             central_privacy=central_privacy,
         )
 
+    def final_acc_of(traj):
+        """Last EVALUATED accuracy — the final round may not be an eval round when
+        num_rounds % eval_every != 0."""
+        return next((r["test_accuracy"] for r in reversed(traj)
+                     if "test_accuracy" in r), None)
+
     arms = {}
     control = _trajectory(make_coord(None))
     arms["no_dp"] = {
         "trajectory": control,
-        "final_test_accuracy": control[-1].get("test_accuracy"),
+        "final_test_accuracy": final_acc_of(control),
     }
-    print(f"control (no DP): final acc={control[-1].get('test_accuracy')}", flush=True)
+    print(f"control (no DP): final acc={final_acc_of(control)}", flush=True)
 
     for budget_eps in (8.0, 4.0, 1.0):
         sigma = noise_multiplier_for_budget(
@@ -140,7 +146,7 @@ def run_dp(tag: str, model_name: str = "linear", num_rounds: int = 40,
         coord = make_coord(PrivacyAwareAggregationConfig(privacy=privacy))
         traj = _trajectory(coord)
         spent = coord.privacy_spent
-        final_acc = traj[-1].get("test_accuracy")
+        final_acc = final_acc_of(traj)
         arms[f"eps={budget_eps:g}"] = {
             "noise_multiplier": round(sigma, 4),
             "epsilon_spent_total": round(spent.epsilon_spent, 4),
