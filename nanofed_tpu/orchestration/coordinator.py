@@ -146,6 +146,35 @@ class Coordinator:
         )
         self._padded_clients = padded
 
+        # Cohort gathering (participation < 1): running the round step over ALL N
+        # clients and zero-weighting non-participants burns (1-q) of every round's
+        # FLOPs — at the DP benchmark's q=0.1 that is a 10x waste, on any platform.
+        # Instead, gather the sampled cohort's rows into a [K_pad, ...] batch (one
+        # jitted device-side take, sharded like the source) and run the step over K
+        # clients.  The math is identical: FedAvg weights, DP uniform weights,
+        # validation stats, and accounting all operate on the same participating
+        # set; dropped and padding slots carry weight 0 exactly as before.  Full
+        # participation keeps the direct path untouched.
+        self._cohort_mode = self.cohort_size < self.num_clients
+        if self._cohort_mode and client_chunk is not None:
+            # A chunk size that divided the full padded count may not divide the
+            # smaller cohort count — keep the legacy full-N path rather than turn a
+            # previously valid config into a trace-time crash.
+            per_dev = pad_client_count(self.cohort_size, n_dev) // n_dev
+            if client_chunk < per_dev and per_dev % client_chunk != 0:
+                self._cohort_mode = False
+        self._step_clients = (
+            pad_client_count(self.cohort_size, n_dev) if self._cohort_mode else padded
+        )
+        if self._cohort_mode:
+            from nanofed_tpu.parallel.mesh import client_sharding
+
+            sharded = client_sharding(self.mesh)
+            self._gather_cohort = jax.jit(
+                lambda data, idx: jax.tree.map(lambda x: x[idx], data),
+                out_shardings=jax.tree.map(lambda _: sharded, self._data),
+            )
+
         self._round_step = build_round_step(
             model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
             local_fit=local_fit, central_privacy=central_privacy,
@@ -279,9 +308,24 @@ class Coordinator:
                 timestamp=_now_iso(),
             )
 
-        mask = np.zeros(self._padded_clients, dtype=np.float32)
-        mask[survived] = 1.0
-        weights = compute_weights(self._num_samples, jnp.asarray(mask))
+        if self._cohort_mode:
+            # Gather the cohort's rows.  Dropped + padding slots point at row 0 with
+            # weight 0: their CONTRIBUTION is zero in every reduce, though their
+            # (static-shape) local fit still executes — the waste is bounded by the
+            # dropout fraction + device padding of K_pad, vs the full-N path burning
+            # N - K slots every round.
+            idx = np.zeros(self._step_clients, dtype=np.int32)
+            idx[: len(survived)] = survived
+            mask = np.zeros(self._step_clients, dtype=np.float32)
+            mask[: len(survived)] = 1.0
+            idx_dev = jnp.asarray(idx)
+            data = self._gather_cohort(self._data, idx_dev)
+            weights = compute_weights(self._num_samples[idx_dev], jnp.asarray(mask))
+        else:
+            data = self._data
+            mask = np.zeros(self._padded_clients, dtype=np.float32)
+            mask[survived] = 1.0
+            weights = compute_weights(self._num_samples, jnp.asarray(mask))
 
         # Device RNG stack: seed-deterministic without DP.  Under central DP the round
         # step derives the server NOISE key from this stack (round_step.py
@@ -298,9 +342,16 @@ class Coordinator:
                 0, 1 << 32, size=4, dtype=np.uint32
             ):
                 base = jax.random.fold_in(base, word)
-        rngs = stack_rngs(base, self._padded_clients)
+        if self._cohort_mode:
+            # Client-STABLE keys: slot i carries the key of the client it hosts, so
+            # a client's batch shuffling (and any model stochasticity) is identical
+            # whether the round ran gathered or full-N masked — the optimization is
+            # exactly invisible, not just statistically equivalent.
+            rngs = stack_rngs(base, self._padded_clients)[idx_dev]
+        else:
+            rngs = stack_rngs(base, self._step_clients)
         result = self._round_step(
-            self.params, self.server_state, self._data, weights, rngs
+            self.params, self.server_state, data, weights, rngs
         )
         self.params = result.params
         self.server_state = result.server_opt_state
@@ -347,6 +398,10 @@ class Coordinator:
                 "client_accuracy": np.asarray(result.client_metrics.accuracy).tolist(),
                 "update_sq_norms": np.asarray(result.update_sq_norms).tolist(),
             }
+            if self._cohort_mode:
+                # Cohort-slot order, not client-id order: record which client each
+                # slot hosted (weight-0 slots host a placeholder row).
+                self._last_client_detail["client_ids"] = idx.tolist()
 
         jax.block_until_ready(self.params)
         duration = time.perf_counter() - t0
