@@ -95,7 +95,9 @@ async def run_client(client_id: str, url: str, local_fit, data, cfg, template,
                 # Per-round secrets (Bonawitz §4 is per-execution): fresh ephemeral
                 # mask key + self seed, Shamir-shared across this round's ACTIVE
                 # cohort (dropped clients get evicted and stop being waited for).
-                participants = await client.fetch_secagg_participants()
+                participants, round_threshold = (
+                    await client.fetch_secagg_round_info()
+                )
                 if client_id not in participants:
                     print(f"  {client_id}: evicted from cohort; stopping")
                     return
@@ -104,7 +106,11 @@ async def run_client(client_id: str, url: str, local_fit, data, cfg, template,
                 self_seed, sealed = make_dropout_shares(
                     identity, mask_keypair, participants,
                     {c: roster.public_keys[c] for c in participants},
-                    cfg.threshold, my_id=client_id, context=context,
+                    # The server announces the cohort-derived threshold per round
+                    # (window enrollment tracks evictions); make_dropout_shares
+                    # re-checks t > n/2 either way.
+                    round_threshold or cfg.threshold,
+                    my_id=client_id, context=context,
                 )
                 assert await client.deposit_secagg_shares(
                     rnd, mask_keypair.public_bytes(), sealed,

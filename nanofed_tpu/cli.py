@@ -116,17 +116,27 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "aggregation mode)", file=sys.stderr)
         return 2
 
+    if args.max_clients is not None and args.max_clients < args.min_clients:
+        print(f"error: --max-clients ({args.max_clients}) must be >= --min-clients "
+              f"({args.min_clients}) — reaching the cap freezes the enrollment "
+              "window, which would close below the minimum", file=sys.stderr)
+        return 2
+
     model = get_model(args.model)
     params = model.init(jax.random.key(args.seed))
     secure = None
     if args.secure:
         from nanofed_tpu.security.secure_agg import SecureAggregationConfig
 
-        # Dropout-tolerant mode: threshold > n/2 (split-view defense), and the
-        # privacy floor must sit BELOW the enrolled cohort size or the survivor gate
-        # fails every round that has a dropout — the whole point of the mode.  One
-        # eviction's worth of slack mirrors the secure-federation example; operators
-        # wanting more tolerance lower --completion-rate.
+        # Dropout-tolerant mode: the privacy floor must sit BELOW the enrolled cohort
+        # size or the survivor gate fails every round that has a dropout — the whole
+        # point of the mode.  One eviction's worth of slack mirrors the
+        # secure-federation example; operators wanting more tolerance lower
+        # --completion-rate.  The Shamir threshold is NOT wired here: it must exceed
+        # half the cohort that ACTUALLY enrolls (split-view defense), so the
+        # coordinator derives it when the enrollment window freezes the roster and
+        # announces it to clients in the roster payload — a static value computed
+        # from min_clients would be wrong for any larger roster.
         floor = (
             max(2, args.min_clients - 1) if args.dropout_tolerant
             else args.min_clients
@@ -134,7 +144,6 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         secure = SecureAggregationConfig(
             min_clients=floor,
             dropout_tolerant=args.dropout_tolerant,
-            threshold=args.min_clients // 2 + 1,
         )
     validation = None
     if args.validate:
@@ -153,6 +162,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     min_clients=args.min_clients,
                     min_completion_rate=args.completion_rate,
                     round_timeout_s=args.timeout,
+                    max_clients=args.max_clients,
                 ),
                 validation=validation,
                 secure=secure,
@@ -251,8 +261,15 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument(
         "--dropout-tolerant", action="store_true",
         help="with --secure: Bonawitz double-masking — per-round ephemeral secrets, "
-        "Shamir share recovery of dropped clients' masks, survivor-only FedAvg "
-        "(threshold is set to min_clients//2+1)",
+        "Shamir share recovery of dropped clients' masks, survivor-only FedAvg. "
+        "min_clients becomes a true minimum: enrollment stays open for stragglers "
+        "and the Shamir threshold is derived from the frozen roster (> n/2)",
+    )
+    serve.add_argument(
+        "--max-clients", type=int, default=None,
+        help="with --dropout-tolerant: cap the enrollment window (reaching it "
+        "freezes the cohort immediately); default: unbounded until the roster "
+        "has been quiet for the grace period",
     )
     serve.add_argument(
         "--validate", action="store_true",

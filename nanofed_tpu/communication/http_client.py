@@ -53,6 +53,12 @@ class SecAggRoster:
     public_keys: dict[str, bytes]
     weights: dict[str, float]
     backend: str = "host"
+    # Cohort-derived Shamir threshold (dropout-tolerant window enrollment): the server
+    # announces the threshold it froze with the roster (> n/2 of who actually
+    # enrolled).  None on exact-cohort rosters — clients then use their configured
+    # value.  Either way make_dropout_shares re-validates t > n/2 before any secret
+    # is shared, so a server announcing a too-small threshold is refused client-side.
+    threshold: int | None = None
 
     def index_of(self, client_id: str) -> int:
         return self.client_order.index(client_id)
@@ -230,12 +236,14 @@ class HTTPClient:
                 payload = await resp.json()
             self._secagg_session = str(payload.get("session", ""))
             if payload.get("complete"):
+                raw_t = payload.get("threshold")
                 return SecAggRoster(
                     client_order=list(payload["client_order"]),
                     public_keys={c: base64.b64decode(k)
                                  for c, k in payload["public_keys"].items()},
                     weights={c: float(w) for c, w in payload["weights"].items()},
                     backend=str(payload.get("backend", "host")),
+                    threshold=int(raw_t) if raw_t is not None else None,
                 )
             if asyncio.get_event_loop().time() > deadline:
                 raise NanoFedError(
@@ -247,13 +255,22 @@ class HTTPClient:
     async def fetch_secagg_participants(self) -> list[str]:
         """This round's ACTIVE cohort (enrolled minus evicted) — what the per-round
         shares must cover."""
+        participants, _ = await self.fetch_secagg_round_info()
+        return participants
+
+    async def fetch_secagg_round_info(self) -> tuple[list[str], int | None]:
+        """This round's ACTIVE cohort plus the server-announced Shamir threshold for
+        the round (window enrollment re-derives it from the active cohort as
+        evictions shrink it; None on exact-cohort servers — use the shared config).
+        ``make_dropout_shares`` re-validates t > m/2 client-side either way."""
         session = self._require_session()
         url = self.server_url + self.endpoints.secagg_shares
         async with session.get(url, headers={HEADER_CLIENT: self.client_id}) as resp:
             if resp.status != 200:
-                raise NanoFedError(f"fetch_secagg_participants: HTTP {resp.status}")
+                raise NanoFedError(f"fetch_secagg_round_info: HTTP {resp.status}")
             payload = await resp.json()
-        return list(payload["participants"])
+        raw_t = payload.get("threshold")
+        return list(payload["participants"]), (int(raw_t) if raw_t is not None else None)
 
     async def deposit_secagg_shares(
         self, round_number: int, ephemeral_public_key: bytes, blobs: dict[str, str],

@@ -90,6 +90,11 @@ class HTTPServer:
         # client, opened by the round engine, and a separate buffer for masked payloads
         # (they are uniform uint32 vectors, not decodable params).
         self._secagg_expected: int | None = None
+        self._secagg_window = False  # exact cohort (legacy) vs min+close window
+        self._secagg_max: int | None = None
+        self._secagg_threshold_for: Any | None = None  # n -> Shamir threshold, at freeze
+        self._secagg_threshold: int | None = None
+        self._secagg_closed = False
         self._secagg_session: str = ""
         self._secagg_backend: str | None = None  # pinned by the first enrollment
         self._secagg_roster: dict[str, dict[str, Any]] = {}
@@ -165,18 +170,55 @@ class HTTPServer:
     # Secure-aggregation round-engine API
     # ------------------------------------------------------------------
 
-    def open_secagg(self, expected_clients: int) -> None:
-        """Open secure-aggregation enrollment for a cohort of exactly
-        ``expected_clients``.  Clients register their X25519 public key + sample count
-        via POST ``/secagg/register``; the roster endpoint reports ``complete`` once all
-        have.  The cohort is fixed for the whole training run (masks are re-derived per
-        round from the round number, so one enrollment covers every round).
+    def open_secagg(
+        self,
+        expected_clients: int,
+        *,
+        window: bool = False,
+        max_clients: int | None = None,
+        threshold_for: Any | None = None,
+    ) -> None:
+        """Open secure-aggregation enrollment.  Clients register their X25519 public
+        key + sample count via POST ``/secagg/register``; the roster endpoint reports
+        ``complete`` once the cohort is fixed.  The cohort is fixed for the whole
+        training run (masks are re-derived per round from the round number, so one
+        enrollment covers every round).
+
+        Two cohort-sizing modes:
+
+        * **exact** (default, ``window=False``): the cohort is exactly
+          ``expected_clients`` — registration beyond it is refused and the roster is
+          complete the moment the count is reached.  Right for the no-dropout masked
+          protocol, where every cohort member must submit every round anyway.
+        * **window** (``window=True``): ``expected_clients`` is a MINIMUM.
+          Registration stays open — up to ``max_clients`` if given — until
+          ``close_secagg()`` freezes the roster (reaching ``max_clients`` freezes it
+          implicitly).  Only then does the roster report complete.  This is the
+          dropout-tolerant mode's shape: the Shamir ``threshold`` must exceed half the
+          cohort that ACTUALLY enrolled (split-view defense,
+          ``secure_agg.make_dropout_shares``), so the cohort size must be settled
+          before anyone derives a threshold from it — ``threshold_for`` (a callable
+          ``n -> int``) is evaluated exactly once, at freeze, and the result is
+          published to clients in the roster payload.
 
         A fresh random session nonce is issued per call; signed enrollments bind to it,
         so captured enrollments from an earlier session cannot be replayed here."""
         import secrets
 
+        if window and max_clients is not None and max_clients < expected_clients:
+            # Reaching max freezes the roster, so a cap below the minimum would
+            # close enrollment at a size the coordinator then waits on forever —
+            # fail fast at configuration time, not after a round timeout.
+            raise ValueError(
+                f"max_clients ({max_clients}) must be >= the enrollment minimum "
+                f"({expected_clients})"
+            )
         self._secagg_expected = int(expected_clients)
+        self._secagg_window = bool(window)
+        self._secagg_max = int(max_clients) if max_clients is not None else None
+        self._secagg_threshold_for = threshold_for
+        self._secagg_threshold: int | None = None
+        self._secagg_closed = False
         self._secagg_session = secrets.token_hex(16)
         self._secagg_backend = None
         self._secagg_roster.clear()
@@ -189,11 +231,41 @@ class HTTPServer:
         self._unmask_request = None
         self._unmask_reveals.clear()
 
+    def close_secagg(self) -> int:
+        """Freeze a window-mode roster (idempotent): no further registrations, and the
+        cohort-derived Shamir threshold becomes available.  Returns the frozen cohort
+        size."""
+        if not self._secagg_closed:
+            self._secagg_closed = True
+            if self._secagg_threshold_for is not None:
+                self._secagg_threshold = int(
+                    self._secagg_threshold_for(len(self._secagg_roster))
+                )
+        return len(self._secagg_roster)
+
+    def secagg_enrolled(self) -> int:
+        return len(self._secagg_roster)
+
+    def secagg_threshold(self) -> int | None:
+        """The cohort-derived Shamir threshold for the CURRENT round (window mode,
+        after the freeze); None in exact mode or before the freeze.
+
+        Re-derived from the ACTIVE cohort, not frozen at enrollment: per-round fresh
+        secrets (Bonawitz §4) mean each round's sharing stands alone, so the
+        split-view requirement is t > m/2 of the cohort sharing THIS round.  A
+        threshold frozen at the enrollment size n would permanently brick the
+        protocol once evictions shrink the active cohort below it (shares can never
+        number >= t again), even with the privacy floor still satisfied."""
+        if not self._secagg_closed or self._secagg_threshold_for is None:
+            return self._secagg_threshold
+        return int(self._secagg_threshold_for(len(self.secagg_active_order())))
+
     def secagg_roster_complete(self) -> bool:
-        return (
-            self._secagg_expected is not None
-            and len(self._secagg_roster) >= self._secagg_expected
-        )
+        if self._secagg_expected is None:
+            return False
+        if self._secagg_window:
+            return self._secagg_closed
+        return len(self._secagg_roster) >= self._secagg_expected
 
     def secagg_client_order(self) -> list[str]:
         """Canonical cohort ordering (sorted ids) — mask sign convention depends on
@@ -529,7 +601,15 @@ class HTTPServer:
                      "message": "already enrolled with a different key/count"},
                     status=409,
                 )
-            if len(self._secagg_roster) >= self._secagg_expected:
+            if self._secagg_closed:
+                # Window mode after the freeze: the cohort (and the threshold derived
+                # from its size) is fixed; a late joiner would break every client's
+                # view of the mask order.
+                return web.json_response(
+                    {"status": "error", "message": "cohort closed"}, status=403
+                )
+            cap = self._secagg_max if self._secagg_window else self._secagg_expected
+            if cap is not None and len(self._secagg_roster) >= cap:
                 return web.json_response(
                     {"status": "error", "message": "cohort is full"}, status=403
                 )
@@ -538,6 +618,12 @@ class HTTPServer:
             self._secagg_roster[client_id] = {
                 "public_key": public_key, "num_samples": num_samples
             }
+            if (
+                self._secagg_window
+                and self._secagg_max is not None
+                and len(self._secagg_roster) >= self._secagg_max
+            ):
+                self.close_secagg()  # cap reached — freeze implicitly
         self._log.info("secagg enrollment: %s (%d/%d, backend=%s)", client_id,
                        len(self._secagg_roster), self._secagg_expected, backend)
         return web.json_response({"status": "success", "message": "enrolled"})
@@ -575,6 +661,12 @@ class HTTPServer:
                     c: self._secagg_roster[c]["num_samples"] / total for c in order
                 },
             )
+            if self._secagg_threshold is not None:
+                # Window mode: the Shamir threshold is a property of who actually
+                # enrolled (> n/2, split-view defense) — clients take it from here,
+                # not from out-of-band config, and make_dropout_shares re-checks the
+                # invariant against the roster before sharing any secret.
+                payload["threshold"] = self._secagg_threshold
         return web.json_response(payload)
 
     async def _handle_secagg_shares_post(self, request: web.Request) -> web.StreamResponse:
@@ -703,6 +795,13 @@ class HTTPServer:
             "deposited": len(self._round_share_senders),
             "expected": len(active),
         }
+        round_threshold = self.secagg_threshold()
+        if round_threshold is not None:
+            # Window mode: the threshold tracks the ACTIVE cohort (see
+            # secagg_threshold) — clients must share THIS round's secrets at this
+            # value, not the enrollment-time one, or a shrunk cohort could never
+            # reach the share count again.
+            payload["threshold"] = round_threshold
         if complete:
             payload["epks"] = {
                 c: base64.b64encode(k).decode()

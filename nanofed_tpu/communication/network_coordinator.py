@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any
 
 import jax
@@ -49,6 +49,12 @@ class NetworkRoundConfig:
     min_completion_rate: float = 1.0
     round_timeout_s: float = 300.0
     poll_interval_s: float = 0.05
+    # Dropout-tolerant enrollment window: min_clients is a true MINIMUM — enrollment
+    # stays open (up to max_clients, None = unbounded) until the count has been quiet
+    # for enrollment_grace_s, then the roster freezes and the Shamir threshold is
+    # derived from who actually enrolled (> n/2; see run()).
+    max_clients: int | None = None
+    enrollment_grace_s: float = 1.0
 
 
 def _metric(
@@ -189,7 +195,25 @@ class NetworkCoordinator:
 
         cohort = self.server.secagg_active_order()
         expected = len(cohort)
-        threshold = self.secure.threshold
+        # The effective threshold is the server's per-round derivation over the
+        # ACTIVE cohort (window enrollment — the same value clients read alongside
+        # the participants list and share at); library users driving the server
+        # directly without a window fall back to the static config value.
+        threshold = self.server.secagg_threshold() or self.secure.threshold
+        if threshold > expected:
+            # No m-client cohort can deposit >= t > m shares: every client's
+            # make_dropout_shares refuses, so waiting out the round timeout for
+            # masked updates that can never come would only hide the real cause.
+            self._log.warning(
+                "secure round %d FAILED: threshold %d exceeds active cohort %d",
+                round_number, threshold, expected,
+            )
+            record = {"round": round_number, "status": "FAILED",
+                      "num_clients": 0, "num_dropped": 0, "secure": True,
+                      "reason": (f"threshold {threshold} exceeds the {expected}-"
+                                 "client active cohort (unsatisfiable)")}
+            self.history.append(record)
+            return record
         deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
         while (
             self.server.num_masked_updates() < expected
@@ -272,7 +296,7 @@ class NetworkCoordinator:
                 epks,
                 round_number,
                 reveals,
-                self.secure,
+                replace(self.secure, threshold=threshold),
                 backend=self.server.secagg_backend(),
                 self_seed_commitments=self.server.secagg_round_commitments(),
             )
@@ -391,17 +415,68 @@ class NetworkCoordinator:
         waits for the cohort to complete before round 0.
         """
         if self.secure is not None:
-            self.server.open_secagg(self.config.min_clients)
-            deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+            loop = asyncio.get_event_loop()
+            tolerant = self.secure.dropout_tolerant
+            if tolerant:
+                # min_clients is a true MINIMUM here: the Shamir threshold must
+                # exceed half the cohort that ACTUALLY enrolls (split-view defense,
+                # secure_agg.make_dropout_shares), so a static threshold wired from
+                # min_clients would be wrong for any larger roster.  Enrollment stays
+                # open; once >= min_clients are in and the count has been quiet for
+                # enrollment_grace_s (or max_clients is reached), the roster freezes
+                # and the threshold is derived from its real size — never below an
+                # operator-configured one.
+                self.server.open_secagg(
+                    self.config.min_clients,
+                    window=True,
+                    max_clients=self.config.max_clients,
+                    threshold_for=lambda n: max(self.secure.threshold, n // 2 + 1),
+                )
+            else:
+                self.server.open_secagg(self.config.min_clients)
+            deadline = loop.time() + self.config.round_timeout_s
             while (
-                not self.server.secagg_roster_complete()
-                and asyncio.get_event_loop().time() < deadline
+                self.server.secagg_enrolled() < self.config.min_clients
+                and loop.time() < deadline
             ):
                 await asyncio.sleep(self.config.poll_interval_s)
-            if not self.server.secagg_roster_complete():
+            if self.server.secagg_enrolled() < self.config.min_clients:
                 self.server.stop_training()
                 raise TimeoutError(
                     "secure-aggregation cohort incomplete before round 0"
+                )
+            if tolerant:
+                if not self.server.secagg_roster_complete():
+                    # Straggler window: admit whoever else shows up until the
+                    # roster has been quiet for the grace period, then freeze.
+                    last_n, last_t = self.server.secagg_enrolled(), loop.time()
+                    while loop.time() < deadline:
+                        n = self.server.secagg_enrolled()
+                        if n != last_n:
+                            last_n, last_t = n, loop.time()
+                        elif loop.time() - last_t >= self.config.enrollment_grace_s:
+                            break
+                        if self.server.secagg_roster_complete():
+                            break  # max_clients froze it implicitly
+                        await asyncio.sleep(self.config.poll_interval_s)
+                # Idempotent: a no-op when max_clients already froze the roster —
+                # the validation below must run on BOTH freeze paths.
+                n = self.server.close_secagg()
+                frozen_t = self.server.secagg_threshold()
+                if frozen_t is not None and frozen_t > n:
+                    # A configured threshold above the cohort size can never be
+                    # shared or reconstructed — every client's make_dropout_shares
+                    # would raise and every round would time out empty.  Surface
+                    # the misconfiguration at startup instead.
+                    self.server.stop_training()
+                    raise ValueError(
+                        f"secure-aggregation threshold {frozen_t} exceeds the "
+                        f"{n}-client cohort that enrolled; lower the configured "
+                        "threshold or raise min_clients"
+                    )
+                self._log.info(
+                    "secagg cohort frozen: %d enrolled (min %d), threshold %s",
+                    n, self.config.min_clients, frozen_t,
                 )
             # (Dropout-tolerant share distribution is PER-ROUND — fresh ephemeral
             # secrets every round, see _tolerant_secure_round — so there is no

@@ -67,14 +67,17 @@ async def _participate_once(client, identity, roster, cid, local_params,
     honest deposit (e.g. to attempt a forged one)."""
     import hashlib
 
-    participants = await client.fetch_secagg_participants()
+    participants, round_threshold = await client.fetch_secagg_round_info()
     if cid not in participants:
         return "evicted"
     mask_key = ClientKeyPair.generate()
     context = f"{client.secagg_session}:{rnd}"
     self_seed, sealed = make_dropout_shares(
         identity, mask_key, participants,
-        {c: roster.public_keys[c] for c in participants}, cfg.threshold,
+        {c: roster.public_keys[c] for c in participants},
+        # Window enrollment announces the per-round cohort-derived threshold;
+        # exact-cohort servers announce none and the shared config applies.
+        round_threshold or cfg.threshold,
         my_id=cid, context=context,
     )
     commitment = hashlib.sha256(self_seed).digest()
@@ -451,6 +454,242 @@ def test_signed_tolerant_round_with_dropout():
     for got, want in zip(jax.tree.leaves(coordinator.params),
                          jax.tree.leaves(expected)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_enrollment_window_derives_threshold_from_actual_cohort():
+    """THE round-4 verdict scenario (`serve --dropout-tolerant --min-clients 3` with 6
+    enrolling clients): min_clients is a true MINIMUM — all 6 join the window, the
+    roster freezes with a threshold derived from the REAL cohort (max(cfg, 6//2+1)=4,
+    announced in the roster payload), and a round with one dropout still COMPLETES.
+    Under the old static wiring (threshold = min_clients//2+1 = 2) a 6-cohort could
+    never share at all: 2*2 <= 6 trips the split-view guard client-side."""
+    model = get_model("linear", in_features=4, num_classes=2)
+    # Exactly what the CLI wires for --min-clients 3: privacy floor min_clients-1,
+    # threshold LEFT AT ITS DEFAULT (2) — the window derivation must override it.
+    cfg = SecureAggregationConfig(min_clients=2, dropout_tolerant=True)
+    ids = [f"c{i}" for i in range(1, 7)]
+    num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
+    local = {c: _client_params(model, 60 + i) for i, c in enumerate(ids)}
+    clients = [(c, local[c], num_samples[c], c == "c4") for c in ids]
+
+    coordinator = _run_round(PORT + 8, cfg, clients, min_clients=3,
+                             completion_rate=1.0, timeout=4.0)
+    # c4 was evicted after its dropout, so the post-round ACTIVE cohort is 5 and
+    # the per-round threshold re-derivation reads 5//2+1 (the round itself ran at
+    # the full 6-cohort's threshold 4 — pinned by completing with 5 reveals).
+    assert coordinator.server.secagg_threshold() == 3
+    record = coordinator.history[0]
+    assert record["status"] == "COMPLETED"
+    assert record["num_clients"] == 5
+    assert record["num_dropped"] == 1
+    survivors = [c for c in ids if c != "c4"]
+    expected = fedavg_combine(stack_model_updates([
+        ModelUpdate(client_id=c, round_number=0, params=local[c],
+                    metrics={"num_samples": num_samples[c]}, timestamp="")
+        for c in survivors
+    ]))
+    for got, want in zip(jax.tree.leaves(coordinator.params),
+                         jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_enrollment_window_refuses_late_joiners_after_freeze():
+    """Once the window freezes (grace elapsed / max reached), a late registration is
+    refused — the cohort AND the threshold derived from its size are fixed, and a
+    late joiner would desynchronize every client's mask order."""
+
+    async def scenario():
+        server = HTTPServer(port=PORT + 9)
+        server.open_secagg(2, window=True, max_clients=3,
+                           threshold_for=lambda n: n // 2 + 1)
+        await server.start()
+        try:
+            keys = {c: ClientKeyPair.generate() for c in ("c1", "c2", "c3", "late")}
+            for cid in ("c1", "c2", "c3"):
+                async with HTTPClient(f"http://127.0.0.1:{PORT + 9}", cid,
+                                      timeout_s=10) as c:
+                    assert await c.register_secagg(keys[cid].public_bytes(), 10.0)
+            # max_clients reached -> frozen implicitly, threshold derived from n=3.
+            assert server.secagg_roster_complete()
+            assert server.secagg_threshold() == 2
+            async with HTTPClient(f"http://127.0.0.1:{PORT + 9}", "late",
+                                  timeout_s=10) as c:
+                assert not await c.register_secagg(keys["late"].public_bytes(), 10.0)
+                # The frozen roster is served WITH the threshold clients share at.
+                roster = await c.fetch_secagg_roster(timeout_s=2.0)
+            assert roster.threshold == 2
+            assert roster.client_order == ["c1", "c2", "c3"]
+            # The round threshold tracks the ACTIVE cohort: after an eviction the
+            # derivation re-runs over the survivors (a threshold frozen at the
+            # enrollment size would brick every round once m < t).
+            server.evict_secagg_clients(["c3"])
+            assert server.secagg_active_order() == ["c1", "c2"]
+            assert server.secagg_threshold() == 2  # 2//2+1
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_window_cap_below_minimum_is_refused_at_open():
+    """A max_clients below the enrollment minimum would freeze the roster at a size
+    the coordinator then waits on forever — open_secagg must refuse the
+    configuration outright."""
+    import pytest
+
+    server = HTTPServer(port=0)
+    with pytest.raises(ValueError, match="max_clients"):
+        server.open_secagg(5, window=True, max_clients=3,
+                           threshold_for=lambda n: n // 2 + 1)
+
+
+def test_unsatisfiable_threshold_fails_fast_on_implicit_freeze_too():
+    """The startup threshold>cohort validation must run on BOTH freeze paths: here
+    max_clients freezes the roster implicitly at enrollment (no grace timer), and
+    run() must still raise the configuration ValueError instead of burning
+    num_rounds timeouts on rounds no client can ever share for."""
+    import pytest
+
+    model = get_model("linear", in_features=3, num_classes=2)
+    cfg = SecureAggregationConfig(min_clients=2, threshold=10, dropout_tolerant=True)
+
+    async def enroll_only(cid):
+        identity = ClientKeyPair.generate()
+        async with HTTPClient(f"http://127.0.0.1:{PORT + 12}", cid,
+                              timeout_s=10) as client:
+            assert await client.register_secagg(identity.public_bytes(), 10.0)
+
+    async def main():
+        server = HTTPServer(port=PORT + 12)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, _client_params(model, 0),
+                NetworkRoundConfig(num_rounds=2, min_clients=3, max_clients=3,
+                                   round_timeout_s=5.0),
+                secure=cfg,
+            )
+            enrollments = asyncio.gather(*(enroll_only(f"c{i}") for i in range(3)))
+            with pytest.raises(ValueError, match="threshold 10 exceeds"):
+                await asyncio.gather(coordinator.run(), enrollments)
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_window_threshold_tracks_evictions_across_rounds():
+    """5 enroll through the window (round threshold 3); two drop at round 1 and are
+    evicted; round 2's 3-client active cohort re-derives threshold 2 and COMPLETES.
+    With a threshold frozen at enrollment (3 < 4... still 3 here, but at 6 enrolled
+    it would be 4 > 3 survivors) a shrunk cohort could never share again — this
+    pins the per-round re-derivation end-to-end."""
+    model = get_model("linear", in_features=4, num_classes=2)
+    cfg = SecureAggregationConfig(min_clients=2, dropout_tolerant=True)
+    ids = [f"c{i}" for i in range(1, 7)]  # 6 clients: frozen-threshold would be 4
+    num_samples = {c: 10.0 * (i + 1) for i, c in enumerate(ids)}
+    local = {c: _client_params(model, 70 + i) for i, c in enumerate(ids)}
+
+    async def main():
+        server = HTTPServer(port=PORT + 11)
+        await server.start()
+        try:
+            coordinator = NetworkCoordinator(
+                server, _client_params(model, 0),
+                NetworkRoundConfig(num_rounds=3, min_clients=3,
+                                   min_completion_rate=0.5, round_timeout_s=2.5),
+                secure=cfg,
+            )
+            await asyncio.gather(
+                coordinator.run(),
+                *(
+                    _run_multi_round_client(
+                        PORT + 11, c, local[c], num_samples[c], cfg,
+                        drop_at_round=(1 if c in ("c5", "c6") else None),
+                    )
+                    for c in ids
+                ),
+            )
+            return coordinator
+        finally:
+            await server.stop()
+
+    coordinator = asyncio.run(main())
+    statuses = [(h["round"], h["status"], h["num_dropped"])
+                for h in coordinator.history]
+    assert statuses == [(0, "COMPLETED", 0), (1, "COMPLETED", 2),
+                        (2, "COMPLETED", 0)]
+    # Round 0/1 ran at the 6-cohort threshold; round 2's active cohort is 4, so the
+    # announced threshold must have dropped to 4//2+1 = 3 (a frozen 4 would demand
+    # 4 reveals from 4 survivors every round — fragile — and a frozen threshold
+    # with one more eviction would be permanently unsatisfiable).
+    assert coordinator.server.secagg_active_order() == ["c1", "c2", "c3", "c4"]
+    assert coordinator.server.secagg_threshold() == 3
+
+
+def test_wire_epk_substitution_aborts_client_side_before_masking():
+    """The epk-substitution attack over the REAL wire: three clients enroll and
+    deposit round shares through HTTP, then the server (actively malicious here)
+    swaps its own ephemeral key into the relayed epk map for c2.  c1's inbox open
+    must refuse with the attestation error — before masking anything — and the
+    honest map must still open fine (the refusal is the attack's, not a false
+    positive)."""
+    import hashlib
+
+    from nanofed_tpu.core.exceptions import AggregationError
+
+    async def scenario():
+        server = HTTPServer(port=PORT + 10)
+        server.open_secagg(3)
+        model = get_model("linear", in_features=3, num_classes=2)
+        await server.publish_model(_client_params(model, 0), 0)
+        await server.start()
+        cfg = SecureAggregationConfig(
+            min_clients=2, frac_bits=16, threshold=2, dropout_tolerant=True
+        )
+        ids = ["c1", "c2", "c3"]
+        identity = {c: ClientKeyPair.generate() for c in ids}
+        try:
+            clients = {}
+            for cid in ids:
+                clients[cid] = HTTPClient(f"http://127.0.0.1:{PORT + 10}", cid,
+                                          timeout_s=10)
+                await clients[cid].__aenter__()
+                assert await clients[cid].register_secagg(
+                    identity[cid].public_bytes(), 10.0
+                )
+            roster = await clients["c1"].fetch_secagg_roster()
+            context = f"{clients['c1'].secagg_session}:0"
+            for cid in ids:
+                mask_key = ClientKeyPair.generate()
+                self_seed, sealed = make_dropout_shares(
+                    identity[cid], mask_key, roster.client_order,
+                    roster.public_keys, cfg.threshold, my_id=cid, context=context,
+                )
+                assert await clients[cid].deposit_secagg_shares(
+                    0, mask_key.public_bytes(), sealed,
+                    self_seed_commitment=hashlib.sha256(self_seed).digest(),
+                )
+            # --- the attack: the server swaps c2's relayed ephemeral key ---
+            honest_epks, inbox = await clients["c1"].fetch_secagg_inbox(0)
+            server._round_share_epks["c2"] = ClientKeyPair.generate().public_bytes()
+            forged_epks, inbox2 = await clients["c1"].fetch_secagg_inbox(0)
+            try:
+                open_share_inbox(identity["c1"], "c1", roster.public_keys,
+                                 inbox2, forged_epks, context)
+                raise AssertionError("substituted epk map was accepted")
+            except AggregationError as e:
+                assert "epk substitution" in str(e)
+            # Honest map (captured before the swap): opens clean.
+            held = open_share_inbox(identity["c1"], "c1", roster.public_keys,
+                                    inbox, honest_epks, context)
+            assert set(held) == set(ids)
+        finally:
+            for c in clients.values():
+                await c.__aexit__(None, None, None)
+            await server.stop()
+
+    asyncio.run(scenario())
 
 
 def test_multiround_eviction_keeps_later_rounds_fast():

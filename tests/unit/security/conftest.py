@@ -37,7 +37,8 @@ def tolerant_cohort():
         }
         return SimpleNamespace(
             order=order, identity=identity, idpks=idpks, mask_keys=mask_keys,
-            epks=epks, self_seeds=self_seeds, held=held,
+            epks=epks, self_seeds=self_seeds, held=held, outbox=outbox,
+            context=context,
         )
 
     return build

@@ -84,6 +84,71 @@ def test_recovery_equals_survivor_sum_random_configs(seed, tolerant_cohort):
     )
 
 
+# --- round-5: the attacks the docstrings claim to stop, actually mounted ---------
+
+
+def test_epk_substitution_is_refused_before_masking(tolerant_cohort):
+    """Mount the attack ``open_share_inbox``'s docstring describes: the epk map
+    travels in an unsigned GET, so a malicious server swaps in its OWN ephemeral key
+    for a peer (it could then compute every pairwise seed with that peer and strip
+    the pairwise masks).  The sealed per-sender attestation must catch the mismatch
+    and abort BEFORE this client masks anything."""
+    from nanofed_tpu.security.secure_agg import ClientKeyPair, open_share_inbox
+
+    order = ["a", "b", "c"]
+    cohort = tolerant_cohort(order, 2, "sess:0")
+    # The server relays the epk map with b's key replaced by the server's own.
+    forged = dict(cohort.epks)
+    forged["b"] = ClientKeyPair.generate().public_bytes()
+    inbox_for_a = {sender: cohort.outbox[sender]["a"] for sender in order}
+    with pytest.raises(AggregationError, match="epk substitution"):
+        open_share_inbox(
+            cohort.identity["a"], "a", cohort.idpks, inbox_for_a, forged, "sess:0"
+        )
+
+
+def test_replayed_prior_round_inbox_is_refused(tolerant_cohort):
+    """Mount the attack ``_share_aad``'s docstring describes: the server already
+    learned round 0's self seeds in that round's unmask; replaying round 0's sealed
+    inbox during round 1 would let it harvest the matching MASK KEYS — both secrets
+    of a victim, across two rounds.  The AAD binds each blob to its round context,
+    so the replay must fail authentication (AES-GCM InvalidTag), not decrypt."""
+    from cryptography.exceptions import InvalidTag
+
+    from nanofed_tpu.security.secure_agg import open_share_inbox
+
+    order = ["a", "b", "c"]
+    round0 = tolerant_cohort(order, 2, "sess:0")
+    inbox_for_a = {sender: round0.outbox[sender]["a"] for sender in order}
+    # Honest round-0 open works (sanity)...
+    open_share_inbox(
+        round0.identity["a"], "a", round0.idpks, inbox_for_a, round0.epks, "sess:0"
+    )
+    # ...but the same wire blobs presented as round 1's inbox do not decrypt.
+    with pytest.raises(InvalidTag):
+        open_share_inbox(
+            round0.identity["a"], "a", round0.idpks, inbox_for_a, round0.epks,
+            "sess:1",
+        )
+
+
+def test_cross_cohort_session_replay_is_refused(tolerant_cohort):
+    """Same replay, other axis: blobs from an earlier cohort SESSION (same round
+    number) must fail too — the AAD context is session:round, not round alone."""
+    from cryptography.exceptions import InvalidTag
+
+    from nanofed_tpu.security.secure_agg import open_share_inbox
+
+    order = ["a", "b"]
+    old = tolerant_cohort(order, 2, "old-session:0")
+    inbox_for_a = {sender: old.outbox[sender]["a"] for sender in order}
+    with pytest.raises(InvalidTag):
+        open_share_inbox(
+            old.identity["a"], "a", old.idpks, inbox_for_a, old.epks,
+            "new-session:0",
+        )
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_tampered_reveal_share_always_fails_closed(seed, tolerant_cohort):
     """Flipping any revealed share value must produce a clean AggregationError

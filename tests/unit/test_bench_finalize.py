@@ -14,7 +14,12 @@ import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
 
-from bench import finalize_measurements  # noqa: E402
+from bench import (  # noqa: E402
+    METRIC_FLAGSHIP,
+    METRIC_PARITY,
+    compact_summary,
+    finalize_measurements,
+)
 
 
 def test_accelerator_single_full_scale():
@@ -67,3 +72,123 @@ def test_nonlinear_scaling_is_visible_in_the_ratio():
     assert out["linearity_check"]["ratio"] == pytest.approx(6600.0 / 12000.0, abs=1e-3)
     # Headline still comes from the larger (less overhead-dominated) workload.
     assert out["value"] == 6600.0
+
+
+# --- round-5: the linearity check GATES the extrapolation (VERDICT r4 ask #3) ---
+
+
+def test_failed_linearity_flags_headline_as_lower_bound():
+    # Round-4's actual shape: per-unit cost grew 28.5% from 1/200 to 1/100.
+    out = finalize_measurements(
+        [(200, np.array([72.5, 72.3])), (100, np.array([186.4]))],
+        200.55, {"metric": "m", "unit": "s"},
+    )
+    assert out["linearity_check"]["ratio"] > 1.10
+    assert out["extrapolation_quality"] == "failed"
+    v = out["linearity_check"]["verdict"]
+    assert v.startswith("FAILED")
+    assert "LOWER bound" in v and "super-linear" in v
+
+
+def test_failed_linearity_sublinear_flags_upper_bound():
+    out = finalize_measurements(
+        [(400, np.array([30.0])), (200, np.array([33.0]))],
+        53.48, {"metric": "m", "unit": "s"},
+    )
+    assert out["extrapolation_quality"] == "failed"
+    assert "UPPER bound" in out["linearity_check"]["verdict"]
+    assert "sub-linear" in out["linearity_check"]["verdict"]
+
+
+def test_passing_linearity_is_labeled_ok():
+    out = finalize_measurements(
+        [(200, np.array([60.0, 62.0])), (100, np.array([121.0]))],
+        200.55, {"metric": "m", "unit": "s"},
+    )
+    assert out["extrapolation_quality"] == "ok"
+    assert out["linearity_check"]["verdict"].startswith("ok")
+
+
+def test_single_scale_is_labeled_unaudited():
+    out = finalize_measurements(
+        [(50, np.array([124.6, 125.1]))], 53.48, {"metric": "m", "unit": "s"}
+    )
+    assert out["extrapolation_quality"] == "unaudited"
+
+
+def test_accelerator_full_scale_needs_no_quality_label():
+    out = finalize_measurements(
+        [(1, np.array([0.75, 0.73, 0.76]))], 200.55, {"metric": "m", "unit": "s"}
+    )
+    assert "extrapolation_quality" not in out  # a measurement, not an extrapolation
+
+
+# --- round-5: compact driver-facing summary line (VERDICT r4 ask #2) ---
+
+
+def test_compact_summary_distills_both_metrics_and_stays_short():
+    results = [
+        {"metric": METRIC_PARITY, "value": 6254.25, "unit": "s",
+         "vs_baseline": 0.01, "platform": "cpu", "extrapolation_quality": "ok",
+         "round_times_s": {"1/50": [100.0] * 50, "1/25": [200.0] * 25},
+         "accel_failure": [{"attempt": "accel-1", "stderr_tail": ["x" * 200] * 6}]},
+        {"metric": METRIC_FLAGSHIP, "value": 18641.15, "unit": "s",
+         "vs_baseline": 0.01, "platform": "cpu",
+         "extrapolation_quality": "failed",
+         "linearity_check": {"ratio": 1.285, "verdict": "FAILED: ..."},
+         "accel_failure": [{"attempt": "probe", "stderr_tail": ["y" * 200] * 6}]},
+    ]
+    out = compact_summary(results)
+    assert out["metric"] == METRIC_FLAGSHIP
+    assert out["value"] == 18641.15
+    assert out["vs_baseline"] == 0.01
+    assert out["platform"] == "cpu"
+    assert out["summary"] is True
+    assert out["extrapolation_quality"] == "failed"
+    assert out["parity"]["value"] == 6254.25
+    assert out["parity"]["extrapolation_quality"] == "ok"
+    # The whole point: short enough that the driver's tail buffer (which
+    # truncated round-4's ~2.3 kB flagship line mid-JSON) can never cut it.
+    import json
+
+    assert len(json.dumps(out)) < 600
+
+
+def test_compact_summary_tpu_carries_mfu():
+    results = [
+        {"metric": METRIC_FLAGSHIP, "value": 0.9, "unit": "s",
+         "vs_baseline": 222.8, "platform": "tpu", "est_mfu_pct": 5.84},
+    ]
+    out = compact_summary(results)
+    assert out["est_mfu_pct"] == 5.84
+    assert "parity" not in out  # absent metric is simply omitted
+
+
+def test_compact_summary_carries_parity_error_too():
+    # rc=3 from a parity-only failure must not leave a clean-looking summary.
+    results = [
+        {"metric": METRIC_PARITY, "value": -1.0, "unit": "s", "vs_baseline": 0.0,
+         "error": "parity on all benchmark workers timed out"},
+        {"metric": METRIC_FLAGSHIP, "value": 0.9, "unit": "s",
+         "vs_baseline": 222.8, "platform": "tpu"},
+    ]
+    out = compact_summary(results)
+    assert out["value"] == 0.9  # flagship headline intact
+    assert "timed out" in out["parity"]["error"]
+
+
+def test_compact_summary_survives_total_failure():
+    # Both workers dead: error records only — the summary must still emit the
+    # driver schema with value -1 rather than crash or omit fields.
+    results = [
+        {"metric": METRIC_FLAGSHIP, "value": -1.0, "unit": "s",
+         "vs_baseline": 0.0, "error": "flagship on all benchmark workers timed out"},
+    ]
+    out = compact_summary(results)
+    assert out["value"] == -1.0
+    assert out["platform"] == "none"
+    assert "error" in out
+
+    out_empty = compact_summary([])
+    assert out_empty["value"] == -1.0
+    assert out_empty["metric"] == METRIC_FLAGSHIP
