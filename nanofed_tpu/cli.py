@@ -116,6 +116,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "aggregation mode)", file=sys.stderr)
         return 2
 
+    if args.max_clients is not None and not args.dropout_tolerant:
+        # Only the tolerant enrollment window reads the cap; silently ignoring it
+        # would let an operator believe a larger cohort can enroll when the
+        # exact-cohort path caps at min_clients.
+        print("error: --max-clients only applies to the --dropout-tolerant "
+              "enrollment window (plain --secure cohorts are exactly "
+              "--min-clients)", file=sys.stderr)
+        return 2
+
     if args.max_clients is not None and args.max_clients < args.min_clients:
         print(f"error: --max-clients ({args.max_clients}) must be >= --min-clients "
               f"({args.min_clients}) — reaching the cap freezes the enrollment "

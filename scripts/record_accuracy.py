@@ -49,6 +49,14 @@ def main() -> int:
         "1,797-image digits set, 100 clients is a realistic ~18-images-per-client "
         "cross-device regime — the artifact name and body record the count)",
     )
+    # Optimizer overrides (round-5 sweep: at 100 clients the MLP plateaus at 96.1%
+    # with the defaults but crosses 97.5% by round ~21 with momentum 0.9 + 4 local
+    # epochs — the fragmented-shard regime needs more local progress per round).
+    ap.add_argument("--momentum", type=float, default=None)
+    ap.add_argument("--local-epochs", type=int, default=None)
+    ap.add_argument("--lr", type=float, default=None)
+    ap.add_argument("--hidden", type=int, default=None,
+                    help="digits_mlp width override (mlp evidence model only)")
     args = ap.parse_args()
 
     from nanofed_tpu.utils.platform import (
@@ -99,15 +107,25 @@ def main() -> int:
         num_clients, batch_eval = 8, 128
     else:
         dataset, model_name = "digits", "digits_mlp"
-        model = get_model(model_name, hidden=96)
+        model = get_model(model_name, hidden=args.hidden or 96)
         train = load_digits_dataset("train")
         test = load_digits_dataset("test")
         training = TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5)
         num_clients, batch_eval = 8, 128
 
-    if args.clients is not None:
-        import dataclasses
+    import dataclasses
 
+    overrides = {
+        k: v for k, v in (
+            ("momentum", args.momentum),
+            ("local_epochs", args.local_epochs),
+            ("learning_rate", args.lr),
+        ) if v is not None
+    }
+    if overrides:
+        training = dataclasses.replace(training, **overrides)
+
+    if args.clients is not None:
         num_clients = args.clients
         dataset = f"{dataset}_{num_clients}c"
         if num_clients * 2 > len(train):
@@ -151,12 +169,14 @@ def main() -> int:
             if dataset == "digits_cnn28"
             else "sklearn digits: 1,797 REAL handwritten-digit images (UCI optdigits)"
         ) if dataset != "mnist" else "MNIST IDX files",
-        "model": model_name,
+        "model": (f"{model_name}(hidden={args.hidden or 96})"
+                  if model_name == "digits_mlp" else model_name),
         "num_clients": num_clients,
         "scheme": "iid",
         "training": {"batch_size": training.batch_size,
                      "local_epochs": training.local_epochs,
-                     "learning_rate": training.learning_rate},
+                     "learning_rate": training.learning_rate,
+                     "momentum": training.momentum},
         "target_accuracy": TARGET_ACC,
         "reached": reached_at is not None,
         "reached_at_round": reached_at["round"] if reached_at else None,

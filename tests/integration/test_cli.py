@@ -103,3 +103,17 @@ def test_serve_secure_round(capsys):
 def test_unknown_benchmark_name_errors():
     with pytest.raises(KeyError):
         main(["bench", "not_a_benchmark"])
+
+
+def test_serve_flag_combinations_fail_fast(capsys):
+    """Misconfigurations exit 2 with a pointed message BEFORE binding anything:
+    --max-clients without the tolerant window (it would be silently ignored),
+    and a cap below the minimum (the implicit freeze would close enrollment at a
+    size the coordinator then waits on forever)."""
+    rc = main(["serve", "--secure", "--min-clients", "3", "--max-clients", "10"])
+    assert rc == 2
+    assert "--dropout-tolerant" in capsys.readouterr().err
+    rc = main(["serve", "--secure", "--dropout-tolerant",
+               "--min-clients", "5", "--max-clients", "3"])
+    assert rc == 2
+    assert "must be >=" in capsys.readouterr().err
