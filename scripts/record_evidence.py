@@ -13,9 +13,9 @@ Three modes, each writing a ``runs/*_r{N}.json`` artifact:
                   FedProx is FOR, Li et al. 2020): multi-seed trajectories at
                   μ ∈ {0, 0.05, 0.2} in a high-drift regime (16 local epochs, C=0.3).
                   The reference has no FedProx at all; BASELINE.json config #3 names it.
-- ``labelskew`` — the 100-client label-skew C=0.1 benchmark config run end-to-end with
-                  round wall-clocks (synthetic MNIST-shaped data, clearly labeled —
-                  the real-data story lives in the digits artifacts).
+- ``labelskew`` — BASELINE.json config #2 end-to-end on REAL data: 100 clients,
+                  2-class label-skew shards, C=0.1 participation, the flagship CNN on
+                  the real digits images upsampled to its 28x28 input.
 
 Usage:
     python scripts/record_evidence.py dp [--round-tag r03]
@@ -275,8 +275,6 @@ def run_labelskew(tag: str, num_rounds: int = 8) -> int:
     images upsampled to the CNN's 28x28 input.  Supersedes the r03 synthetic-data
     artifact (``real_data: false``); the cohort-gathering path makes the CNN config
     CPU-feasible (each round trains the 10-client cohort, not all 100)."""
-    import time as _time
-
     import jax
 
     from nanofed_tpu.data import federate, load_digits_dataset, pack_eval
@@ -300,7 +298,6 @@ def run_labelskew(tag: str, num_rounds: int = 8) -> int:
         training=training,
         eval_data=pack_eval(test, batch_size=256),
     )
-    t0 = _time.time()
     trajectory = _trajectory(coord)
     _write(f"labelskew_{tag}", {
         "artifact": f"labelskew_{tag}",
@@ -323,7 +320,7 @@ def run_labelskew(tag: str, num_rounds: int = 8) -> int:
         "final_test_accuracy": next(
             (r["test_accuracy"] for r in reversed(trajectory)
              if "test_accuracy" in r), None),
-        "total_wall_clock_s": round(_time.time() - t0, 2),
+        "total_wall_clock_s": trajectory[-1]["elapsed_s"] if trajectory else None,
         "trajectory": trajectory,
         "platform": str(jax.devices()[0].platform),
         "supersedes": "labelskew_r03 (synthetic MNIST-shaped data, real_data: false)",

@@ -21,8 +21,8 @@ Stages, in priority order (artifacts land in ``runs/``):
                the shape ceiling, fixed-vs-compute split (VERDICT item 2)
   accuracy100  ``scripts/record_accuracy.py --clients 100`` — north-star client
                count on real digits (VERDICT item 5)
-  labelskew    ``scripts/record_evidence.py labelskew`` — full config on-chip
-               (VERDICT item 6)
+  labelskew    ``scripts/record_evidence.py labelskew`` — config #2 (100 clients,
+               2-class shards, C=0.1, CNN) on real digits, on-chip
   dp_cnn       ``scripts/record_evidence.py dp --model cnn`` — privacy-utility on
                the flagship CNN (VERDICT item 7)
   accuracy1000 ``scripts/record_accuracy.py --clients 1000`` — clearly-labeled

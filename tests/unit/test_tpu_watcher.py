@@ -1,0 +1,88 @@
+"""The session-long tunnel watcher (scripts/tpu_watcher.py): probe loop mechanics.
+
+Round 4's lesson was that a single early probe leaves a recovered tunnel unnoticed for
+hours; the watcher's contract is (a) every attempt leaves a timestamped log line, (b)
+the FIRST successful probe fires the campaign exactly once with --skip-probe (the
+probe just passed — burning another 150 s probe budget would be waste), and (c) a
+session of failures still exits with a log that proves the tunnel was re-checked.
+Probes and the campaign are subprocesses, so they are stubbed at subprocess level —
+no accelerator needed.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+
+def _load_watcher():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watcher", REPO / "scripts" / "tpu_watcher.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(monkeypatch, tmp_path, probe_outcomes, argv):
+    """Drive watcher.main() with scripted probe outcomes; returns (rc, calls, log)."""
+    watcher = _load_watcher()
+    monkeypatch.setattr(watcher, "REPO", tmp_path)
+    (tmp_path / "runs").mkdir()
+    calls = []
+    outcomes = iter(probe_outcomes)
+
+    def fake_run(argv_, capture_output=None, text=None, timeout=None):
+        calls.append(("run", argv_))
+        ok = next(outcomes)
+        return SimpleNamespace(
+            stdout='{"probe": "ok", "platform": "tpu"}' if ok
+            else '{"probe": "timeout"}',
+            returncode=0 if ok else 3,
+        )
+
+    def fake_call(argv_):
+        calls.append(("call", argv_))
+        return 0
+
+    monkeypatch.setattr(watcher.subprocess, "run", fake_run)
+    monkeypatch.setattr(watcher.subprocess, "call", fake_call)
+    monkeypatch.setattr(watcher.time, "sleep", lambda s: None)
+    monkeypatch.setattr(sys, "argv", ["tpu_watcher.py", *argv])
+    rc = watcher.main()
+    log = (tmp_path / "runs" / "tpu_campaign_t.log").read_text()
+    return rc, calls, log
+
+
+def test_first_success_fires_campaign_once_and_stops(monkeypatch, tmp_path):
+    rc, calls, log = _run(
+        monkeypatch, tmp_path, [False, False, True],
+        ["--tag", "t", "--interval", "0.01", "--max-hours", "1"],
+    )
+    assert rc == 0
+    probes = [c for c in calls if c[0] == "run"]
+    fires = [c for c in calls if c[0] == "call"]
+    assert len(probes) == 3
+    assert len(fires) == 1  # exactly once, on FIRST success
+    campaign_argv = fires[0][1]
+    assert any("tpu_campaign.py" in str(a) for a in campaign_argv)
+    assert "--skip-probe" in campaign_argv  # the probe just passed
+    assert "--tag" in campaign_argv and "t" in campaign_argv
+    # Every attempt logged, plus the success and the campaign result.
+    assert log.count("probe #") == 3
+    assert "probe #3: OK" in log
+    assert "campaign finished rc=0" in log
+
+
+def test_all_failures_exit_2_with_full_probe_record(monkeypatch, tmp_path):
+    rc, calls, log = _run(
+        monkeypatch, tmp_path, [False] * 50,
+        ["--tag", "t", "--interval", "0.0001", "--max-hours", "1e-7"],
+    )
+    assert rc == 2
+    assert not [c for c in calls if c[0] == "call"]  # campaign never fired
+    # The round still leaves a timestamped record of every attempt (the r04 gap).
+    assert log.count("probe #") >= 1
+    assert "gave up" in log
