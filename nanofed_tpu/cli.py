@@ -87,6 +87,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         client_chunk=args.client_chunk,
         compute_dtype=args.dtype,
         central_privacy=central_privacy,
+        lr_schedule=args.lr_schedule,
+        lr_min_factor=args.lr_min_factor,
+        lr_decay_every=args.lr_decay_every,
+        lr_decay_gamma=args.lr_decay_gamma,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -241,6 +245,18 @@ def main(argv: list[str] | None = None) -> int:
         "--dtype", default=None, choices=["bfloat16", "float32"],
         help="local-training compute dtype (mixed precision when bfloat16)",
     )
+    run.add_argument(
+        "--lr-schedule", default="constant",
+        choices=["constant", "cosine", "linear", "step"],
+        help="per-round client-lr schedule; rides a traced scalar through the "
+        "compiled round step, so decaying costs zero recompiles",
+    )
+    run.add_argument("--lr-min-factor", type=float, default=0.0,
+                     help="terminal lr fraction for cosine/linear; floor for step")
+    run.add_argument("--lr-decay-every", type=int, default=10,
+                     help="step schedule: rounds between decays")
+    run.add_argument("--lr-decay-gamma", type=float, default=0.5,
+                     help="step schedule: multiplier per decay")
     run.add_argument(
         "--dp-epsilon", type=float, default=None,
         help="enable central DP-FedAvg with noise CALIBRATED to this epsilon budget "

@@ -63,6 +63,10 @@ def run_experiment(
     central_privacy: Any = None,
     client_chunk: int | None = None,
     compute_dtype: str | None = None,
+    lr_schedule: str = "constant",
+    lr_min_factor: float = 0.0,
+    lr_decay_every: int = 10,
+    lr_decay_gamma: float = 0.5,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -94,6 +98,10 @@ def run_experiment(
             seed=seed,
             base_dir=out_dir,
             eval_every=eval_every,
+            lr_schedule=lr_schedule,
+            lr_min_factor=lr_min_factor,
+            lr_decay_every=lr_decay_every,
+            lr_decay_gamma=lr_decay_gamma,
         ),
         training=TrainingConfig(
             batch_size=batch_size,

@@ -50,6 +50,7 @@ from nanofed_tpu.parallel.mesh import (
 from nanofed_tpu.parallel.round_step import build_round_step, init_server_state
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn, make_evaluator, stack_rngs
+from nanofed_tpu.trainer.schedules import SCHEDULES, lr_schedule_scale
 from nanofed_tpu.utils.logger import Logger, log_exec
 
 
@@ -71,6 +72,13 @@ class CoordinatorConfig:
     base_dir: str | Path = "runs"
     save_metrics: bool = True
     eval_every: int = 0  # 0 = never evaluate during training
+    # Per-round client-lr schedule (trainer.schedules): the scale streams into the
+    # compiled round step as a traced scalar, so a decaying lr costs zero recompiles.
+    # Pure function of the round index — resumed runs continue the schedule exactly.
+    lr_schedule: str = "constant"  # constant | cosine | linear | step
+    lr_min_factor: float = 0.0
+    lr_decay_every: int = 10  # step schedule: rounds between decays
+    lr_decay_gamma: float = 0.5  # step schedule: multiplier per decay
 
     def __post_init__(self) -> None:
         if self.num_rounds < 1:
@@ -81,6 +89,14 @@ class CoordinatorConfig:
             raise ValueError("min_completion_rate must be in [0, 1]")
         if not 0.0 <= self.dropout_rate < 1.0:
             raise ValueError("dropout_rate must be in [0, 1)")
+        if self.lr_schedule not in SCHEDULES:
+            raise ValueError(
+                f"unknown lr_schedule {self.lr_schedule!r}; choose from {SCHEDULES}"
+            )
+        if not 0.0 <= self.lr_min_factor <= 1.0:
+            raise ValueError("lr_min_factor must be in [0, 1]")
+        if self.lr_decay_every < 1:
+            raise ValueError("lr_decay_every must be >= 1")
 
 
 class Coordinator:
@@ -177,6 +193,19 @@ class Coordinator:
                 out_shardings=jax.tree.map(lambda _: sharded, self._data),
             )
 
+        if (
+            config.lr_schedule != "constant"
+            and local_fit is not None
+            and not getattr(local_fit, "supports_lr_scale", False)
+        ):
+            # The scale would be silently ignored — the operator would believe lr is
+            # decaying while every round trains at full rate.
+            raise ValueError(
+                f"lr_schedule={config.lr_schedule!r} requires a local_fit that "
+                "accepts lr_scale (make_local_fit/make_private_local_fit do; mark a "
+                "custom one with `fit.supports_lr_scale = True` once it honors the "
+                "argument)"
+            )
         self._round_step = build_round_step(
             model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
             local_fit=local_fit, central_privacy=central_privacy,
@@ -352,13 +381,22 @@ class Coordinator:
             rngs = stack_rngs(base, self._padded_clients)[idx_dev]
         else:
             rngs = stack_rngs(base, self._step_clients)
+        lr_scale = lr_schedule_scale(
+            self.config.lr_schedule, round_id, self.config.num_rounds,
+            min_factor=self.config.lr_min_factor,
+            decay_every=self.config.lr_decay_every,
+            gamma=self.config.lr_decay_gamma,
+        )
         result = self._round_step(
-            self.params, self.server_state, data, weights, rngs
+            self.params, self.server_state, data, weights, rngs,
+            jnp.float32(lr_scale),
         )
         self.params = result.params
         self.server_state = result.server_opt_state
 
         agg = {k: float(v) for k, v in result.metrics.items()}
+        if self.config.lr_schedule != "constant":
+            agg["lr_scale"] = round(lr_scale, 6)
         for count_key in ("participating_clients", "valid_clients"):
             if count_key in agg:
                 agg[count_key] = int(agg[count_key])

@@ -66,11 +66,13 @@ def build_round_step(
 ) -> RoundStepFn:
     """Compile the round function for a mesh.
 
-    Returns ``round_step(global_params, server_opt_state, data, weights, rngs)`` where
-    ``data`` leaves are ``[C, N, ...]`` sharded over ``axis_name``, ``weights`` is ``[C]``
-    (sample counts x participation mask — zero drops a client out of the reduction), and
-    ``rngs`` is ``[C]`` per-client keys.  Initialize ``server_opt_state`` with
-    ``init_server_state``.
+    Returns ``round_step(global_params, server_opt_state, data, weights, rngs,
+    lr_scale=1.0)`` where ``data`` leaves are ``[C, N, ...]`` sharded over
+    ``axis_name``, ``weights`` is ``[C]`` (sample counts x participation mask — zero
+    drops a client out of the reduction), and ``rngs`` is ``[C]`` per-client keys.
+    Initialize ``server_opt_state`` with ``init_server_state``.  ``lr_scale`` is a
+    TRACED scalar multiplying every local optimizer step — the per-round lr-schedule
+    hook (``trainer.schedules``): varying it across rounds does not retrace.
 
     ``local_fit`` overrides the default fit (e.g. ``make_private_local_fit`` for DP-SGD
     clients); it must have the ``local_fit(global_params, data, rng)`` signature.
@@ -112,6 +114,11 @@ def build_round_step(
             "local_fit, not both — a supplied local_fit ignores grad_fn"
         )
     local_fit = local_fit or make_local_fit(apply_fn, training, grad_fn=grad_fn)
+    # Per-round lr scheduling rides a TRACED scalar (one compiled program; see
+    # trainer.schedules).  A custom local_fit that doesn't declare support simply
+    # trains unscaled — the Coordinator refuses a non-constant schedule in that case
+    # rather than silently ignoring it.
+    fit_takes_lr_scale = getattr(local_fit, "supports_lr_scale", False)
     server_tx = strategy.server_tx
 
     def clip_deltas(delta):
@@ -119,7 +126,7 @@ def build_round_step(
         clip = central_privacy.privacy.max_gradient_norm
         return jax.vmap(lambda d: tree_clip_by_global_norm(d, clip)[0])(delta)
 
-    def streaming_chunk_reduce(gp_v, data, rngs, weights, n_chunks):
+    def streaming_chunk_reduce(fit, gp_v, data, rngs, weights, n_chunks):
         """Clients >> chips FAST PATH: fold the weighted reduce into the chunk loop.
 
         The materializing path below runs every chunk's ``vmap(local_fit)``, stacks all
@@ -145,7 +152,7 @@ def build_round_step(
 
         def step_chunk(acc, chunk):
             c_data, c_rngs, c_weights = chunk
-            result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, c_data, c_rngs)
+            result = jax.vmap(fit, in_axes=(None, 0, 0))(gp_v, c_data, c_rngs)
             delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
             if uniform_dp:
                 delta = clip_deltas(delta)
@@ -205,10 +212,17 @@ def build_round_step(
         metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
         return new_gp, new_sos, metrics, client_metrics, sq_norms
 
-    def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng):
+    def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale):
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
         gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
+        # The schedule scale is replicated data closed over by the per-client fit (the
+        # same scalar for every client in the round).
+        fit = (
+            (lambda g, d, r: local_fit(g, d, r, lr_scale=lr_scale))
+            if fit_takes_lr_scale
+            else local_fit
+        )
         c_local = rngs.shape[0]
         chunking = client_chunk is not None and client_chunk < c_local
         if chunking and c_local % client_chunk != 0:
@@ -218,7 +232,7 @@ def build_round_step(
             )
         if chunking and validation is None:
             local_wsum, client_metrics, sq_norms = streaming_chunk_reduce(
-                gp_v, data, rngs, weights, c_local // client_chunk
+                fit, gp_v, data, rngs, weights, c_local // client_chunk
             )
             return finish_streamed_round(
                 gp, sos, weights, noise_rng, client_metrics, sq_norms, local_wsum
@@ -229,14 +243,14 @@ def build_round_step(
                 lambda x: x.reshape(n_chunks, client_chunk, *x.shape[1:]), (data, rngs)
             )
             result = lax.map(
-                lambda args: jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, *args),
+                lambda args: jax.vmap(fit, in_axes=(None, 0, 0))(gp_v, *args),
                 chunked,
             )
             result = jax.tree.map(
                 lambda x: x.reshape(c_local, *x.shape[2:]), result
             )
         else:
-            result = jax.vmap(local_fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
+            result = jax.vmap(fit, in_axes=(None, 0, 0))(gp_v, data, rngs)
         delta = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
 
         if validation is not None:
@@ -296,7 +310,7 @@ def build_round_step(
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P()),
+        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P(), P()),
         out_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
     )
 
@@ -307,12 +321,17 @@ def build_round_step(
         data: ClientData,
         weights: jax.Array,
         rngs: PRNGKey,
+        lr_scale: jax.Array | float = 1.0,
     ) -> RoundStepResult:
         # Replicated server-side noise key (central DP), derived so every device draws the
         # identical noise on the replicated aggregate.
         noise_rng = jax.random.fold_in(rngs[0], 0x5EED)
+        # Traced (not static): callers pass a DIFFERENT scale every round under an lr
+        # schedule, and that must not retrace — normalize to f32 so python floats and
+        # jnp scalars share one compiled signature.
+        lr_scale = jnp.asarray(lr_scale, jnp.float32)
         gp, sos, metrics, client_metrics, sq_norms = sharded(
-            global_params, server_opt_state, data, weights, rngs, noise_rng
+            global_params, server_opt_state, data, weights, rngs, noise_rng, lr_scale
         )
         return RoundStepResult(gp, sos, metrics, client_metrics, sq_norms)
 

@@ -13,6 +13,7 @@ from nanofed_tpu.trainer.local import (
     make_optimizer,
     stack_rngs,
 )
+from nanofed_tpu.trainer.schedules import SCHEDULES, lr_schedule_scale
 from nanofed_tpu.trainer.private import (
     local_fit_noise_events,
     make_dp_grad_fn,
@@ -37,6 +38,8 @@ __all__ = [
     "make_optimizer",
     "make_private_local_fit",
     "record_local_fit",
+    "SCHEDULES",
+    "lr_schedule_scale",
     "stack_rngs",
     "validate_privacy_budget",
 ]

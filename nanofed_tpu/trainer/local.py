@@ -101,9 +101,18 @@ def make_local_fit(
 ) -> Callable[[Params, ClientData, PRNGKey], LocalFitResult]:
     """Build the pure local-training function for one client.
 
-    The returned ``local_fit(global_params, data, rng)`` is jit-compatible and
-    vmap-compatible over stacked clients.  FedProx: with ``config.prox_mu > 0`` the
-    proximal gradient ``mu * (w - w_global)`` is added analytically each step.
+    The returned ``local_fit(global_params, data, rng, lr_scale=None)`` is
+    jit-compatible and vmap-compatible over stacked clients.  FedProx: with
+    ``config.prox_mu > 0`` the proximal gradient ``mu * (w - w_global)`` is added
+    analytically each step.
+
+    ``lr_scale`` (an optional TRACED scalar) multiplies every optimizer step — the
+    per-round lr-schedule hook (``trainer.schedules``): scheduling via a traced
+    multiplier keeps one compiled round program, where re-baking
+    ``config.learning_rate`` per round would re-trace and re-compile.  Scaling the
+    post-momentum update is equivalent to running this fit at
+    ``learning_rate * lr_scale`` (optax applies lr after the momentum trace);
+    FedProx and decoupled weight decay scale with it, exactly as if lr changed.
     """
     if grad_fn is not None and config.compute_dtype is not None:
         # A custom grad_fn owns its own casts; silently ignoring the config would let a
@@ -118,7 +127,12 @@ def make_local_fit(
     tx = optimizer or make_optimizer(config)
     bsz = config.batch_size
 
-    def local_fit(global_params: Params, data: ClientData, rng: PRNGKey) -> LocalFitResult:
+    def local_fit(
+        global_params: Params,
+        data: ClientData,
+        rng: PRNGKey,
+        lr_scale: jax.Array | None = None,
+    ) -> LocalFitResult:
         n = data.x.shape[0]
         if n % bsz != 0:
             raise ValueError(
@@ -146,6 +160,8 @@ def make_local_fit(
                     prox = tree_scale(tree_sub(params, global_params), config.prox_mu)
                     grads = jax.tree.map(jnp.add, grads, prox)
                 updates, new_opt_state = tx.update(grads, opt_state, params)
+                if lr_scale is not None:
+                    updates = tree_scale(updates, lr_scale)
                 new_params = optax.apply_updates(params, updates)
                 # A batch of pure padding must be a no-op (both params and opt state).
                 nonempty = stats.count > 0
@@ -179,6 +195,10 @@ def make_local_fit(
             batch_loss=b_loss,
         )
 
+    # Marker for build_round_step: a CUSTOM local_fit override may not accept
+    # lr_scale, and a traced value cannot be introspected at call time — the round
+    # builder checks this attribute instead of the signature.
+    local_fit.supports_lr_scale = True
     return local_fit
 
 

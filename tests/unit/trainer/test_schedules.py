@@ -1,0 +1,246 @@
+"""Per-round lr schedules: host-side math + the traced-scale contract in the round step.
+
+The reference has no lr scheduling at all; here the design constraint is TPU-specific —
+a schedule must not recompile the round program (baking lr into the static
+TrainingConfig would re-trace every round), so the scale rides as a traced scalar and
+these tests pin (a) the schedule arithmetic, (b) that scaling is EXACTLY equivalent to
+changing the configured lr, and (c) that varying the scale across calls reuses one
+compiled program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.trainer import TrainingConfig, make_local_fit, stack_rngs
+from nanofed_tpu.trainer.schedules import SCHEDULES, lr_schedule_scale
+
+
+# --- schedule arithmetic -----------------------------------------------------------
+
+
+def test_constant_is_always_one():
+    assert all(lr_schedule_scale("constant", r, 10) == 1.0 for r in range(12))
+
+
+def test_cosine_endpoints_and_monotonicity():
+    scales = [lr_schedule_scale("cosine", r, 10, min_factor=0.1) for r in range(10)]
+    assert scales[0] == pytest.approx(1.0)
+    # The last TRAINED round sits one step above the floor — landing exactly on a
+    # min_factor=0 floor would make the final round a full-cost silent no-op.
+    assert 0.1 < scales[-1] < 0.2
+    assert all(a >= b for a, b in zip(scales, scales[1:]))  # monotone decreasing
+    # Past the planned horizon: hold the terminal value, don't extrapolate.
+    assert lr_schedule_scale("cosine", 10, 10, min_factor=0.1) == pytest.approx(0.1)
+    assert lr_schedule_scale("cosine", 25, 10, min_factor=0.1) == pytest.approx(0.1)
+
+
+def test_cosine_default_floor_never_zeroes_a_trained_round():
+    # The default min_factor=0.0 must never hand a scheduled round scale 0.0 — that
+    # round would train every client and discard every update.
+    scales = [lr_schedule_scale("cosine", r, 50) for r in range(50)]
+    assert min(scales) > 0.0
+
+
+def test_linear_is_a_straight_line():
+    scales = [lr_schedule_scale("linear", r, 5, min_factor=0.5) for r in range(5)]
+    np.testing.assert_allclose(scales, [1.0, 0.9, 0.8, 0.7, 0.6], atol=1e-9)
+    assert lr_schedule_scale("linear", 5, 5, min_factor=0.5) == pytest.approx(0.5)
+
+
+def test_step_staircase_floor_and_horizon_hold():
+    assert lr_schedule_scale("step", 0, 100, decay_every=10) == 1.0
+    assert lr_schedule_scale("step", 9, 100, decay_every=10) == 1.0
+    assert lr_schedule_scale("step", 10, 100, decay_every=10) == 0.5
+    assert lr_schedule_scale("step", 29, 100, decay_every=10) == 0.25
+    assert lr_schedule_scale(
+        "step", 90, 100, decay_every=10, gamma=0.5, min_factor=0.1
+    ) == pytest.approx(0.1)  # floored, not 0.5**9
+    # Past the horizon: hold the round total_rounds-1 value (docstring contract),
+    # don't keep decaying forever on an extended/resumed run.
+    held = lr_schedule_scale("step", 19, 20, decay_every=10)
+    assert lr_schedule_scale("step", 50, 20, decay_every=10) == held == 0.5
+
+
+def test_single_round_run_has_no_room_to_decay():
+    for s in ("cosine", "linear"):
+        assert lr_schedule_scale(s, 0, 1, min_factor=0.0) == 1.0
+
+
+def test_invalid_inputs_raise():
+    with pytest.raises(ValueError, match="unknown lr schedule"):
+        lr_schedule_scale("exponential", 0, 10)
+    with pytest.raises(ValueError, match="min_factor"):
+        lr_schedule_scale("cosine", 0, 10, min_factor=1.5)
+    with pytest.raises(ValueError, match="decay_every"):
+        lr_schedule_scale("step", 0, 10, decay_every=0)
+    assert set(SCHEDULES) == {"constant", "cosine", "linear", "step"}
+
+
+# --- the traced scale in local_fit -------------------------------------------------
+
+
+def _tiny_client(seed=0, n=8, d=4):
+    from nanofed_tpu.core.types import ClientData
+
+    rng = np.random.default_rng(seed)
+    return ClientData(
+        x=jnp.asarray(rng.normal(size=(n, d)), jnp.float32),
+        y=jnp.asarray(rng.integers(0, 2, size=n)),
+        mask=jnp.ones((n,), jnp.float32),
+    )
+
+
+def _params_of(fit, params, data, rng, lr_scale=None):
+    out = fit(params, data, rng, lr_scale) if lr_scale is not None else fit(
+        params, data, rng
+    )
+    return out.params
+
+
+def test_lr_scale_equals_configured_lr(monkeypatch):
+    """fit(lr=0.2, scale=0.5) must equal fit(lr=0.1) — including with momentum and
+    FedProx, where the scale multiplies the post-momentum step exactly like lr."""
+    from nanofed_tpu.models import get_model
+
+    model = get_model("linear", in_features=4, num_classes=2)
+    params = model.init(jax.random.key(0))
+    data = _tiny_client()
+    rng = jax.random.key(7)
+    for extra in ({}, {"momentum": 0.9}, {"prox_mu": 0.1}):
+        fit_hi = make_local_fit(
+            model.apply, TrainingConfig(batch_size=4, local_epochs=2,
+                                        learning_rate=0.2, **extra))
+        fit_lo = make_local_fit(
+            model.apply, TrainingConfig(batch_size=4, local_epochs=2,
+                                        learning_rate=0.1, **extra))
+        scaled = _params_of(jax.jit(fit_hi), params, data, rng,
+                            lr_scale=jnp.float32(0.5))
+        direct = _params_of(jax.jit(fit_lo), params, data, rng)
+        for a, b in zip(jax.tree.leaves(scaled), jax.tree.leaves(direct)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_lr_scale_zero_freezes_params():
+    from nanofed_tpu.models import get_model
+
+    model = get_model("linear", in_features=4, num_classes=2)
+    params = model.init(jax.random.key(0))
+    fit = make_local_fit(model.apply, TrainingConfig(batch_size=4, local_epochs=3))
+    out = fit(params, _tiny_client(), jax.random.key(1), jnp.float32(0.0))
+    for a, b in zip(jax.tree.leaves(out.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert getattr(fit, "supports_lr_scale", False) is True
+
+
+# --- the traced scale through the full SPMD round step -----------------------------
+
+
+def test_round_step_lr_scale_varies_without_retrace(devices):
+    """Different scales across rounds = one compiled program (the whole point of a
+    traced scale), and scale semantics survive shard_map + vmap + the streaming
+    chunk path."""
+    from nanofed_tpu.data import pack_clients, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.parallel import (
+        build_round_step,
+        init_server_state,
+        make_mesh,
+        pad_client_count,
+        pad_clients,
+        replicated_sharding,
+        shard_client_data,
+    )
+    from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+
+    model = get_model("linear", in_features=6, num_classes=2)
+    mesh = make_mesh()
+    n_dev = len(mesh.devices.flat)
+    ds = synthetic_classification(64, 2, (6,), seed=0)
+    data = pack_clients(ds, [np.arange(i * 8, (i + 1) * 8) for i in range(8)],
+                        batch_size=4)
+    padded = pad_client_count(8, n_dev)
+    data = shard_client_data(pad_clients(data, padded), mesh)
+    num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1))
+    weights = compute_weights(num_samples) * (num_samples > 0)
+    strategy = fedavg_strategy()
+    repl = replicated_sharding(mesh)
+    params = jax.device_put(model.init(jax.random.key(0)), repl)
+    sos = jax.device_put(init_server_state(strategy, params), repl)
+    training = TrainingConfig(batch_size=4, local_epochs=1, learning_rate=0.2)
+
+    # chunked (streaming reduce) so the scale is pinned through that path too
+    step = build_round_step(model.apply, training, mesh, strategy, client_chunk=1)
+
+    with jax.log_compiles(False):
+        r1 = step(params, sos, data, weights,
+                  stack_rngs(jax.random.key(1), padded), jnp.float32(1.0))
+        n_compiles_after_first = step._cache_size()
+        r2 = step(params, sos, data, weights,
+                  stack_rngs(jax.random.key(1), padded), jnp.float32(0.25))
+        assert step._cache_size() == n_compiles_after_first  # no retrace
+
+    # Same rngs: the 0.25-scaled round must differ from full-rate (it trained) but
+    # equal a quarter-lr config bit-for-bit.
+    step_q = build_round_step(
+        model.apply,
+        TrainingConfig(batch_size=4, local_epochs=1, learning_rate=0.05),
+        mesh, strategy, client_chunk=1,
+    )
+    rq = step_q(params, sos, data, weights, stack_rngs(jax.random.key(1), padded))
+    for a, b in zip(jax.tree.leaves(r2.params), jax.tree.leaves(rq.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(r1.params), jax.tree.leaves(r2.params))
+    )
+    assert changed
+
+
+def test_coordinator_cosine_schedule_end_to_end(tmp_path, devices):
+    """A scheduled Coordinator runs, reports lr_scale per round, and its terminal
+    round trains at ~min_factor."""
+    from nanofed_tpu.data import federate, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+
+    cd = federate(synthetic_classification(64, 2, (6,), seed=0), num_clients=8,
+                  scheme="iid", batch_size=4)
+    coord = Coordinator(
+        model=get_model("linear", in_features=6, num_classes=2),
+        train_data=cd,
+        config=CoordinatorConfig(num_rounds=4, seed=0, base_dir=tmp_path,
+                                 save_metrics=False, lr_schedule="cosine",
+                                 lr_min_factor=0.1),
+        training=TrainingConfig(batch_size=4, local_epochs=1),
+    )
+    history = coord.run()
+    scales = [m.agg_metrics["lr_scale"] for m in history]
+    assert scales[0] == pytest.approx(1.0)
+    # round 3 of 4: frac 0.75 -> 0.1 + 0.9*0.5*(1+cos(0.75*pi)) — above the floor
+    # (the final trained round never lands ON min_factor).
+    assert scales[-1] == pytest.approx(0.2318, abs=1e-3)
+    assert all(a >= b for a, b in zip(scales, scales[1:]))
+
+
+def test_coordinator_refuses_schedule_with_unaware_custom_fit(tmp_path, devices):
+    from nanofed_tpu.data import federate, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+
+    model = get_model("linear", in_features=6, num_classes=2)
+    cd = federate(synthetic_classification(64, 2, (6,), seed=0), num_clients=8,
+                  scheme="iid", batch_size=4)
+
+    def legacy_fit(gp, data, rng):  # no lr_scale, no marker
+        raise NotImplementedError
+
+    with pytest.raises(ValueError, match="supports_lr_scale"):
+        Coordinator(
+            model=model, train_data=cd,
+            config=CoordinatorConfig(num_rounds=2, seed=0, base_dir=tmp_path,
+                                     save_metrics=False, lr_schedule="cosine"),
+            training=TrainingConfig(batch_size=4),
+            local_fit=legacy_fit,
+        )
