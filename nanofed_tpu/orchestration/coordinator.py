@@ -97,6 +97,10 @@ class CoordinatorConfig:
             raise ValueError("lr_min_factor must be in [0, 1]")
         if self.lr_decay_every < 1:
             raise ValueError("lr_decay_every must be >= 1")
+        if not 0.0 < self.lr_decay_gamma <= 1.0:
+            # gamma=0 would zero every update from the first decay on (full-cost
+            # silent no-op rounds); gamma>1 silently GROWS the lr each decay.
+            raise ValueError("lr_decay_gamma must be in (0, 1]")
 
 
 class Coordinator:

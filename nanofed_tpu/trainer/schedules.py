@@ -61,6 +61,8 @@ def lr_schedule_scale(
     if schedule == "step":
         if decay_every < 1:
             raise ValueError("decay_every must be >= 1")
+        if not 0.0 < gamma <= 1.0:
+            raise ValueError("gamma must be in (0, 1]")
         effective = min(round_id, max(total_rounds - 1, 0))
         return max(min_factor, gamma ** (effective // decay_every))
     # cosine / linear interpolate over the run; a 1-round run has no room to decay.

@@ -35,6 +35,9 @@ def _run(monkeypatch, tmp_path, probe_outcomes, argv):
     outcomes = iter(probe_outcomes)
 
     def fake_run(argv_, capture_output=None, text=None, timeout=None):
+        if argv_ and argv_[0] == "pgrep":
+            # The core-contention guard: report no measurement running.
+            return SimpleNamespace(stdout="", returncode=1)
         calls.append(("run", argv_))
         ok = next(outcomes)
         return SimpleNamespace(
@@ -74,6 +77,33 @@ def test_first_success_fires_campaign_once_and_stops(monkeypatch, tmp_path):
     assert log.count("probe #") == 3
     assert "probe #3: OK" in log
     assert "campaign finished rc=0" in log
+
+
+def test_probe_defers_while_a_measurement_owns_the_core(monkeypatch, tmp_path):
+    """A 150 s backend-init probe mid-benchmark distorts round times ~2x on this
+    1-core host; the watcher must wait the cycle out, then resume probing."""
+    watcher = _load_watcher()
+    monkeypatch.setattr(watcher, "REPO", tmp_path)
+    (tmp_path / "runs").mkdir()
+    pgrep_results = iter(["12345\n", ""])  # busy once, then clear
+    probes = []
+
+    def fake_run(argv_, capture_output=None, text=None, timeout=None):
+        if argv_ and argv_[0] == "pgrep":
+            return SimpleNamespace(stdout=next(pgrep_results, ""), returncode=0)
+        probes.append(argv_)
+        return SimpleNamespace(stdout='{"probe": "ok"}', returncode=0)
+
+    monkeypatch.setattr(watcher.subprocess, "run", fake_run)
+    monkeypatch.setattr(watcher.subprocess, "call", lambda argv_: 0)
+    monkeypatch.setattr(watcher.time, "sleep", lambda s: None)
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_watcher.py", "--tag", "t", "--interval", "0.01"])
+    assert watcher.main() == 0
+    assert len(probes) == 1  # deferred cycle never probed
+    log = (tmp_path / "runs" / "tpu_campaign_t.log").read_text()
+    assert "deferring the probe" in log
+    assert "probe #1: OK" in log
 
 
 def test_all_failures_exit_2_with_full_probe_record(monkeypatch, tmp_path):

@@ -75,7 +75,20 @@ def test_invalid_inputs_raise():
         lr_schedule_scale("cosine", 0, 10, min_factor=1.5)
     with pytest.raises(ValueError, match="decay_every"):
         lr_schedule_scale("step", 0, 10, decay_every=0)
+    # gamma=0 would zero every post-decay round's updates (full-cost no-ops);
+    # gamma>1 would silently GROW the lr.
+    with pytest.raises(ValueError, match="gamma"):
+        lr_schedule_scale("step", 0, 10, gamma=0.0)
+    with pytest.raises(ValueError, match="gamma"):
+        lr_schedule_scale("step", 0, 10, gamma=1.5)
     assert set(SCHEDULES) == {"constant", "cosine", "linear", "step"}
+
+
+def test_coordinator_config_validates_gamma(tmp_path):
+    from nanofed_tpu.orchestration import CoordinatorConfig
+
+    with pytest.raises(ValueError, match="lr_decay_gamma"):
+        CoordinatorConfig(num_rounds=2, lr_schedule="step", lr_decay_gamma=0.0)
 
 
 # --- the traced scale in local_fit -------------------------------------------------
