@@ -57,6 +57,9 @@ def main() -> int:
     ap.add_argument("--lr", type=float, default=None)
     ap.add_argument("--hidden", type=int, default=None,
                     help="digits_mlp width override (mlp evidence model only)")
+    ap.add_argument("--lr-schedule", default="constant",
+                    choices=["constant", "cosine", "linear", "step"])
+    ap.add_argument("--lr-min-factor", type=float, default=0.0)
     args = ap.parse_args()
 
     from nanofed_tpu.utils.platform import (
@@ -138,7 +141,9 @@ def main() -> int:
         model=model,
         train_data=cd,
         config=CoordinatorConfig(num_rounds=args.max_rounds, seed=0,
-                                 base_dir="runs/accuracy_run", eval_every=1),
+                                 base_dir="runs/accuracy_run", eval_every=1,
+                                 lr_schedule=args.lr_schedule,
+                                 lr_min_factor=args.lr_min_factor),
         training=training,
         eval_data=pack_eval(test, batch_size=batch_eval),
     )
@@ -176,7 +181,8 @@ def main() -> int:
         "training": {"batch_size": training.batch_size,
                      "local_epochs": training.local_epochs,
                      "learning_rate": training.learning_rate,
-                     "momentum": training.momentum},
+                     "momentum": training.momentum,
+                     "lr_schedule": args.lr_schedule},
         "target_accuracy": TARGET_ACC,
         "reached": reached_at is not None,
         "reached_at_round": reached_at["round"] if reached_at else None,
