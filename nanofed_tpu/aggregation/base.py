@@ -46,15 +46,28 @@ def fedavg_strategy() -> Strategy:
     return Strategy(name="fedavg", server_tx=optax.sgd(1.0))
 
 
-def fedavgm_strategy(learning_rate: float = 1.0, momentum: float = 0.9) -> Strategy:
-    """FedAvg with server momentum (Hsu et al. 2019) — new capability."""
+def fedavgm_strategy(
+    learning_rate: float | optax.Schedule = 1.0, momentum: float = 0.9
+) -> Strategy:
+    """FedAvg with server momentum (Hsu et al. 2019) — new capability.
+
+    ``learning_rate`` may be an optax schedule (e.g.
+    ``optax.cosine_decay_schedule``): the server optimizer state PERSISTS across
+    rounds (unlike the client optimizer, re-initialized per local fit), so optax's
+    step counter is exactly the round index and server-side lr decay needs no extra
+    machinery — the complement of the client-side traced ``lr_scale``
+    (``trainer.schedules``)."""
     return Strategy(name="fedavgm", server_tx=optax.sgd(learning_rate, momentum=momentum))
 
 
 def fedadam_strategy(
-    learning_rate: float = 1e-2, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3
+    learning_rate: float | optax.Schedule = 1e-2,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-3,
 ) -> Strategy:
-    """FedAdam (Reddi et al. 2021) — new capability."""
+    """FedAdam (Reddi et al. 2021) — new capability.  ``learning_rate`` may be an
+    optax schedule, stepped per ROUND (see ``fedavgm_strategy``)."""
     return Strategy(name="fedadam", server_tx=optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
 
 

@@ -119,3 +119,28 @@ def test_strategies_construct():
     assert fedavg_strategy().name == "fedavg"
     assert fedavgm_strategy().name == "fedavgm"
     assert fedadam_strategy().name == "fedadam"
+
+
+def test_server_lr_schedule_steps_per_round():
+    """Server-side lr schedules ride optax's step counter, which counts ROUNDS here
+    because the server optimizer state persists across rounds (the complement of the
+    client-side traced lr_scale).  A schedule that zeroes the lr from step 1 on must
+    apply round 1's delta and freeze the params for round 2."""
+    import optax
+
+    strat = fedavgm_strategy(
+        learning_rate=lambda step: jnp.where(step == 0, 1.0, 0.0), momentum=0.0
+    )
+    params = {"w": jnp.zeros(3)}
+    sos = strat.server_tx.init(params)
+    delta = {"w": jnp.ones(3)}
+
+    def apply(params, sos):
+        neg = jax.tree.map(jnp.negative, delta)
+        updates, sos = strat.server_tx.update(neg, sos, params)
+        return optax.apply_updates(params, updates), sos
+
+    params, sos = apply(params, sos)  # round 0: lr 1.0 -> +delta
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+    params, sos = apply(params, sos)  # round 1: lr 0.0 -> frozen
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
