@@ -118,8 +118,29 @@ cohort's round. `dropout_tolerant=True` runs the Bonawitz §4 double-masking var
    **weighted FedAvg of the survivors**, and evicts the dropped client.
 
 Below, `c3` vanishes mid-round (after the share barrier — its masks are already baked
-into everyone's vectors) and the round still completes from 3 survivors.""",
+into everyone's vectors) and the round still completes from 3 survivors.
+
+> **Serving this over the wire** (`nanofed-tpu serve --secure --dropout-tolerant`):
+> `--min-clients` is a true *minimum* — enrollment stays open for stragglers (cap it
+> with `--max-clients`) until the roster quiesces, and the Shamir threshold is derived
+> from the cohort that **actually enrolled** (`max(configured, n//2+1)`, the
+> split-view floor), announced to clients in the roster and re-derived per round as
+> evictions shrink the active cohort. The static `threshold=3` below is the
+> library-level equivalent for this fixed 4-client demo cohort.""",
     # 12
+    """## 11. Per-round learning-rate schedules
+
+Round-wise client-lr decay is standard FL practice the reference lacks. The TPU
+constraint shapes the design: re-baking `TrainingConfig.learning_rate` per round is a
+*static* jit-argument change — every round would re-trace and re-compile (~20-40 s on
+a chip). Instead the schedule's scale streams through the compiled round step as a
+**traced scalar** (`round_step(..., lr_scale)`): one program, zero recompiles, and a
+resumed run continues the schedule exactly (it is a pure function of the round index).
+
+The *server* optimizer needs no machinery at all — its optax state persists across
+rounds, so `fedadam_strategy(learning_rate=optax.cosine_decay_schedule(...))` steps
+per round natively.""",
+    # 13
     """## Where to go next
 
 - **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
@@ -374,6 +395,24 @@ async def tolerant_round():
 nc2 = await tolerant_round()
 print("history:", nc2.history)
 assert nc2.history[0]["status"] == "COMPLETED" and nc2.history[0]["num_dropped"] == 1""",
+    # L (after MD 12) — per-round lr schedule: decaying scale, zero recompiles
+    """sched_coord = Coordinator(
+    model=model,
+    train_data=client_data,
+    config=CoordinatorConfig(num_rounds=6, seed=0, base_dir="runs/tutorial_sched",
+                             save_metrics=False, eval_every=2,
+                             lr_schedule="cosine", lr_min_factor=0.2),
+    training=TrainingConfig(batch_size=16, local_epochs=2, learning_rate=0.5),
+    eval_data=pack_eval(test, batch_size=128),
+)
+scales = []
+for m in sched_coord.start_training():
+    scales.append(m.agg_metrics["lr_scale"])
+    acc = m.eval_metrics.get("accuracy")
+    print(f"round {m.round_id}: lr_scale={scales[-1]:.3f}"
+          + (f"  test acc {acc:.4f}" if acc is not None else ""))
+assert scales[0] == 1.0 and all(a >= b for a, b in zip(scales, scales[1:]))
+assert scales[-1] > 0.2  # decayed toward — but never ONTO — the floor""",
 ]
 
 
@@ -382,12 +421,12 @@ def build() -> nbf.NotebookNode:
     nb.metadata["kernelspec"] = {"name": "python3", "display_name": "Python 3",
                                  "language": "python"}
     cells = [nbf.v4.new_markdown_cell(MD[0])]
-    pairs = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4), (6, 5), (7, 6), (8, 7),
-             (9, 8), (10, 9), (11, 10)]
-    for md_i, code_i in pairs:
+    # MD[i] pairs with CODE[i-1]; the last MD entry is the unpaired closing section —
+    # derived, so adding a section is one MD + one CODE append, not three edits.
+    for md_i in range(1, len(CODE) + 1):
         cells.append(nbf.v4.new_markdown_cell(MD[md_i]))
-        cells.append(nbf.v4.new_code_cell(CODE[code_i]))
-    cells.append(nbf.v4.new_markdown_cell(MD[12]))
+        cells.append(nbf.v4.new_code_cell(CODE[md_i - 1]))
+    cells.append(nbf.v4.new_markdown_cell(MD[-1]))
     nb.cells = cells
     return nb
 
