@@ -17,6 +17,10 @@ from nanofed_tpu.aggregation.fedavg import (
     psum_weighted_mean,
     psum_weighted_metrics,
 )
+from nanofed_tpu.aggregation.robust import (
+    RobustAggregationConfig,
+    trimmed_mean,
+)
 from nanofed_tpu.aggregation.privacy import (
     PrivacyAwareAggregationConfig,
     apply_central_privacy,
@@ -28,6 +32,8 @@ from nanofed_tpu.aggregation.privacy import (
 
 __all__ = [
     "AggregationResult",
+    "RobustAggregationConfig",
+    "trimmed_mean",
     "PrivacyAwareAggregationConfig",
     "Strategy",
     "apply_central_privacy",

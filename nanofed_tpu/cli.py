@@ -91,6 +91,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         lr_min_factor=args.lr_min_factor,
         lr_decay_every=args.lr_decay_every,
         lr_decay_gamma=args.lr_decay_gamma,
+        robust_trim_k=args.robust_trim,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -257,6 +258,12 @@ def main(argv: list[str] | None = None) -> int:
                      help="step schedule: rounds between decays")
     run.add_argument("--lr-decay-gamma", type=float, default=0.5,
                      help="step schedule: multiplier per decay")
+    run.add_argument(
+        "--robust-trim", type=int, default=None, metavar="K",
+        help="Byzantine-robust aggregation: coordinate-wise trimmed mean dropping "
+        "the K extremes per side (tolerates K colluding clients; unweighted over "
+        "the kept ranks; incompatible with --dp-epsilon)",
+    )
     run.add_argument(
         "--dp-epsilon", type=float, default=None,
         help="enable central DP-FedAvg with noise CALIBRATED to this epsilon budget "

@@ -67,6 +67,7 @@ def run_experiment(
     lr_min_factor: float = 0.0,
     lr_decay_every: int = 10,
     lr_decay_gamma: float = 0.5,
+    robust_trim_k: int | None = None,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -81,6 +82,11 @@ def run_experiment(
     precision; params/updates stay float32).
     """
     log = Logger()
+    robust = None
+    if robust_trim_k is not None:
+        from nanofed_tpu.aggregation import RobustAggregationConfig
+
+        robust = RobustAggregationConfig(trim_k=robust_trim_k)
     mdl = get_model(model)
     train, test = load_datasets_for(mdl, data_dir, train_size, seed)
     log.info("dataset %s: %d train / %d test samples", train.name, len(train), len(test))
@@ -113,6 +119,7 @@ def run_experiment(
         eval_data=pack_eval(test, batch_size=256),
         central_privacy=central_privacy,
         client_chunk=client_chunk,
+        robust=robust,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()

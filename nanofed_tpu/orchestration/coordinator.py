@@ -123,6 +123,7 @@ class Coordinator:
         accountant=None,
         local_fit: Callable | None = None,
         client_chunk: int | None = None,
+        robust=None,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
     ) -> None:
         self.model = model
@@ -177,6 +178,16 @@ class Coordinator:
         # validation stats, and accounting all operate on the same participating
         # set; dropped and padding slots carry weight 0 exactly as before.  Full
         # participation keeps the direct path untouched.
+        if robust is not None and self.cohort_size < 2 * robust.trim_k + 1:
+            # Every round would fail closed (zero aggregate) yet still be reported
+            # COMPLETED — a run that silently trains nothing. The cohort size is
+            # static, so refuse the configuration up front.
+            raise ValueError(
+                f"robust trim_k={robust.trim_k} needs a cohort of at least "
+                f"{2 * robust.trim_k + 1} clients, but participation_rate="
+                f"{config.participation_rate} over {self.num_clients} clients "
+                f"samples only {self.cohort_size} per round"
+            )
         self._cohort_mode = self.cohort_size < self.num_clients
         if self._cohort_mode and client_chunk is not None:
             # A chunk size that divided the full padded count may not divide the
@@ -213,7 +224,8 @@ class Coordinator:
         self._round_step = build_round_step(
             model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
             local_fit=local_fit, central_privacy=central_privacy,
-            validation=validation, client_chunk=client_chunk, donate=True,
+            validation=validation, robust=robust, client_chunk=client_chunk,
+            donate=True,
         )
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
 from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
 from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+from nanofed_tpu.aggregation.robust import RobustAggregationConfig, trimmed_mean
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import CLIENT_AXIS
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
@@ -60,6 +61,7 @@ def build_round_step(
     local_fit: Callable | None = None,
     central_privacy: PrivacyAwareAggregationConfig | None = None,
     validation: ValidationConfig | None = None,
+    robust: RobustAggregationConfig | None = None,
     client_chunk: int | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
@@ -103,11 +105,28 @@ def build_round_step(
     with ``validation`` the deltas must materialize, because cohort z-score rejection
     re-weights clients only after every client's statistics are known.
 
+    ``robust`` replaces the weighted-mean reduce with the coordinate-wise TRIMMED mean
+    (Yin et al. 2018; see ``aggregation.robust``): per-client deltas are
+    ``all_gather``ed over the client axis (order statistics need every value — a
+    ``psum`` cannot express a sort) and each coordinate discards the ``trim_k``
+    extremes per side before averaging, bounding any ``<= trim_k`` Byzantine clients'
+    influence structurally.  Unweighted over the kept ranks by design (sample-count
+    weighting would let an attacker amplify itself).  Composes with ``validation``
+    (rejected clients are excluded before the trim); refused alongside
+    ``central_privacy`` (the trimmed mean's DP sensitivity differs from the clipped
+    mean's — combining them silently would void the stated (ε, δ)).
+
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
     and keep only the returned arrays, as ``Coordinator`` does.
     """
     strategy = strategy or fedavg_strategy()
+    if robust is not None and central_privacy is not None:
+        raise ValueError(
+            "robust= cannot be combined with central_privacy=: the DP guarantee is "
+            "calibrated for the clipped uniform MEAN (sensitivity C/K); a trimmed "
+            "mean has a different sensitivity and the stated budget would be wrong"
+        )
     if local_fit is not None and grad_fn is not None:
         raise ValueError(
             "pass either grad_fn (used to build the default local fit) or a complete "
@@ -230,7 +249,9 @@ def build_round_step(
                 f"client_chunk {client_chunk} must divide per-device client count "
                 f"{c_local}"
             )
-        if chunking and validation is None:
+        if chunking and validation is None and robust is None:
+            # (robust aggregation, like validation, needs every client's delta
+            # materialized — order statistics cannot fold into a streamed sum.)
             local_wsum, client_metrics, sq_norms = streaming_chunk_reduce(
                 fit, gp_v, data, rngs, weights, c_local // client_chunk
             )
@@ -284,7 +305,31 @@ def build_round_step(
             )
 
         total_w = lax.psum(weights.sum(), axis_name)
-        if central_privacy is not None:
+        robust_kept = None
+        if robust is not None:
+            # Order statistics need the FULL client axis on every device: gather,
+            # trim each coordinate's extremes, average the kept ranks.  The result
+            # is identical on all devices (same gathered inputs), i.e. replicated.
+            gathered = jax.tree.map(
+                lambda d: lax.all_gather(d, axis_name, tiled=True), delta
+            )
+            part_full = lax.all_gather(
+                (weights > 0).astype(jnp.float32), axis_name, tiled=True
+            )
+            agg_delta, trim_ok, kept = trimmed_mean(
+                gathered, part_full, robust.trim_k
+            )
+            # Every device computed the identical aggregate from the identical
+            # gathered inputs, but shard_map's replication checker cannot infer
+            # that — a pmean over equal values IS the value and makes the
+            # replication explicit (same cost class as the plain path's psum).
+            agg_delta = jax.tree.map(lambda x: lax.pmean(x, axis_name), agg_delta)
+            trim_ok_f = lax.pmean(trim_ok.astype(jnp.float32), axis_name)
+            robust_kept = lax.pmean(kept, axis_name)
+            # Fail closed below the 2k+1 floor: zero effective weight leaves params
+            # AND server state untouched (same semantics as an empty round).
+            total_w = total_w * trim_ok_f.astype(total_w.dtype)
+        elif central_privacy is not None:
             delta = clip_deltas(delta)
             uniform = (weights > 0).astype(jnp.float32)
             participants = jnp.maximum(lax.psum(uniform.sum(), axis_name), 1.0)
@@ -295,6 +340,20 @@ def build_round_step(
         new_gp, new_sos = apply_server_update(gp, sos, agg_delta, total_w)
 
         metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
+        if robust_kept is not None:
+            # The attacker's DELTA is trimmed but its metric row would still ride
+            # the weighted mean (a NaN loss from one client would corrupt every
+            # round's reported numbers) — so the reported loss/accuracy are the
+            # TRIMMED means of the per-client scalars, same estimator, same k.
+            scalar_gather = lambda v: lax.all_gather(v, axis_name, tiled=True)
+            robust_scalars, _, _ = trimmed_mean(
+                {"loss": scalar_gather(result.metrics.loss),
+                 "accuracy": scalar_gather(result.metrics.accuracy)},
+                part_full, robust.trim_k,
+            )
+            metrics["loss"] = lax.pmean(robust_scalars["loss"], axis_name)
+            metrics["accuracy"] = lax.pmean(robust_scalars["accuracy"], axis_name)
+            metrics["robust_kept_clients"] = robust_kept
         if validation is not None:
             # participating = PRE-validation cohort; valid = the subset that survived.
             # The difference is the number of rejected updates this round.
