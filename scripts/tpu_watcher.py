@@ -24,6 +24,39 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 PY = sys.executable
 
+MEASUREMENT_SCRIPTS = (
+    "bench.py", "record_evidence.py", "record_accuracy.py",
+    "measure_cohort_gather.py", "measure_pallas.py", "profile_flagship.py",
+)
+
+
+def measurement_running() -> bool:
+    """True when a benchmark/evidence measurement owns the (single) core — a 150 s
+    backend-init probe mid-measurement distorts its round times by up to ~2x
+    (observed: 67 s vs 97 s for identical rounds), exactly the noise that fails
+    the linearity audit.
+
+    Parses /proc argv properly instead of pgrep -f substring matching: the session
+    harness's own wrapper process carries the literal text "bench.py" inside a huge
+    prompt argument and LIVES ALL SESSION — a substring guard deferred every probe
+    forever (observed r05).  A measurement is a python process whose argv contains
+    a TOKEN that is one of the known script paths."""
+    me = os.getpid()
+    for pid_dir in Path("/proc").iterdir():
+        if not pid_dir.name.isdigit() or int(pid_dir.name) == me:
+            continue
+        try:
+            argv = (pid_dir / "cmdline").read_bytes().split(b"\0")
+        except OSError:
+            continue
+        if not argv or b"python" not in argv[0]:
+            continue
+        for tok in argv[1:]:
+            name = tok.decode(errors="replace").rsplit("/", 1)[-1]
+            if name in MEASUREMENT_SCRIPTS:
+                return True
+    return False
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -43,27 +76,13 @@ def main() -> int:
         with open(log_path, "a") as f:
             f.write(line + "\n")
 
-    def bench_running() -> bool:
-        """True when a full bench/evidence measurement owns the (single) core — a
-        150 s backend-init probe mid-measurement distorts its round times by up to
-        ~2x (observed: 67 s vs 97 s for identical rounds), which is exactly the
-        noise that fails the linearity audit."""
-        check = subprocess.run(
-            ["pgrep", "-f", "bench.py|record_evidence.py|record_accuracy.py|"
-             "measure_cohort_gather.py|measure_pallas.py|profile_flagship.py"],
-            capture_output=True, text=True,
-        )
-        pids = [p for p in check.stdout.split()
-                if p.isdigit() and int(p) != os.getpid()]
-        return bool(pids)
-
     deadline = time.time() + args.max_hours * 3600.0
     attempt = 0
     deferred = 0
     log(f"armed — probing every {args.interval:.0f}s for up to "
         f"{args.max_hours:.1f}h; on first success: tpu_campaign.py --tag {args.tag}")
     while time.time() < deadline:
-        if bench_running():
+        if measurement_running():
             deferred += 1
             log("measurement in progress on this core — deferring the probe")
             time.sleep(args.interval)

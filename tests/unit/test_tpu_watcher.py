@@ -34,10 +34,9 @@ def _run(monkeypatch, tmp_path, probe_outcomes, argv):
     calls = []
     outcomes = iter(probe_outcomes)
 
+    monkeypatch.setattr(watcher, "measurement_running", lambda: False)
+
     def fake_run(argv_, capture_output=None, text=None, timeout=None):
-        if argv_ and argv_[0] == "pgrep":
-            # The core-contention guard: report no measurement running.
-            return SimpleNamespace(stdout="", returncode=1)
         calls.append(("run", argv_))
         ok = next(outcomes)
         return SimpleNamespace(
@@ -85,12 +84,12 @@ def test_probe_defers_while_a_measurement_owns_the_core(monkeypatch, tmp_path):
     watcher = _load_watcher()
     monkeypatch.setattr(watcher, "REPO", tmp_path)
     (tmp_path / "runs").mkdir()
-    pgrep_results = iter(["12345\n", ""])  # busy once, then clear
+    busy = iter([True, False])  # busy once, then clear
+    monkeypatch.setattr(watcher, "measurement_running",
+                        lambda: next(busy, False))
     probes = []
 
     def fake_run(argv_, capture_output=None, text=None, timeout=None):
-        if argv_ and argv_[0] == "pgrep":
-            return SimpleNamespace(stdout=next(pgrep_results, ""), returncode=0)
         probes.append(argv_)
         return SimpleNamespace(stdout='{"probe": "ok"}', returncode=0)
 
