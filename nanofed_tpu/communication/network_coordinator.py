@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nanofed_tpu.aggregation.fedavg import fedavg_combine
+from nanofed_tpu.aggregation.robust import RobustAggregationConfig, trimmed_mean
 from nanofed_tpu.communication.http_server import HTTPServer
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate, Params
 from nanofed_tpu.security.secure_agg import SecureAggregationConfig, unmask_sum
@@ -129,12 +130,27 @@ class NetworkCoordinator:
         config: NetworkRoundConfig,
         validation: ValidationConfig | None = None,
         secure: SecureAggregationConfig | None = None,
+        robust: RobustAggregationConfig | None = None,
     ):
+        """``robust`` (a ``RobustAggregationConfig``) swaps the weighted FedAvg of
+        drained updates for the coordinate-wise trimmed mean — the network path is
+        where actual Byzantine clients live (the simulator's clients are our own
+        code).  Incompatible with ``secure``: masked vectors are uniformly random,
+        so per-coordinate order statistics are meaningless until after unmasking,
+        and the server never sees unmasked individuals by design."""
+        if robust is not None and secure is not None:
+            raise ValueError(
+                "robust= cannot be combined with secure=: the server only ever "
+                "sees masked (uniformly random) vectors, so it cannot compute "
+                "order statistics over individual updates — that blindness is the "
+                "point of secure aggregation"
+            )
         self.server = server
         self.params = params
         self.config = config
         self.validation = validation
         self.secure = secure
+        self.robust = robust
         self.history: list[dict[str, Any]] = []
         self._log = Logger()
 
@@ -391,18 +407,50 @@ class NetworkCoordinator:
             self.history.append(record)
             return record
         stacked = stack_model_updates(updates)
-        self.params = fedavg_combine(stacked)
+        if self.robust is not None:
+            # FedAvg over params IS a mean of client params, so the trimmed mean
+            # drops straight in: coordinate-wise, unweighted over kept ranks (a
+            # Byzantine client claiming a huge num_samples must not amplify
+            # itself), every drained update participating.  The round's reported
+            # loss/accuracy ride the SAME estimator in the same call — a
+            # huge-but-finite claimed loss (the host _metric coercion only catches
+            # non-finite values) must not corrupt the round record either.
+            out, trim_ok, _ = trimmed_mean(
+                {"params": stacked.params,
+                 "loss": stacked.metrics.loss,
+                 "accuracy": stacked.metrics.accuracy},
+                jnp.ones(len(updates), jnp.float32),
+                self.robust.trim_k,
+            )
+            if not bool(trim_ok):
+                self._log.warning(
+                    "round %d FAILED: %d updates < robust floor 2*%d+1",
+                    round_number, len(updates), self.robust.trim_k,
+                )
+                record = {"round": round_number, "status": "FAILED",
+                          "num_clients": len(updates),
+                          "num_rejected": num_rejected,
+                          "reason": (f"{len(updates)} updates below the robust "
+                                     f"floor 2*{self.robust.trim_k}+1")}
+                self.history.append(record)
+                return record
+            self.params = out["params"]
+            round_metrics = {"loss": float(out["loss"]),
+                             "accuracy": float(out["accuracy"])}
+        else:
+            self.params = fedavg_combine(stacked)
+            round_metrics = {
+                "loss": float((stacked.metrics.loss * stacked.weights).sum()
+                              / stacked.weights.sum()),
+                "accuracy": float((stacked.metrics.accuracy * stacked.weights).sum()
+                                  / stacked.weights.sum()),
+            }
         record = {
             "round": round_number,
             "status": "COMPLETED",
             "num_clients": len(updates),
             "num_rejected": num_rejected,
-            "metrics": {
-                "loss": float((stacked.metrics.loss * stacked.weights).sum()
-                              / stacked.weights.sum()),
-                "accuracy": float((stacked.metrics.accuracy * stacked.weights).sum()
-                                  / stacked.weights.sum()),
-            },
+            "metrics": round_metrics,
         }
         self.history.append(record)
         self._log.info("round %d: %s", round_number, record["metrics"])

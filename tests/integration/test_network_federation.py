@@ -115,6 +115,88 @@ def test_full_network_federation_two_rounds():
     assert moved
 
 
+def test_robust_aggregation_over_the_wire():
+    """A Byzantine client POSTs a poisoned update (1e6 on every coordinate, huge
+    claimed sample count and loss) through the real HTTP transport; with
+    robust=trim_k=1 the aggregate stays in the honest clients' range and the round
+    metrics ignore the attacker's claimed loss."""
+    from nanofed_tpu.aggregation import RobustAggregationConfig
+
+    model = get_model("linear", in_features=8, num_classes=2)
+    training = TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+    local_fit = jax.jit(make_local_fit(model.apply, training))
+
+    def client_data(seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(16, 8)).astype(np.float32)
+        w = r.normal(size=(8,))
+        y = (x @ w > 0).astype(np.int32)
+        return ClientData(x=jnp.asarray(x), y=jnp.asarray(y), mask=jnp.ones((16,)))
+
+    async def byzantine_client(port):
+        init = get_model("linear", in_features=8, num_classes=2).init(
+            jax.random.key(0)
+        )
+        async with HTTPClient(f"http://127.0.0.1:{port}", "attacker",
+                              timeout_s=30) as client:
+            params, rnd, active = await client.fetch_global_model(like=init)
+            assert active
+            poisoned = jax.tree.map(lambda p: jnp.full_like(p, 1e6), params)
+            # Huge claimed sample count: weighting would amplify it; the trimmed
+            # mean must not care.
+            await client.submit_update(
+                poisoned, {"loss": 1e9, "accuracy": 1.0, "num_samples": 1e9}
+            )
+
+    async def main():
+        server = HTTPServer(port=PORT + 60)
+        await server.start()
+        try:
+            init = model.init(jax.random.key(0))
+            coordinator = NetworkCoordinator(
+                server, init,
+                NetworkRoundConfig(num_rounds=1, min_clients=4,
+                                   round_timeout_s=30),
+                robust=RobustAggregationConfig(trim_k=1),
+            )
+            results = await asyncio.gather(
+                coordinator.run(),
+                _run_client("c1", model, local_fit, client_data(1), PORT + 60),
+                _run_client("c2", model, local_fit, client_data(2), PORT + 60),
+                _run_client("c3", model, local_fit, client_data(3), PORT + 60),
+                byzantine_client(PORT + 60),
+            )
+            return results[0], init, coordinator
+        finally:
+            await server.stop()
+
+    history, init, coordinator = asyncio.run(main())
+    assert history[0]["status"] == "COMPLETED"
+    assert history[0]["num_clients"] == 4
+    # The attacker's 1e6 coordinates were trimmed: the aggregate stays sane.
+    for leaf in jax.tree.leaves(coordinator.params):
+        assert np.abs(np.asarray(leaf)).max() < 100.0
+    # And its claimed 1e9 loss never reached the round record.
+    assert history[0]["metrics"]["loss"] < 100.0
+
+
+def test_robust_refuses_secure_mode():
+    from nanofed_tpu.aggregation import RobustAggregationConfig
+    from nanofed_tpu.security.secure_agg import SecureAggregationConfig
+
+    async def scenario():
+        server = HTTPServer(port=0)
+        with pytest.raises(ValueError, match="masked"):
+            NetworkCoordinator(
+                server, {"w": jnp.zeros(3)},
+                NetworkRoundConfig(num_rounds=1),
+                secure=SecureAggregationConfig(min_clients=3),
+                robust=RobustAggregationConfig(trim_k=1),
+            )
+
+    asyncio.run(scenario())
+
+
 def test_stale_round_rejected_and_status():
     model = get_model("linear", in_features=4, num_classes=2)
     params = model.init(jax.random.key(0))
