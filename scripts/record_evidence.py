@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Record benchmark-evidence artifacts beyond the headline bench (VERDICT r2 items 6, 9).
 
-Three modes, each writing a ``runs/*_r{N}.json`` artifact:
+Four modes, each writing a ``runs/*_r{N}.json`` artifact:
 
 - ``dp``        — DP-FedAvg (central clip+noise at the reduce) on REAL digit images
                   upsampled to the flagship CNN's 28x28 input: per-round (ε, δ) spend
@@ -24,6 +24,7 @@ Usage:
     python scripts/record_evidence.py dp [--round-tag r03]
     python scripts/record_evidence.py fedprox
     python scripts/record_evidence.py labelskew
+    python scripts/record_evidence.py byzantine
 """
 
 from __future__ import annotations
@@ -52,6 +53,13 @@ def _trajectory(coord) -> list[dict]:
             row["test_accuracy"] = round(float(m.eval_metrics["accuracy"]), 4)
         out.append(row)
     return out
+
+
+def _final_accuracy(traj: list[dict]) -> float | None:
+    """Last EVALUATED accuracy — the final round may not be an eval round when
+    num_rounds % eval_every != 0 (the commit-ac86b76 semantics, in ONE place)."""
+    return next((r["test_accuracy"] for r in reversed(traj)
+                 if "test_accuracy" in r), None)
 
 
 def _write(name: str, artifact: dict) -> Path:
@@ -126,11 +134,7 @@ def run_dp(tag: str, model_name: str = "linear", num_rounds: int = 40,
             central_privacy=central_privacy,
         )
 
-    def final_acc_of(traj):
-        """Last EVALUATED accuracy — the final round may not be an eval round when
-        num_rounds % eval_every != 0."""
-        return next((r["test_accuracy"] for r in reversed(traj)
-                     if "test_accuracy" in r), None)
+    final_acc_of = _final_accuracy
 
     name = f"dp_fedavg_{tag}" if model_name != "cnn" else f"dp_fedavg_cnn_{tag}"
 
@@ -320,9 +324,7 @@ def run_labelskew(tag: str, num_rounds: int = 8) -> int:
                    "batch_size": training.batch_size,
                    "local_epochs": training.local_epochs,
                    "learning_rate": training.learning_rate},
-        "final_test_accuracy": next(
-            (r["test_accuracy"] for r in reversed(trajectory)
-             if "test_accuracy" in r), None),
+        "final_test_accuracy": _final_accuracy(trajectory),
         "total_wall_clock_s": trajectory[-1]["elapsed_s"] if trajectory else None,
         "trajectory": trajectory,
         "platform": str(jax.devices()[0].platform),
@@ -385,8 +387,7 @@ def run_byzantine(tag: str) -> int:
             robust=robust,
         )
         traj = _trajectory(coord)
-        final = next((r["test_accuracy"] for r in reversed(traj)
-                      if "test_accuracy" in r), None)
+        final = _final_accuracy(traj)
         arms[name] = {"final_test_accuracy": final, "trajectory": traj}
         print(f"  {name}: final {final}", flush=True)
 
@@ -407,8 +408,11 @@ def run_byzantine(tag: str) -> int:
         "arms": arms,
         "summary": (f"final held-out accuracy: clean FedAvg {clean}; under attack "
                     f"FedAvg {attacked} vs robust {robustf}"),
-        "defense_holds": bool(robustf is not None and attacked is not None
-                              and robustf > attacked),
+        # "Holds" means the defense PRESERVES clean accuracy (within 2 points),
+        # not merely that it beats the collapsed arm — a regressed trim landing at
+        # 15% would beat 7.8% yet be a broken defense.
+        "defense_holds": bool(robustf is not None and clean is not None
+                              and robustf >= clean - 0.02),
         "platform": str(jax.devices()[0].platform),
     })
     return 0
