@@ -39,6 +39,16 @@ def _cmd_info(_args: argparse.Namespace) -> int:
 def _cmd_run(args: argparse.Namespace) -> int:
     from nanofed_tpu.experiments import run_experiment
 
+    if args.robust_trim is not None and args.dp_epsilon is not None:
+        # build_round_step refuses the combination too, but with a traceback; the
+        # CLI should say why up front (the DP budget is calibrated for the clipped
+        # uniform mean — a trimmed mean has a different sensitivity).
+        print("error: --robust-trim cannot be combined with --dp-epsilon — the DP "
+              "guarantee is calibrated for the clipped mean; a trimmed mean has a "
+              "different sensitivity and the stated budget would be wrong",
+              file=sys.stderr)
+        return 2
+
     central_privacy = None
     if args.dp_epsilon is not None:
         from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig

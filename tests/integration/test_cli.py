@@ -105,6 +105,12 @@ def test_unknown_benchmark_name_errors():
         main(["bench", "not_a_benchmark"])
 
 
+def test_run_robust_with_dp_fails_fast(capsys):
+    rc = main(["run", "--robust-trim", "1", "--dp-epsilon", "4.0"])
+    assert rc == 2
+    assert "different sensitivity" in capsys.readouterr().err
+
+
 def test_serve_flag_combinations_fail_fast(capsys):
     """Misconfigurations exit 2 with a pointed message BEFORE binding anything:
     --max-clients without the tolerant window (it would be silently ignored),
