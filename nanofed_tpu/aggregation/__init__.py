@@ -19,6 +19,9 @@ from nanofed_tpu.aggregation.fedavg import (
 )
 from nanofed_tpu.aggregation.robust import (
     RobustAggregationConfig,
+    coordinate_median,
+    robust_aggregate,
+    robust_floor,
     trimmed_mean,
 )
 from nanofed_tpu.aggregation.privacy import (
@@ -33,6 +36,9 @@ from nanofed_tpu.aggregation.privacy import (
 __all__ = [
     "AggregationResult",
     "RobustAggregationConfig",
+    "coordinate_median",
+    "robust_aggregate",
+    "robust_floor",
     "trimmed_mean",
     "PrivacyAwareAggregationConfig",
     "Strategy",

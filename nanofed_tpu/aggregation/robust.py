@@ -41,16 +41,48 @@ from nanofed_tpu.core.types import Params
 
 @dataclass(frozen=True)
 class RobustAggregationConfig:
-    """``trim_k``: clients trimmed from EACH end of every coordinate's sorted value
-    list — tolerates up to ``trim_k`` Byzantine clients.  The round must keep at
-    least ``2 * trim_k + 1`` participants or it fails closed (zero aggregate,
-    params untouched — mirroring the zero-total-weight round semantics)."""
+    """``method="trimmed_mean"`` (default): ``trim_k`` clients trimmed from EACH end
+    of every coordinate's sorted value list — tolerates up to ``trim_k`` Byzantine
+    clients; the round must keep at least ``2 * trim_k + 1`` participants or it
+    fails closed (zero aggregate, params untouched — mirroring the
+    zero-total-weight round semantics).
+
+    ``method="median"``: the coordinate-wise median (Yin et al. 2018's other
+    estimator) — tolerates any MINORITY of Byzantine clients (< m/2) without a
+    tuning knob, at the cost of discarding more honest signal per round than a
+    small trim.  ``trim_k`` is ignored; the floor is 3 participants (the median of
+    1-2 values is just those values — no outvoting)."""
 
     trim_k: int = 1
+    method: str = "trimmed_mean"  # trimmed_mean | median
 
     def __post_init__(self) -> None:
-        if self.trim_k < 1:
+        if self.method not in ("trimmed_mean", "median"):
+            raise ValueError(
+                f"unknown robust method {self.method!r}; "
+                "choose trimmed_mean or median"
+            )
+        if self.method == "trimmed_mean" and self.trim_k < 1:
             raise ValueError("trim_k must be >= 1 (0 is just the plain mean)")
+
+
+def _rank_weighted_mean(stacked, mask, keep, denom, ok):
+    """Shared masking/sort/gate machinery for order-statistic estimators: sort each
+    coordinate with non-participants pushed to the top as ``+inf``, average the
+    ranks selected by ``keep`` (the keep-weights zero out the inf tail; the where
+    keeps the arithmetic NaN-free regardless), zero everything when ``ok`` fails."""
+    c = mask.shape[0]
+
+    def leaf(x):
+        shaped = mask.reshape((c,) + (1,) * (x.ndim - 1))
+        vals = jnp.where(shaped, x.astype(jnp.float32), jnp.inf)
+        srt = jnp.sort(vals, axis=0)
+        kv = keep.reshape(shaped.shape)
+        safe = jnp.where(kv > 0, srt, 0.0)
+        out = (safe * kv).sum(axis=0) / denom
+        return jnp.where(ok, out, jnp.zeros_like(out)).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
 
 
 def trimmed_mean(
@@ -69,22 +101,42 @@ def trimmed_mean(
     m = mask.sum()  # traced participant count
     kept = jnp.maximum(m - 2 * trim_k, 0).astype(jnp.float32)
     ok = m >= 2 * trim_k + 1
-    c = participating.shape[0]
-    ranks = jnp.arange(c)
+    ranks = jnp.arange(participating.shape[0])
     # Rank weights shared by every coordinate: keep ranks [trim_k, m - trim_k).
     keep = ((ranks >= trim_k) & (ranks < m - trim_k)).astype(jnp.float32)
-    denom = jnp.maximum(kept, 1.0)
+    agg = _rank_weighted_mean(stacked, mask, keep, jnp.maximum(kept, 1.0), ok)
+    return agg, ok, kept * ok.astype(jnp.float32)
 
-    def leaf(x):
-        shaped = mask.reshape((c,) + (1,) * (x.ndim - 1))
-        # Non-participants -> +inf: after an ascending sort participants occupy
-        # ranks [0, m) in every coordinate.
-        vals = jnp.where(shaped, x.astype(jnp.float32), jnp.inf)
-        srt = jnp.sort(vals, axis=0)
-        # keep-weights zero out the +inf tail, so the product never sees inf*0
-        # ambiguity — guard with where to keep the arithmetic NaN-free anyway.
-        safe = jnp.where(keep.reshape(shaped.shape) > 0, srt, 0.0)
-        out = (safe * keep.reshape(shaped.shape)).sum(axis=0) / denom
-        return jnp.where(ok, out, jnp.zeros_like(out)).astype(x.dtype)
 
-    return jax.tree.map(leaf, stacked), ok, kept * ok.astype(jnp.float32)
+def coordinate_median(
+    stacked: Params, participating: jax.Array
+) -> tuple[Params, jax.Array, jax.Array]:
+    """Coordinate-wise median over the participating clients — same contract and
+    masking discipline as ``trimmed_mean`` (non-participants ride ``+inf`` past the
+    participant ranks), same ``(aggregate, ok, kept)`` return — except ``kept``
+    reports the PARTICIPANT count m: every participant's ordering contributes to a
+    median, and "2 ranks averaged" on a 100-client dashboard would misread as 98
+    clients rejected.  Even participant counts average the two middle ranks; ``ok``
+    requires >= 3 participants (below that there is no outvoting a bad value)."""
+    mask = participating.astype(bool)
+    m = mask.sum()
+    ok = m >= 3
+    ranks = jnp.arange(participating.shape[0])
+    lo, hi = (m - 1) // 2, m // 2  # equal for odd m
+    keep = ((ranks == lo) | (ranks == hi)).astype(jnp.float32)
+    agg = _rank_weighted_mean(stacked, mask, keep, jnp.maximum(keep.sum(), 1.0), ok)
+    return agg, ok, m.astype(jnp.float32) * ok.astype(jnp.float32)
+
+
+def robust_aggregate(
+    config: RobustAggregationConfig, stacked: Params, participating: jax.Array
+) -> tuple[Params, jax.Array, jax.Array]:
+    """Dispatch on ``config.method`` — the single entry point round engines use."""
+    if config.method == "median":
+        return coordinate_median(stacked, participating)
+    return trimmed_mean(stacked, participating, config.trim_k)
+
+
+def robust_floor(config: RobustAggregationConfig) -> int:
+    """Minimum participants below which the round fails closed."""
+    return 3 if config.method == "median" else 2 * config.trim_k + 1

@@ -39,7 +39,8 @@ def _cmd_info(_args: argparse.Namespace) -> int:
 def _cmd_run(args: argparse.Namespace) -> int:
     from nanofed_tpu.experiments import run_experiment
 
-    if args.robust_trim is not None and args.dp_epsilon is not None:
+    if ((args.robust_trim is not None or args.robust_method is not None)
+            and args.dp_epsilon is not None):
         # build_round_step refuses the combination too, but with a traceback; the
         # CLI should say why up front (the DP budget is calibrated for the clipped
         # uniform mean — a trimmed mean has a different sensitivity).
@@ -102,6 +103,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         lr_decay_every=args.lr_decay_every,
         lr_decay_gamma=args.lr_decay_gamma,
         robust_trim_k=args.robust_trim,
+        robust_method=args.robust_method,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -273,6 +275,12 @@ def main(argv: list[str] | None = None) -> int:
         help="Byzantine-robust aggregation: coordinate-wise trimmed mean dropping "
         "the K extremes per side (tolerates K colluding clients; unweighted over "
         "the kept ranks; incompatible with --dp-epsilon)",
+    )
+    run.add_argument(
+        "--robust-method", default=None, choices=["trimmed_mean", "median"],
+        help="robust estimator: trimmed_mean (default when --robust-trim is set) "
+        "or median (knob-free, tolerates any Byzantine minority); incompatible "
+        "with --dp-epsilon",
     )
     run.add_argument(
         "--dp-epsilon", type=float, default=None,

@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from nanofed_tpu.aggregation.fedavg import fedavg_combine
-from nanofed_tpu.aggregation.robust import RobustAggregationConfig, trimmed_mean
+from nanofed_tpu.aggregation.robust import (
+    RobustAggregationConfig,
+    robust_aggregate,
+    robust_floor,
+)
 from nanofed_tpu.communication.http_server import HTTPServer
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate, Params
 from nanofed_tpu.security.secure_agg import SecureAggregationConfig, unmask_sum
@@ -415,23 +419,23 @@ class NetworkCoordinator:
             # loss/accuracy ride the SAME estimator in the same call — a
             # huge-but-finite claimed loss (the host _metric coercion only catches
             # non-finite values) must not corrupt the round record either.
-            out, trim_ok, _ = trimmed_mean(
+            out, trim_ok, _ = robust_aggregate(
+                self.robust,
                 {"params": stacked.params,
                  "loss": stacked.metrics.loss,
                  "accuracy": stacked.metrics.accuracy},
                 jnp.ones(len(updates), jnp.float32),
-                self.robust.trim_k,
             )
             if not bool(trim_ok):
                 self._log.warning(
-                    "round %d FAILED: %d updates < robust floor 2*%d+1",
-                    round_number, len(updates), self.robust.trim_k,
+                    "round %d FAILED: %d updates < robust floor %d",
+                    round_number, len(updates), robust_floor(self.robust),
                 )
                 record = {"round": round_number, "status": "FAILED",
                           "num_clients": len(updates),
                           "num_rejected": num_rejected,
                           "reason": (f"{len(updates)} updates below the robust "
-                                     f"floor 2*{self.robust.trim_k}+1")}
+                                     f"floor {robust_floor(self.robust)}")}
                 self.history.append(record)
                 return record
             self.params = out["params"]

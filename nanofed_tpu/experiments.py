@@ -68,6 +68,7 @@ def run_experiment(
     lr_decay_every: int = 10,
     lr_decay_gamma: float = 0.5,
     robust_trim_k: int | None = None,
+    robust_method: str | None = None,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -83,10 +84,13 @@ def run_experiment(
     """
     log = Logger()
     robust = None
-    if robust_trim_k is not None:
+    if robust_trim_k is not None or robust_method is not None:
         from nanofed_tpu.aggregation import RobustAggregationConfig
 
-        robust = RobustAggregationConfig(trim_k=robust_trim_k)
+        robust = RobustAggregationConfig(
+            trim_k=robust_trim_k if robust_trim_k is not None else 1,
+            method=robust_method or "trimmed_mean",
+        )
     mdl = get_model(model)
     train, test = load_datasets_for(mdl, data_dir, train_size, seed)
     log.info("dataset %s: %d train / %d test samples", train.name, len(train), len(test))

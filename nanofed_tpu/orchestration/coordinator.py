@@ -178,16 +178,19 @@ class Coordinator:
         # validation stats, and accounting all operate on the same participating
         # set; dropped and padding slots carry weight 0 exactly as before.  Full
         # participation keeps the direct path untouched.
-        if robust is not None and self.cohort_size < 2 * robust.trim_k + 1:
-            # Every round would fail closed (zero aggregate) yet still be reported
-            # COMPLETED — a run that silently trains nothing. The cohort size is
-            # static, so refuse the configuration up front.
-            raise ValueError(
-                f"robust trim_k={robust.trim_k} needs a cohort of at least "
-                f"{2 * robust.trim_k + 1} clients, but participation_rate="
-                f"{config.participation_rate} over {self.num_clients} clients "
-                f"samples only {self.cohort_size} per round"
-            )
+        if robust is not None:
+            from nanofed_tpu.aggregation.robust import robust_floor
+
+            if self.cohort_size < robust_floor(robust):
+                # Every round would fail closed (zero aggregate) yet still be
+                # reported COMPLETED — a run that silently trains nothing. The
+                # cohort size is static, so refuse the configuration up front.
+                raise ValueError(
+                    f"robust method {robust.method!r} needs a cohort of at least "
+                    f"{robust_floor(robust)} clients, but participation_rate="
+                    f"{config.participation_rate} over {self.num_clients} clients "
+                    f"samples only {self.cohort_size} per round"
+                )
         self._cohort_mode = self.cohort_size < self.num_clients
         if self._cohort_mode and client_chunk is not None:
             # A chunk size that divided the full padded count may not divide the

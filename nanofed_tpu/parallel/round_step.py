@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
 from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
 from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
-from nanofed_tpu.aggregation.robust import RobustAggregationConfig, trimmed_mean
+from nanofed_tpu.aggregation.robust import RobustAggregationConfig, robust_aggregate
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import CLIENT_AXIS
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
@@ -316,9 +316,7 @@ def build_round_step(
             part_full = lax.all_gather(
                 (weights > 0).astype(jnp.float32), axis_name, tiled=True
             )
-            agg_delta, trim_ok, kept = trimmed_mean(
-                gathered, part_full, robust.trim_k
-            )
+            agg_delta, trim_ok, kept = robust_aggregate(robust, gathered, part_full)
             # Every device computed the identical aggregate from the identical
             # gathered inputs, but shard_map's replication checker cannot infer
             # that — a pmean over equal values IS the value and makes the
@@ -346,10 +344,11 @@ def build_round_step(
             # round's reported numbers) — so the reported loss/accuracy are the
             # TRIMMED means of the per-client scalars, same estimator, same k.
             scalar_gather = lambda v: lax.all_gather(v, axis_name, tiled=True)
-            robust_scalars, _, _ = trimmed_mean(
+            robust_scalars, _, _ = robust_aggregate(
+                robust,
                 {"loss": scalar_gather(result.metrics.loss),
                  "accuracy": scalar_gather(result.metrics.accuracy)},
-                part_full, robust.trim_k,
+                part_full,
             )
             metrics["loss"] = lax.pmean(robust_scalars["loss"], axis_name)
             metrics["accuracy"] = lax.pmean(robust_scalars["accuracy"], axis_name)

@@ -7,7 +7,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from nanofed_tpu.aggregation import RobustAggregationConfig, trimmed_mean
+from nanofed_tpu.aggregation import (
+    RobustAggregationConfig,
+    coordinate_median,
+    robust_aggregate,
+    robust_floor,
+    trimmed_mean,
+)
 from nanofed_tpu.trainer import TrainingConfig, stack_rngs
 
 
@@ -69,6 +75,79 @@ def test_fails_closed_below_the_floor():
 def test_config_validates():
     with pytest.raises(ValueError, match="trim_k"):
         RobustAggregationConfig(trim_k=0)
+    with pytest.raises(ValueError, match="unknown robust method"):
+        RobustAggregationConfig(method="krum")
+    # median ignores trim_k entirely, including a zero one
+    RobustAggregationConfig(trim_k=0, method="median")
+    assert robust_floor(RobustAggregationConfig(trim_k=3)) == 7
+    assert robust_floor(RobustAggregationConfig(method="median")) == 3
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_median_matches_numpy_reference_with_masks(seed):
+    rng = np.random.default_rng(100 + seed)
+    c = int(rng.integers(4, 11))
+    mask = np.zeros(c, np.float32)
+    m = int(rng.integers(3, c + 1))
+    mask[rng.choice(c, size=m, replace=False)] = 1.0
+    vals = rng.normal(size=(c, 5)).astype(np.float32)
+    got, ok, kept = coordinate_median({"w": jnp.asarray(vals)}, jnp.asarray(mask))
+    assert bool(ok)
+    assert float(kept) == m  # participant count (not "ranks averaged")
+    expected = np.median(vals[mask.astype(bool)], axis=0)
+    np.testing.assert_allclose(np.asarray(got["w"]), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_median_outvotes_any_minority():
+    # 3 attackers among 7: the median ignores them entirely (trimmed mean would
+    # need trim_k=3, leaving only 1 rank — the median IS that estimator, knob-free).
+    rng = np.random.default_rng(1)
+    honest = rng.normal(size=(4, 6)).astype(np.float32)
+    attack = np.full((3, 6), 1e9, np.float32)
+    vals = np.concatenate([honest, attack], axis=0)
+    got, ok, _ = coordinate_median({"w": jnp.asarray(vals)},
+                                   jnp.ones(7, jnp.float32))
+    assert bool(ok)
+    g = np.asarray(got["w"])
+    assert (g <= honest.max(axis=0) + 1e-6).all()
+
+
+def test_median_fails_closed_below_three():
+    got, ok, kept = coordinate_median(
+        {"w": jnp.ones((4, 2))}, jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    )
+    assert not bool(ok) and float(kept) == 0.0
+    np.testing.assert_array_equal(np.asarray(got["w"]), 0.0)
+
+
+def test_robust_aggregate_dispatches():
+    vals = {"w": jnp.asarray(np.arange(15, dtype=np.float32).reshape(5, 3))}
+    ones = jnp.ones(5, jnp.float32)
+    med, _, _ = robust_aggregate(RobustAggregationConfig(method="median"), vals, ones)
+    tm, _, _ = robust_aggregate(RobustAggregationConfig(trim_k=1), vals, ones)
+    np.testing.assert_allclose(np.asarray(med["w"]), [6.0, 7.0, 8.0])
+    np.testing.assert_allclose(np.asarray(tm["w"]), [6.0, 7.0, 8.0])  # symmetric data
+
+
+def test_round_step_median_bounds_byzantine(devices):
+    from nanofed_tpu.parallel import build_round_step, make_mesh
+
+    mesh = make_mesh()
+    model, strategy, data, weights, padded, params, sos = _round_setup(8, mesh)
+    x = np.array(data.x)
+    x[0] = x[0] * 1e4
+    poisoned = data._replace(x=jnp.asarray(x))
+    training = TrainingConfig(batch_size=4, local_epochs=1, learning_rate=0.2)
+    res = build_round_step(
+        model.apply, training, mesh, strategy,
+        robust=RobustAggregationConfig(method="median"),
+    )(params, sos, poisoned, weights, stack_rngs(jax.random.key(5), padded))
+    step = max(
+        float(jnp.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(res.params), jax.tree.leaves(params))
+    )
+    assert step < 1.0
+    assert float(res.metrics["robust_kept_clients"]) == 8.0  # all participants
 
 
 def test_metrics_are_trimmed_too(devices):
