@@ -343,6 +343,7 @@ def run_byzantine(tag: str) -> int:
       clean_fedavg    no attackers (the ceiling)
       attacked_fedavg 2 attackers, plain weighted FedAvg
       attacked_robust 2 attackers, trimmed mean with trim_k=2
+      attacked_median 2 attackers, knob-free coordinate-wise median
     """
     import jax
     import jax.numpy as jnp
@@ -376,6 +377,7 @@ def run_byzantine(tag: str) -> int:
         ("clean_fedavg", False, None),
         ("attacked_fedavg", True, None),
         ("attacked_robust", True, RobustAggregationConfig(trim_k=n_attackers)),
+        ("attacked_median", True, RobustAggregationConfig(method="median")),
     ):
         coord = Coordinator(
             model=model, train_data=make_data(poison),
@@ -394,6 +396,7 @@ def run_byzantine(tag: str) -> int:
     clean = arms["clean_fedavg"]["final_test_accuracy"]
     attacked = arms["attacked_fedavg"]["final_test_accuracy"]
     robustf = arms["attacked_robust"]["final_test_accuracy"]
+    medianf = arms["attacked_median"]["final_test_accuracy"]
     _write(f"byzantine_{tag}", {
         "artifact": f"byzantine_{tag}",
         "claim": "coordinate-wise trimmed mean (aggregation.robust, Yin et al. "
@@ -407,7 +410,8 @@ def run_byzantine(tag: str) -> int:
                    "learning_rate": training.learning_rate},
         "arms": arms,
         "summary": (f"final held-out accuracy: clean FedAvg {clean}; under attack "
-                    f"FedAvg {attacked} vs robust {robustf}"),
+                    f"FedAvg {attacked} vs trimmed mean {robustf} vs median "
+                    f"{medianf}"),
         # "Holds" means the defense PRESERVES clean accuracy (within 2 points),
         # not merely that it beats the collapsed arm — a regressed trim landing at
         # 15% would beat 7.8% yet be a broken defense.
