@@ -49,6 +49,18 @@ def _cmd_run(args: argparse.Namespace) -> int:
               "different sensitivity and the stated budget would be wrong",
               file=sys.stderr)
         return 2
+    if args.scaffold and (
+        args.dp_epsilon is not None
+        or args.robust_trim is not None
+        or args.robust_method is not None
+    ):
+        # Same up-front courtesy as above: the Coordinator refuses these too, with
+        # a traceback (the control estimate is computed from the un-noised,
+        # un-trimmed local trajectory).
+        print("error: --scaffold cannot be combined with --dp-epsilon or "
+              "--robust-trim — DP noise / robust trimming would bias the control "
+              "estimate every later round relies on", file=sys.stderr)
+        return 2
 
     central_privacy = None
     if args.dp_epsilon is not None:
@@ -104,6 +116,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         lr_decay_gamma=args.lr_decay_gamma,
         robust_trim_k=args.robust_trim,
         robust_method=args.robust_method,
+        scaffold=args.scaffold,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -270,6 +283,12 @@ def main(argv: list[str] | None = None) -> int:
                      help="step schedule: rounds between decays")
     run.add_argument("--lr-decay-gamma", type=float, default=0.5,
                      help="step schedule: multiplier per decay")
+    run.add_argument(
+        "--scaffold", action="store_true",
+        help="SCAFFOLD control-variate correction (Karimireddy et al. 2020): "
+        "removes non-IID client drift at its source; shines under partial "
+        "participation. Requires plain SGD (no momentum) and refuses --dp-epsilon "
+        "and --robust-trim (each would bias the control estimate)")
     run.add_argument(
         "--robust-trim", type=int, default=None, metavar="K",
         help="Byzantine-robust aggregation: coordinate-wise trimmed mean dropping "

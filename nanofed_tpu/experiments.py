@@ -69,6 +69,7 @@ def run_experiment(
     lr_decay_gamma: float = 0.5,
     robust_trim_k: int | None = None,
     robust_method: str | None = None,
+    scaffold: bool = False,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -124,6 +125,7 @@ def run_experiment(
         central_privacy=central_privacy,
         client_chunk=client_chunk,
         robust=robust,
+        scaffold=scaffold,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()

@@ -124,6 +124,7 @@ class Coordinator:
         local_fit: Callable | None = None,
         client_chunk: int | None = None,
         robust=None,
+        scaffold: bool = False,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
     ) -> None:
         self.model = model
@@ -224,12 +225,41 @@ class Coordinator:
                 "custom one with `fit.supports_lr_scale = True` once it honors the "
                 "argument)"
             )
-        self._round_step = build_round_step(
-            model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
-            local_fit=local_fit, central_privacy=central_privacy,
-            validation=validation, robust=robust, client_chunk=client_chunk,
-            donate=True,
-        )
+        # SCAFFOLD (Karimireddy et al. 2020): control-variate round state — the server
+        # control rides replicated; every client's control is a row of a stacked pytree
+        # sharded exactly like the training data.  Cohort gathering gathers control
+        # rows alongside data rows and scatter-ADDS the returned deltas back
+        # (collision-safe: padding slots alias row 0 with an exact-zero delta).
+        self.scaffold = scaffold
+        if scaffold:
+            incompatible = {
+                "central_privacy": central_privacy, "validation": validation,
+                "robust": robust, "local_fit": local_fit,
+            }
+            bad = [k for k, v in incompatible.items() if v is not None]
+            if bad:
+                # The control estimate is computed from the UN-noised, UN-trimmed local
+                # trajectory; composing it with DP noise / robust trimming / arbitrary
+                # fits would silently bias every later round's correction.
+                raise ValueError(
+                    f"scaffold=True cannot be combined with {', '.join(bad)}: the "
+                    "control-variate update assumes the plain corrected-SGD local fit "
+                    "and the uniform participant mean"
+                )
+            from nanofed_tpu.parallel.scaffold_step import build_scaffold_round_step
+
+            self._round_step = build_scaffold_round_step(
+                model.apply, self.training, self.mesh, self.num_clients,
+                strategy=self.strategy, grad_fn=grad_fn, client_chunk=client_chunk,
+                donate=True,
+            )
+        else:
+            self._round_step = build_round_step(
+                model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
+                local_fit=local_fit, central_privacy=central_privacy,
+                validation=validation, robust=robust, client_chunk=client_chunk,
+                donate=True,
+            )
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None
         )
@@ -245,6 +275,43 @@ class Coordinator:
         self.server_state = jax.device_put(
             init_server_state(self.strategy, self.params), repl
         )
+        if scaffold:
+            from nanofed_tpu.parallel.mesh import client_sharding
+            from nanofed_tpu.trainer.scaffold import stack_zero_controls, zero_controls
+
+            csh = client_sharding(self.mesh)
+            self.c_global: Params = jax.device_put(zero_controls(self.params), repl)
+            self.c_stack: Params = jax.device_put(
+                stack_zero_controls(self.params, self._padded_clients), csh
+            )
+            stack_shardings = jax.tree.map(lambda _: csh, self.c_stack)
+            # Full-participation write-back: rows align with the stack, so the update
+            # is a fused elementwise add (a scatter here would invite GSPMD to lower
+            # cross-device index traffic for what is really identity addressing).
+            # Built in BOTH modes: tests force `_cohort_mode = False` to pin the
+            # gathered path against the full-N path.
+            self._add_controls = jax.jit(
+                lambda stack, delta: jax.tree.map(
+                    lambda s, d: s + d.astype(s.dtype), stack, delta
+                ),
+                donate_argnums=(0,),
+                out_shardings=stack_shardings,
+            )
+            if self._cohort_mode:
+                # delta rows arrive with the STEP's client count (cohort-padded), the
+                # stack with the population's — scatter-add bridges the two.  Donating
+                # the stack keeps the population controls single-buffered in HBM.
+                self._scatter_add_controls = jax.jit(
+                    lambda stack, idx, delta: jax.tree.map(
+                        lambda s, d: s.at[idx].add(d.astype(s.dtype)), stack, delta
+                    ),
+                    donate_argnums=(0,),
+                    out_shardings=stack_shardings,
+                )
+                self._gather_controls = jax.jit(
+                    lambda stack, idx: jax.tree.map(lambda x: x[idx], stack),
+                    out_shardings=stack_shardings,
+                )
         self.current_round = 0
         self.history: list[RoundMetrics] = []
 
@@ -260,7 +327,51 @@ class Coordinator:
                 # Same replicated placement as the fresh-init path: restored arrays come
                 # from the host and would otherwise change the round-step input sharding.
                 self.params = jax.device_put(restored.params, repl)
-                self.server_state = jax.device_put(restored.server_state, repl)
+                restored_ss = restored.server_state
+                has_controls = (
+                    isinstance(restored_ss, dict) and "scaffold_c_stack" in restored_ss
+                )
+                if not self.scaffold and has_controls:
+                    # The symmetric mistake must fail just as loudly: feeding the
+                    # wrapper dict to optax as "optimizer state" would surface as an
+                    # opaque pytree-structure error deep inside the jitted round step.
+                    raise NanoFedError(
+                        "the checkpoint carries SCAFFOLD control state but this "
+                        "coordinator was built with scaffold=False — resume with "
+                        "scaffold=True (or point at a non-SCAFFOLD run's store)"
+                    )
+                if self.scaffold:
+                    if not has_controls:
+                        raise NanoFedError(
+                            "scaffold=True but the checkpoint carries no control "
+                            "state — it was written by a non-SCAFFOLD run; resuming "
+                            "would silently zero every client's correction"
+                        )
+                    from nanofed_tpu.parallel.mesh import client_sharding
+
+                    restored_rows = jax.tree.leaves(
+                        restored_ss["scaffold_c_stack"]
+                    )[0].shape[0]
+                    if restored_rows != self._padded_clients:
+                        # Unlike params/server state (replicated, device-count-free),
+                        # the control stack's padding is mesh-derived — resuming on a
+                        # different device count must refuse clearly, not crash with
+                        # a broadcast error inside the first round's jit.
+                        raise NanoFedError(
+                            f"checkpointed control stack has {restored_rows} rows "
+                            f"but this mesh pads {self.num_clients} clients to "
+                            f"{self._padded_clients} — resume a SCAFFOLD run on the "
+                            "same device count it was checkpointed with"
+                        )
+                    csh = client_sharding(self.mesh)
+                    self.c_global = jax.device_put(
+                        restored_ss["scaffold_c_global"], repl
+                    )
+                    self.c_stack = jax.device_put(
+                        restored_ss["scaffold_c_stack"], csh
+                    )
+                    restored_ss = restored_ss["opt"]
+                self.server_state = jax.device_put(restored_ss, repl)
                 acct_state = restored.metadata.metrics.get("privacy_accountant")
                 if self.privacy_accountant is not None and acct_state is not None:
                     self.privacy_accountant.load_state_dict(acct_state)
@@ -291,10 +402,19 @@ class Coordinator:
                         ckpt_metrics["privacy_accountant"] = (
                             self.privacy_accountant.state_dict()
                         )
+                    ckpt_server_state = self.server_state
+                    if self.scaffold:
+                        # The controls ARE round state: resuming without them would
+                        # silently restart every client's correction from zero.
+                        ckpt_server_state = {
+                            "opt": self.server_state,
+                            "scaffold_c_global": self.c_global,
+                            "scaffold_c_stack": self.c_stack,
+                        }
                     self.state_store.checkpoint(
                         round_number=metrics.round_id,
                         params=self.params,
-                        server_state=self.server_state,
+                        server_state=ckpt_server_state,
                         metrics=ckpt_metrics,
                         status=(
                             "COMPLETED"
@@ -406,10 +526,32 @@ class Coordinator:
             decay_every=self.config.lr_decay_every,
             gamma=self.config.lr_decay_gamma,
         )
-        result = self._round_step(
-            self.params, self.server_state, data, weights, rngs,
-            jnp.float32(lr_scale),
-        )
+        if self.scaffold:
+            c_rows = (
+                self._gather_controls(self.c_stack, idx_dev)
+                if self._cohort_mode
+                else self.c_stack
+            )
+            result = self._round_step(
+                self.params, self.server_state, self.c_global, c_rows,
+                data, weights, rngs, jnp.float32(lr_scale),
+            )
+            self.c_global = result.c_global
+            if self._cohort_mode:
+                # Participants' control rows move by their delta; padding/dropped
+                # slots add exact zeros (collision-safe though they alias row 0).
+                self.c_stack = self._scatter_add_controls(
+                    self.c_stack, idx_dev, result.delta_c
+                )
+            else:
+                # Rows already align with the stack — a fused elementwise add, not a
+                # scatter (which GSPMD may lower with cross-device index traffic).
+                self.c_stack = self._add_controls(self.c_stack, result.delta_c)
+        else:
+            result = self._round_step(
+                self.params, self.server_state, data, weights, rngs,
+                jnp.float32(lr_scale),
+            )
         self.params = result.params
         self.server_state = result.server_opt_state
 

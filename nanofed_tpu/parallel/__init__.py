@@ -20,11 +20,17 @@ from nanofed_tpu.parallel.round_step import (
     build_round_step,
     init_server_state,
 )
+from nanofed_tpu.parallel.scaffold_step import (
+    ScaffoldStepResult,
+    build_scaffold_round_step,
+)
 
 __all__ = [
     "CLIENT_AXIS",
     "RoundStepResult",
+    "ScaffoldStepResult",
     "build_round_step",
+    "build_scaffold_round_step",
     "client_sharding",
     "init_server_state",
     "initialize_distributed",

@@ -1,0 +1,183 @@
+"""The SCAFFOLD federated round as one jitted SPMD program.
+
+Same shape as ``parallel.round_step`` — ``jit(shard_map(vmap(local_fit) -> psum))`` —
+with two extra pieces of ROUND STATE flowing through the program:
+
+    c        the server control (replicated, params-shaped)
+    c_stack  every client's control (``[C, ...]`` sharded over the client axis)
+
+Per round (Karimireddy et al. 2020, Alg. 1):
+
+    per device:  vmap(scaffold_fit) over its client shard — each local step corrected
+                 by (c - c_i); each client emits (delta y_i, delta c_i)
+    across mesh: x <- x + server_tx( mean_{participants} delta y_i )   (uniform mean:
+                 the paper's estimator — sample-count weighting would re-bias exactly
+                 the drift the controls remove)
+                 c <- c + sum_{participants} delta c_i / N_total
+    write-back:  delta c_i rows are returned PER CLIENT (zeroed for non-participants)
+                 so the host can ``scatter-add`` them into the population stack —
+                 collision-safe under cohort gathering, where padding slots all alias
+                 row 0 with weight 0 (an ``.at[idx].add`` of exact zeros).
+
+The reference has no comparable algorithm (its trainer surface is plain SGD + DP-SGD,
+``nanofed/trainer/``); SCAFFOLD is part of this framework's non-IID story alongside
+FedProx (``trainer.local``) and server momentum/Adam (``aggregation.base``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
+from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
+from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
+from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.trainer.config import TrainingConfig
+from nanofed_tpu.trainer.local import GradFn
+from nanofed_tpu.trainer.scaffold import make_scaffold_local_fit
+from nanofed_tpu.utils.trees import tree_sq_norm, tree_where
+
+
+class ScaffoldStepResult(NamedTuple):
+    params: Params  # new global params (replicated)
+    server_opt_state: Any  # server optimizer state (replicated)
+    c_global: Params  # updated server control (replicated)
+    delta_c: Params  # [C, ...] per-client control deltas (zero for non-participants)
+    metrics: dict[str, jax.Array]
+    client_metrics: ClientMetrics  # per-client arrays [C]
+    update_sq_norms: jax.Array  # [C]
+
+
+def build_scaffold_round_step(
+    apply_fn: Callable[..., jax.Array],
+    training: TrainingConfig,
+    mesh: Mesh,
+    num_clients_total: int,
+    strategy: Strategy | None = None,
+    grad_fn: GradFn | None = None,
+    client_chunk: int | None = None,
+    axis_name: str = CLIENT_AXIS,
+    donate: bool = False,
+) -> Callable[..., ScaffoldStepResult]:
+    """Compile the SCAFFOLD round for a mesh.
+
+    Returns ``scaffold_step(global_params, server_opt_state, c_global, c_stack, data,
+    weights, rngs, lr_scale=1.0)``.  ``c_stack`` leaves are ``[C, ...]`` sharded over
+    ``axis_name`` EXACTLY like ``data`` — under cohort gathering the caller gathers the
+    cohort's control rows alongside its data rows and scatter-adds the returned
+    ``delta_c`` back (``Coordinator`` owns both sides).
+
+    ``num_clients_total`` is the REAL population size N (not the padded stack size):
+    the server-control step c <- c + (|S|/N) * mean delta c_i deliberately under-weights
+    a small cohort's information, and padding rows are not clients.
+
+    ``weights`` keeps the standard sample-count-times-mask convention so reporting
+    (weighted metrics) matches every other path, but the MODEL aggregate is the uniform
+    participant mean — the paper's estimator, and the sensitivity-free choice
+    (sample-count weighting would let one hoarding client steer the corrected round).
+
+    ``client_chunk`` bounds activation memory via a ``lax.map`` over chunks of a
+    chunk-wide ``vmap``.  There is no streaming variant: SCAFFOLD's per-client OUTPUT
+    (``delta_c``) is itself params-sized per client, so the ``[C, |params|]`` output
+    stack exists regardless — streaming the reduce would save nothing.
+    """
+    strategy = strategy or fedavg_strategy()
+    server_tx = strategy.server_tx
+    local_fit = make_scaffold_local_fit(apply_fn, training, grad_fn=grad_fn)
+
+    def shard_body(gp, sos, c_global, c_stack, data: ClientData, weights, rngs, lr_scale):
+        gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
+        cg_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), c_global)
+        fit = lambda g, d, r, ci: local_fit(g, d, r, cg_v, ci, lr_scale=lr_scale)
+        c_local = rngs.shape[0]
+        chunking = client_chunk is not None and client_chunk < c_local
+        if chunking and c_local % client_chunk != 0:
+            raise ValueError(
+                f"client_chunk {client_chunk} must divide per-device client count "
+                f"{c_local}"
+            )
+        vfit = jax.vmap(fit, in_axes=(None, 0, 0, 0))
+        if chunking:
+            n_chunks = c_local // client_chunk
+            chunked = jax.tree.map(
+                lambda x: x.reshape(n_chunks, client_chunk, *x.shape[1:]),
+                (data, rngs, c_stack),
+            )
+            result = lax.map(
+                lambda args: vfit(gp_v, args[0], args[1], args[2]), chunked
+            )
+            result = jax.tree.map(lambda x: x.reshape(c_local, *x.shape[2:]), result)
+        else:
+            result = vfit(gp_v, data, rngs, c_stack)
+
+        delta_y = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
+        participating = (weights > 0).astype(jnp.float32)
+        total_w = lax.psum(weights.sum(), axis_name)
+
+        # Model update: server_tx over the UNIFORM participant mean of delta y.
+        agg_delta = psum_weighted_mean(delta_y, participating, axis_name)
+        neg_delta = jax.tree.map(jnp.negative, agg_delta)
+        updates, new_sos = server_tx.update(neg_delta, sos, gp)
+        ok = total_w > 0
+        new_gp = tree_where(ok, optax.apply_updates(gp, updates), gp)
+        new_sos = tree_where(ok, new_sos, sos)
+
+        # Control updates: dc rows zeroed outside the cohort (the scatter-add then
+        # writes exact zeros for padding/dropped slots); the server control moves by
+        # sum_participants dc_i / N_total — an empty round moves nothing.
+        delta_c = jax.tree.map(
+            lambda d: jnp.where(
+                participating.reshape((-1,) + (1,) * (d.ndim - 1)) > 0, d, 0.0
+            ).astype(d.dtype),
+            result.delta_c,
+        )
+        c_sum = jax.tree.map(lambda d: lax.psum(d.sum(axis=0), axis_name), delta_c)
+        new_c_global = jax.tree.map(
+            lambda c, s: jnp.where(ok, c + s / float(num_clients_total), c).astype(
+                c.dtype
+            ),
+            c_global, c_sum,
+        )
+
+        metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
+        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        sq_norms = jax.vmap(tree_sq_norm)(delta_y)
+        return new_gp, new_sos, new_c_global, delta_c, metrics, result.metrics, sq_norms
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis_name), P(axis_name), P(axis_name),
+                  P(axis_name), P()),
+        out_specs=(P(), P(), P(), P(axis_name), P(), P(axis_name), P(axis_name)),
+    )
+
+    # c_stack (argnum 3) is deliberately NOT donated: in full-participation mode the
+    # caller passes its population stack directly and must still scatter-add the
+    # returned deltas into that same buffer after the step.
+    @partial(jax.jit, donate_argnums=(0, 1, 2) if donate else ())
+    def scaffold_step(
+        global_params: Params,
+        server_opt_state: Any,
+        c_global: Params,
+        c_stack: Params,
+        data: ClientData,
+        weights: jax.Array,
+        rngs: PRNGKey,
+        lr_scale: jax.Array | float = 1.0,
+    ) -> ScaffoldStepResult:
+        lr_scale = jnp.asarray(lr_scale, jnp.float32)
+        gp, sos, cg, dc, metrics, client_metrics, sq_norms = sharded(
+            global_params, server_opt_state, c_global, c_stack, data, weights, rngs,
+            lr_scale,
+        )
+        return ScaffoldStepResult(gp, sos, cg, dc, metrics, client_metrics, sq_norms)
+
+    return scaffold_step

@@ -13,6 +13,12 @@ from nanofed_tpu.trainer.local import (
     make_optimizer,
     stack_rngs,
 )
+from nanofed_tpu.trainer.scaffold import (
+    ScaffoldFitResult,
+    make_scaffold_local_fit,
+    stack_zero_controls,
+    zero_controls,
+)
 from nanofed_tpu.trainer.schedules import SCHEDULES, lr_schedule_scale
 from nanofed_tpu.trainer.private import (
     local_fit_noise_events,
@@ -27,6 +33,7 @@ __all__ = [
     "Callback",
     "LocalFitResult",
     "MetricsLogger",
+    "ScaffoldFitResult",
     "StepStats",
     "Trainer",
     "TrainingConfig",
@@ -37,7 +44,10 @@ __all__ = [
     "make_local_fit",
     "make_optimizer",
     "make_private_local_fit",
+    "make_scaffold_local_fit",
     "record_local_fit",
+    "stack_zero_controls",
+    "zero_controls",
     "SCHEDULES",
     "lr_schedule_scale",
     "stack_rngs",

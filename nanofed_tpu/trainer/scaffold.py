@@ -1,0 +1,201 @@
+"""SCAFFOLD local training: control-variate-corrected SGD (Karimireddy et al. 2020).
+
+FedAvg's local steps follow each client's OWN gradient field; under non-IID data the
+clients drift toward their local optima and the averaged model oscillates between them
+— the reference framework's only answer is FedProx's proximal pull (and the reference
+itself has neither; see ``nanofed/trainer/`` — plain ``TorchTrainer``/``PrivateTrainer``
+are its whole algorithm surface).  SCAFFOLD removes the drift at its source: every local
+step is corrected by the difference between the estimated GLOBAL gradient direction
+(server control ``c``) and the client's own (client control ``c_i``),
+
+    y  <-  y - eta_l * (grad f_i(y) + c - c_i),
+
+so in expectation each client walks the global descent direction even on fully skewed
+shards.  After ``K`` effective steps the client re-estimates its control (option II of
+the paper — no extra gradient pass):
+
+    c_i+  =  c_i - c + (x - y) / (K * eta_l)          (== the mean of its local grads)
+    dc_i  =  c_i+ - c_i
+
+TPU mapping: ``c_i`` for the whole population is a STACKED pytree ``[C, ...]`` sharded
+over the client mesh axis (exactly like the training data), and the corrected fit is
+``vmap``-ed over ``(data_i, rng_i, c_i)`` — one client's control ride-along costs one
+extra vector add per step on the VPU, fused by XLA into the optimizer update.  The fit
+returns ``dc_i`` (not ``c_i+``) so the round step can write participants back with a
+collision-safe ``scatter-add`` (non-participants contribute an exact zero).
+
+Restrictions are enforced, not documented away: the option-II control estimate equals
+the mean local gradient ONLY for plain SGD — momentum or decoupled weight decay would
+make ``(x - y)/(K*eta)`` estimate a momentum-filtered direction and silently bias every
+future round's correction — and FedProx's proximal term is a different drift remedy
+whose gradient would leak into the control estimate; combining them is refused.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
+from nanofed_tpu.trainer.config import TrainingConfig
+from nanofed_tpu.trainer.local import GradFn, make_grad_fn
+from nanofed_tpu.utils.trees import tree_where, tree_zeros_like
+
+
+class ScaffoldFitResult(NamedTuple):
+    params: Params  # the client's final local params y
+    metrics: ClientMetrics  # final-epoch metrics (same reporting contract as local_fit)
+    delta_c: Params  # dc_i = c_i+ - c_i (zero when the client took no real step)
+    epoch_loss: jax.Array  # [E] per-epoch mean loss
+    epoch_accuracy: jax.Array  # [E] per-epoch accuracy
+
+
+def make_scaffold_local_fit(
+    apply_fn: Callable[..., jax.Array],
+    config: TrainingConfig,
+    grad_fn: GradFn | None = None,
+) -> Callable[..., ScaffoldFitResult]:
+    """Build the SCAFFOLD-corrected local fit for one client.
+
+    The returned ``fit(global_params, data, rng, c_global, c_client, lr_scale=None)``
+    is jit/vmap-compatible; the round step vmaps it with
+    ``in_axes=(None, 0, 0, None, 0)`` — controls are per-client, the server control is
+    replicated round state.  ``lr_scale`` scales the SGD step AND the control estimate's
+    effective ``eta`` consistently, so per-round lr schedules compose with SCAFFOLD
+    without re-tracing (same traced-scalar contract as ``make_local_fit``).
+    """
+    if config.momentum != 0.0 or config.weight_decay != 0.0:
+        raise ValueError(
+            "SCAFFOLD requires plain SGD locally: the option-II control update "
+            "(x - y)/(K*eta) equals the mean local gradient only without momentum/"
+            "weight decay — set TrainingConfig.momentum=0 and weight_decay=0"
+        )
+    if config.prox_mu != 0.0:
+        raise ValueError(
+            "prox_mu > 0 with SCAFFOLD would fold the proximal gradient into the "
+            "control estimate — choose ONE drift remedy (FedProx via prox_mu on the "
+            "standard path, or SCAFFOLD here)"
+        )
+    grad_fn = grad_fn or make_grad_fn(apply_fn, compute_dtype=config.compute_dtype)
+    bsz = config.batch_size
+    base_lr = config.learning_rate
+
+    # NOTE: the epoch/step scan below mirrors make_local_fit's loop (local.py) with
+    # the update rule swapped for corrected plain SGD + the effective-step counter.
+    # The batching/masking discipline (capacity check, permutation slicing, the
+    # pure-padding no-op rule, max_batches clamp) must stay identical in both —
+    # test_zero_controls_first_round_is_fedavg pins the two paths to the same float
+    # trajectory, so a divergence in the shared discipline fails loudly.
+    def scaffold_fit(
+        global_params: Params,
+        data: ClientData,
+        rng: PRNGKey,
+        c_global: Params,
+        c_client: Params,
+        lr_scale: jax.Array | None = None,
+    ) -> ScaffoldFitResult:
+        n = data.x.shape[0]
+        if n % bsz != 0:
+            raise ValueError(
+                f"data capacity {n} must be a multiple of batch_size {bsz} "
+                "(use data.batching.pack_clients with the same batch_size)"
+            )
+        steps = n // bsz
+        if config.max_batches is not None:
+            steps = min(steps, config.max_batches)
+
+        # c - c_i is constant over the whole local fit (controls update once per
+        # round); hoist it out of the step loop.
+        correction = jax.tree.map(lax.sub, c_global, c_client)
+        scale = 1.0 if lr_scale is None else lr_scale
+        eta = base_lr * scale
+
+        def epoch_body(carry, ekey):
+            params, taken = carry
+            perm_key, step_key = jax.random.split(ekey)
+            perm = jax.random.permutation(perm_key, n)
+
+            def step_body(carry, inp):
+                params, taken = carry
+                sidx, skey = inp
+                idx = lax.dynamic_slice(perm, (sidx * bsz,), (bsz,))
+                xb, yb, mb = data.x[idx], data.y[idx], data.mask[idx]
+                grads, stats = grad_fn(params, xb, yb, mb, skey)
+                corrected = jax.tree.map(jnp.add, grads, correction)
+                new_params = jax.tree.map(
+                    lambda p, g: p - (eta * g).astype(p.dtype), params, corrected
+                )
+                # A batch of pure padding is a no-op and does NOT count toward K:
+                # the control estimate divides by the number of REAL steps.
+                nonempty = stats.count > 0
+                params = tree_where(nonempty, new_params, params)
+                taken = taken + nonempty.astype(jnp.float32)
+                return (params, taken), stats
+
+            step_keys = jax.random.split(step_key, steps)
+            (params, taken), stats = lax.scan(
+                step_body, (params, taken), (jnp.arange(steps), step_keys)
+            )
+            count = jnp.maximum(stats.count.sum(), 1.0)
+            e_loss = stats.loss_sum.sum() / count
+            e_acc = stats.correct.sum() / count
+            return (params, taken), (e_loss, e_acc)
+
+        epoch_keys = jax.random.split(rng, config.local_epochs)
+        # The step counter's zero is derived from the data so it carries the same
+        # varying-axes type as the per-step increments under shard_map (a literal
+        # jnp.float32(0.0) is "unvarying" there and fails the scan carry check).
+        taken0 = data.mask.sum().astype(jnp.float32) * 0.0
+        (params, taken), (e_loss, e_acc) = lax.scan(
+            epoch_body, (global_params, taken0), epoch_keys
+        )
+
+        # Option II: c_i+ = c_i - c + (x - y)/(K*eta)  =>  dc_i = -c + (x - y)/(K*eta).
+        # A client that never took a real step (all-padding cohort slot) has y == x and
+        # K == 0; its control must not move.
+        k_eta = jnp.maximum(taken, 1.0) * eta
+        took_any = taken > 0
+        delta_c = jax.tree.map(
+            lambda cg, x, y: jnp.where(
+                took_any, -cg + (x - y).astype(jnp.float32) / k_eta, 0.0
+            ).astype(cg.dtype),
+            c_global, global_params, params,
+        )
+        metrics = ClientMetrics(
+            loss=e_loss[-1], accuracy=e_acc[-1], samples=data.mask.sum()
+        )
+        return ScaffoldFitResult(
+            params=params,
+            metrics=metrics,
+            delta_c=delta_c,
+            epoch_loss=e_loss,
+            epoch_accuracy=e_acc,
+        )
+
+    scaffold_fit.supports_lr_scale = True
+    return scaffold_fit
+
+
+def zero_controls(params: Params) -> Params:
+    """Fresh server/client control state: all zeros (the paper's initialization —
+    round 1 with zero controls is exactly uniform FedAvg)."""
+    return tree_zeros_like(params)
+
+
+def stack_zero_controls(params: Params, num_clients: int) -> Params:
+    """The population's client controls as one stacked ``[C, ...]`` pytree, ready to
+    shard over the client mesh axis."""
+    return jax.tree.map(
+        lambda p: jnp.zeros((num_clients, *p.shape), p.dtype), params
+    )
+
+
+__all__ = [
+    "ScaffoldFitResult",
+    "make_scaffold_local_fit",
+    "stack_zero_controls",
+    "zero_controls",
+]

@@ -65,6 +65,10 @@ def main() -> int:
                     help="seconds between probes (default 600 = 10 min)")
     ap.add_argument("--max-hours", type=float, default=12.0,
                     help="give up after this many hours of failed probes")
+    ap.add_argument("--stages", default=None,
+                    help="comma list forwarded to tpu_campaign.py --stages — re-arm "
+                    "the watcher for just the stages a flaky tunnel killed, without "
+                    "re-burning budget on artifacts already captured")
     args = ap.parse_args()
 
     log_path = REPO / "runs" / f"tpu_campaign_{args.tag}.log"
@@ -81,7 +85,13 @@ def main() -> int:
     deferred = 0
     log(f"armed — probing every {args.interval:.0f}s for up to "
         f"{args.max_hours:.1f}h; on first success: tpu_campaign.py --tag {args.tag}")
-    while time.time() < deadline:
+    # At least one cycle always runs: "give up after N hours" must never mean
+    # "gave up without testing the tunnel at all", however small the window (and
+    # however slow the host — the arming log line above can outlast a sub-second
+    # window on a loaded core, which made zero-probe exits a real flake).
+    first_cycle = True
+    while first_cycle or time.time() < deadline:
+        first_cycle = False
         if measurement_running():
             deferred += 1
             log("measurement in progress on this core — deferring the probe")
@@ -103,9 +113,11 @@ def main() -> int:
         if ok:
             log("chip answered — firing the campaign (probe already passed, skipping "
                 "its probe stage)")
-            rc = subprocess.call(
-                [PY, str(REPO / "scripts" / "tpu_campaign.py"),
-                 "--tag", args.tag, "--skip-probe"])
+            argv = [PY, str(REPO / "scripts" / "tpu_campaign.py"),
+                    "--tag", args.tag, "--skip-probe"]
+            if args.stages:
+                argv += ["--stages", args.stages]
+            rc = subprocess.call(argv)
             log(f"campaign finished rc={rc}")
             return rc
         time.sleep(max(0.0, args.interval - (time.time() - t0)))
