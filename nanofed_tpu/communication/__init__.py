@@ -5,7 +5,14 @@ simulator never imports this package; it exists for true cross-device runs.  Req
 ``[net]`` extra (aiohttp); the codec itself is dependency-free.
 """
 
-from nanofed_tpu.communication.codec import decode_params, encode_params
+from nanofed_tpu.communication.codec import (
+    ENCODING_Q8_DELTA,
+    decode_delta_q8,
+    decode_params,
+    encode_delta_q8,
+    encode_params,
+    reconstruct_q8,
+)
 
 _NET_EXPORTS = {
     "HTTPServer": "http_server",
@@ -30,10 +37,14 @@ def __getattr__(name: str):
 
 __all__ = [
     "ClientEndpoints",
+    "ENCODING_Q8_DELTA",
     "HTTPClient",
     "HTTPServer",
     "NetworkCoordinator",
     "NetworkRoundConfig",
+    "decode_delta_q8",
+    "encode_delta_q8",
+    "reconstruct_q8",
     "SecAggRoster",
     "ServerEndpoints",
     "decode_params",

@@ -13,10 +13,18 @@ from dataclasses import dataclass
 from typing import Any
 
 import aiohttp
+import jax
 
-from nanofed_tpu.communication.codec import decode_params, encode_params
+from nanofed_tpu.communication.codec import (
+    ENCODING_Q8_DELTA,
+    decode_params,
+    encode_delta_q8,
+    encode_params,
+    reconstruct_q8,
+)
 from nanofed_tpu.communication.http_server import (
     HEADER_CLIENT,
+    HEADER_ENCODING,
     HEADER_METRICS,
     HEADER_ROUND,
     HEADER_SECAGG,
@@ -85,19 +93,33 @@ class HTTPClient:
         endpoints: ClientEndpoints | None = None,
         timeout_s: float = 300.0,
         security_manager: Any | None = None,
+        update_encoding: str = "npz",
     ) -> None:
         """``security_manager`` (a ``nanofed_tpu.security.SecurityManager``) makes every
         submitted update carry an RSA-PSS signature header; pair it with a server
-        configured with ``require_signatures=True`` and this client's public key."""
+        configured with ``require_signatures=True`` and this client's public key.
+
+        ``update_encoding="q8-delta"`` ships each update as its stochastically-rounded
+        int8 round DELTA instead of full float params — ~4x fewer bytes on the
+        client->server wire (see ``codec.encode_delta_q8``).  Requires fetching the
+        global model through THIS client each round (the delta's base); signatures are
+        computed over the server's exact reconstruction, so signing composes."""
+        if update_encoding not in ("npz", ENCODING_Q8_DELTA):
+            raise NanoFedError(
+                f"unknown update_encoding {update_encoding!r} "
+                f"(choose 'npz' or '{ENCODING_Q8_DELTA}')"
+            )
         self.server_url = server_url.rstrip("/")
         self.client_id = client_id
         self.endpoints = endpoints or ClientEndpoints()
         self.security_manager = security_manager
+        self.update_encoding = update_encoding
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self._session: aiohttp.ClientSession | None = None
         self._log = Logger()
         self.current_round = 0
         self._secagg_session = ""  # cohort session nonce, cached from the roster
+        self._last_global: Params | None = None  # q8-delta base, set by fetch
 
     @property
     def secagg_session(self) -> str:
@@ -137,11 +159,21 @@ class HTTPClient:
             if resp.headers.get(HEADER_STATUS) == "terminated":
                 return None, round_number, False
             payload = await resp.read()
-        return decode_params(payload, like=like), round_number, True
+        params = decode_params(payload, like=like)
+        if self.update_encoding == ENCODING_Q8_DELTA:
+            # Pin the delta base.  Not kept for plain npz — it would hold a full
+            # extra model copy per client process for nothing.
+            self._last_global = params
+        return params, round_number, True
 
     async def submit_update(self, params: Params, metrics: dict[str, Any]) -> bool:
         """POST local training results for the current round (parity:
-        ``client.py:158-211``)."""
+        ``client.py:158-211``).
+
+        Under ``update_encoding="q8-delta"`` the body is the quantized round delta and
+        the signature covers the server's exact reconstruction (base + dequantized
+        delta — recomputed locally with the same numpy float32 arithmetic), so a
+        verifying server accepts precisely what it will aggregate."""
         session = self._require_session()
         url = self.server_url + self.endpoints.update
         headers = {
@@ -149,6 +181,27 @@ class HTTPClient:
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
         }
+        if self.update_encoding == ENCODING_Q8_DELTA:
+            import numpy as np
+
+            if self._last_global is None:
+                raise NanoFedError(
+                    "q8-delta encoding needs the round's global model as its base — "
+                    "call fetch_global_model on this client before submit_update"
+                )
+            delta = jax.tree.map(
+                lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
+                params, self._last_global,
+            )
+            body = encode_delta_q8(delta)
+            # What the SERVER will reconstruct (dequantization is lossy; sign that,
+            # not the local pre-quantization params) — via the SHARED helper, so
+            # client and server arithmetic cannot drift apart.
+            signed_params = reconstruct_q8(self._last_global, body)
+            headers[HEADER_ENCODING] = ENCODING_Q8_DELTA
+        else:
+            body = encode_params(params)
+            signed_params = params
         if self.security_manager is not None:
             import base64
 
@@ -156,10 +209,11 @@ class HTTPClient:
             # the params, so a captured update cannot be replayed into a later round or
             # have its metrics (aggregation weight) rewritten.
             signature = self.security_manager.sign_update(
-                params, self.client_id, self.current_round, headers[HEADER_METRICS]
+                signed_params, self.client_id, self.current_round,
+                headers[HEADER_METRICS],
             )
             headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
-        async with session.post(url, data=encode_params(params), headers=headers) as resp:
+        async with session.post(url, data=body, headers=headers) as resp:
             if resp.status != 200:
                 # Framework error pages (413 too-large, 500) are text, not JSON.
                 try:

@@ -26,7 +26,11 @@ from typing import Any, Iterable
 import jax
 from aiohttp import web
 
-from nanofed_tpu.communication.codec import decode_params, encode_params
+from nanofed_tpu.communication.codec import (
+    ENCODING_Q8_DELTA,
+    decode_params,
+    encode_params,
+)
 from nanofed_tpu.core.types import ModelUpdate, Params
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
@@ -40,6 +44,7 @@ HEADER_METRICS = "X-NanoFed-Metrics"
 HEADER_STATUS = "X-NanoFed-Status"
 HEADER_SIGNATURE = "X-NanoFed-Signature"  # base64 RSA-PSS signature of the npz params
 HEADER_SECAGG = "X-NanoFed-SecAgg"  # "masked" flags a pairwise-masked uint32 payload
+HEADER_ENCODING = "X-NanoFed-Encoding"  # absent/"npz" = full params; "q8-delta" = codec
 
 
 @dataclass(frozen=True)
@@ -411,13 +416,37 @@ class HTTPServer:
                 },
                 status=400,
             )
+        encoding = request.headers.get(HEADER_ENCODING, "npz")
         if request.headers.get(HEADER_SECAGG) == "masked":
+            if encoding != "npz":
+                # Masked payloads are uint32 fixed-point with their own codec; a
+                # client that ALSO asks for q8-delta is misconfigured — refuse
+                # rather than silently interpret the body one way or the other.
+                return web.json_response(
+                    {"status": "error",
+                     "message": f"encoding {encoding!r} cannot combine with "
+                                "SecAgg masked payloads"},
+                    status=400,
+                )
             return await self._handle_masked_update(request, client_id, round_number, metrics)
         body = await request.read()
+        if encoding not in ("npz", ENCODING_Q8_DELTA):
+            return web.json_response(
+                {"status": "error", "message": f"unknown encoding {encoding!r}"},
+                status=400,
+            )
         try:
             # Offload the CPU-bound decode (up to 100 MB decompress + structure checks)
             # so concurrent /model and /status requests aren't stalled behind it.
-            params = await asyncio.to_thread(decode_params, body, like=self._params)
+            if encoding == ENCODING_Q8_DELTA:
+                # Quantized round delta: reconstruct base + dequantized delta in
+                # numpy float32 — bit-identical to the client's signing-side
+                # reconstruction, so signature verification composes.
+                params = await asyncio.to_thread(
+                    self._reconstruct_q8_update, body
+                )
+            else:
+                params = await asyncio.to_thread(decode_params, body, like=self._params)
         except Exception as e:
             return web.json_response(
                 {"status": "error", "message": f"bad payload: {e}"}, status=400
@@ -453,6 +482,16 @@ class HTTPServer:
         return web.json_response(
             {"status": "success", "message": "update accepted", "update_id": client_id}
         )
+
+    def _reconstruct_q8_update(self, body: bytes) -> Params:
+        """q8-delta body -> full params via the SHARED codec helper (the client signs
+        this exact arithmetic).  self._params is read without the round lock (decode
+        runs in a worker thread), but the stale-round pre-check plus the
+        authoritative locked check after reconstruction reject any update whose base
+        rotated mid-decode."""
+        from nanofed_tpu.communication.codec import reconstruct_q8
+
+        return reconstruct_q8(self._params, body)
 
     def _verify_update_signature(
         self, client_id: str, round_number: int, request: web.Request, params: Params

@@ -85,10 +85,11 @@ def main() -> int:
     deferred = 0
     log(f"armed — probing every {args.interval:.0f}s for up to "
         f"{args.max_hours:.1f}h; on first success: tpu_campaign.py --tag {args.tag}")
-    # At least one cycle always runs: "give up after N hours" must never mean
-    # "gave up without testing the tunnel at all", however small the window (and
-    # however slow the host — the arming log line above can outlast a sub-second
-    # window on a loaded core, which made zero-probe exits a real flake).
+    # At least one CYCLE always runs, however small the window (the arming log line
+    # above can outlast a sub-second window on a loaded core, which made zero-cycle
+    # exits a real flake).  A cycle that finds a measurement on the core still
+    # defers — probing mid-measurement is the greater evil — and the zero-probe
+    # exit path below says so honestly.
     first_cycle = True
     while first_cycle or time.time() < deadline:
         first_cycle = False
