@@ -6,6 +6,7 @@ from nanofed_tpu.aggregation.base import (
     AggregationResult,
     Strategy,
     fedadam_strategy,
+    fedyogi_strategy,
     fedavg_strategy,
     fedavgm_strategy,
     validate_updates,
@@ -20,6 +21,7 @@ from nanofed_tpu.aggregation.fedavg import (
 from nanofed_tpu.aggregation.robust import (
     RobustAggregationConfig,
     coordinate_median,
+    multi_krum,
     robust_aggregate,
     robust_floor,
     trimmed_mean,
@@ -39,6 +41,7 @@ __all__ = [
     "coordinate_median",
     "robust_aggregate",
     "robust_floor",
+    "multi_krum",
     "trimmed_mean",
     "PrivacyAwareAggregationConfig",
     "Strategy",
@@ -50,6 +53,7 @@ __all__ = [
     "aggregate_metrics",
     "compute_weights",
     "fedadam_strategy",
+    "fedyogi_strategy",
     "fedavg_strategy",
     "fedavgm_strategy",
     "fedavg_combine",

@@ -71,6 +71,19 @@ def fedadam_strategy(
     return Strategy(name="fedadam", server_tx=optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
 
 
+def fedyogi_strategy(
+    learning_rate: float | optax.Schedule = 1e-2,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-3,
+) -> Strategy:
+    """FedYogi (Reddi et al. 2021) — completes the paper's adaptive-server family
+    (FedAdagrad ~ Adam at b1=0, FedAdam, FedYogi).  Yogi's additive second-moment
+    update reacts to sign changes instead of magnitudes, which the paper found more
+    stable than Adam when client deltas are heavy-tailed under non-IID sampling."""
+    return Strategy(name="fedyogi", server_tx=optax.yogi(learning_rate, b1=b1, b2=b2, eps=eps))
+
+
 def validate_updates(updates: ClientUpdates, global_params: Params) -> None:
     """Structural validation before aggregation.
 

@@ -296,9 +296,11 @@ def main(argv: list[str] | None = None) -> int:
         "the kept ranks; incompatible with --dp-epsilon)",
     )
     run.add_argument(
-        "--robust-method", default=None, choices=["trimmed_mean", "median"],
-        help="robust estimator: trimmed_mean (default when --robust-trim is set) "
-        "or median (knob-free, tolerates any Byzantine minority); incompatible "
+        "--robust-method", default=None,
+        choices=["trimmed_mean", "median", "multi_krum"],
+        help="robust estimator: trimmed_mean (default when --robust-trim is set), "
+        "median (knob-free, tolerates any Byzantine minority), or multi_krum "
+        "(whole-update selection, --robust-trim acts as f); incompatible "
         "with --dp-epsilon",
     )
     run.add_argument(

@@ -141,19 +141,53 @@ The *server* optimizer needs no machinery at all — its optax state persists ac
 rounds, so `fedadam_strategy(learning_rate=optax.cosine_decay_schedule(...))` steps
 per round natively.""",
     # 13
+    """## 12. SCAFFOLD: correct the drift instead of damping it
+
+Under non-IID data, FedAvg's local steps follow each client's own gradient field and
+drift toward local optima; FedProx pulls iterates back with a proximal term.
+**SCAFFOLD** (Karimireddy et al. 2020) removes the drift at its source: every local
+step is corrected by (server control − client control), so in expectation each client
+walks the *global* descent direction even on a one-class shard. The population's
+client controls live as ONE stacked pytree sharded over the `clients` mesh axis —
+under partial participation the cohort's control rows are gathered alongside its data
+rows and the round's deltas scatter-added back.
+
+Partial participation is exactly where it shines (each round's cohort is a biased
+sample; the stored controls carry the absent clients' directions into the round), and
+the correction is one round stale — it wants a *smaller* local lr than FedAvg's tuned
+value (the paper's η_l = O(1/K) bound; `runs/scaffold_r05.json` records a diverged
+lr=0.5 arm alongside the win).""",
+    # 14
+    """## 13. q8-delta wire compression
+
+In a real cross-device federation the client→server update is the bandwidth bill.
+`HTTPClient(update_encoding="q8-delta")` ships each round's **delta** stochastically
+rounded to int8 with per-leaf absmax scales: unbiased (FedAvg's mean averages the
+rounding noise away), **5.25×** fewer bytes than the already-binary npz format — 32×
+fewer than the reference's JSON float lists — and signatures still verify, because
+the client signs the server's exact float32 reconstruction. Measured end-to-end:
+identical final accuracy after 15 fully-quantized rounds
+(`runs/wire_compression_r05.json`). Below, the codec itself on a real trained
+delta.""",
+    # 15
     """## Where to go next
 
 - **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
   (`nanofed-tpu bench mnist_1000`); `compute_dtype="bfloat16"` engages the MXU.
-  Measured on ONE real v5e chip: 0.75 s for a 1000-client round (`runs/bench_tpu_r03.json`).
+  Measured on ONE real v5e chip: **0.74 s** for a 1000-client round of the current
+  code — 271× the reference-extrapolated CPU baseline (`runs/bench_tpu_r05.json`).
 - **Real networks**: `nanofed_tpu.communication` has a binary-payload HTTP server/client
-  with RSA-PSS-signed updates; `examples/secure_federation/run_secure.py` is the full
-  secure-aggregation protocol as a runnable script (`--dropout-tolerant --drop-client 2`
-  demos multi-round recovery + eviction), and `nanofed-tpu serve --secure
-  --dropout-tolerant` hosts it from the CLI.
+  with RSA-PSS-signed updates and optional q8-delta compression;
+  `examples/secure_federation/run_secure.py` is the full secure-aggregation protocol as
+  a runnable script (`--dropout-tolerant --drop-client 2` demos multi-round recovery +
+  eviction), and `nanofed-tpu serve --secure --dropout-tolerant` hosts it from the CLI.
+- **Robustness**: `--robust-trim K` (or `method="median"`) bounds Byzantine clients
+  structurally — measured holding 97.5% while plain FedAvg collapses to 7.8% under
+  2 poisoned clients (`runs/byzantine_r05.json`).
 - **Profiling**: `nanofed_tpu.utils.profiling.trace` captures TensorBoard/Perfetto
   device traces of a round.
 - **Benchmarks**: `nanofed-tpu bench --list`; accuracy evidence in
+  `runs/accuracy_digits_100c_r05.json` (the 97% bar met at 100 clients) and
   `runs/accuracy_digits_cnn28_r03.json` (the flagship CNN at 97.2% on real images).""",
 ]
 
@@ -413,6 +447,45 @@ for m in sched_coord.start_training():
           + (f"  test acc {acc:.4f}" if acc is not None else ""))
 assert scales[0] == 1.0 and all(a >= b for a, b in zip(scales, scales[1:]))
 assert scales[-1] > 0.2  # decayed toward — but never ONTO — the floor""",
+    # M (after MD 13): SCAFFOLD vs FedAvg under drift + partial participation
+    """drift_data = federate(train, num_clients=16, scheme="dirichlet",
+                      batch_size=16, seed=1, alpha=0.05)  # ~1-2 classes per client
+
+finals = {}
+for name, scaffold in (("fedavg", False), ("scaffold", True)):
+    c = Coordinator(
+        model=model, train_data=drift_data,
+        config=CoordinatorConfig(num_rounds=12, seed=0, participation_rate=0.5,
+                                 base_dir="runs/nb_scaffold", save_metrics=False),
+        training=TrainingConfig(batch_size=16, local_epochs=16, learning_rate=0.2),
+        eval_data=pack_eval(test, batch_size=128),
+        scaffold=scaffold,
+    )
+    c.run()
+    finals[name] = c.evaluate()["accuracy"]
+    print(f"{name:9s} final held-out accuracy: {finals[name]:.4f}")
+print(f"drift correction buys {finals['scaffold'] - finals['fedavg']:+.4f}")""",
+    # N (after MD 14): q8-delta codec on a real trained delta
+    """import numpy as np
+from nanofed_tpu.communication import (decode_delta_q8, encode_delta_q8,
+                                       encode_params)
+from nanofed_tpu.trainer import make_local_fit
+
+fit = make_local_fit(model.apply, TrainingConfig(batch_size=16, local_epochs=2,
+                                                 learning_rate=0.2))
+one = jax.tree.map(lambda a: jax.numpy.asarray(a[0]), client_data)
+res = fit(params, one, jax.random.key(3))
+delta = jax.tree.map(lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
+                     res.params, params)
+
+wire_q8 = encode_delta_q8(delta, seed=0)
+wire_npz = encode_params(res.params)
+dq = decode_delta_q8(wire_q8, like=delta)
+err = max(float(np.abs(a - b).max())
+          for a, b in zip(jax.tree.leaves(dq), jax.tree.leaves(delta)))
+print(f"npz full params: {len(wire_npz):7d} bytes")
+print(f"q8 delta:        {len(wire_q8):7d} bytes  ({len(wire_npz)/len(wire_q8):.2f}x smaller)")
+print(f"max dequantization error: {err:.2e} (bounded by absmax/127 per leaf)")""",
 ]
 
 

@@ -12,6 +12,7 @@ from nanofed_tpu.aggregation import (
     compute_weights,
     fedadam_strategy,
     fedavg_combine,
+    fedyogi_strategy,
     fedavgm_strategy,
     fedavg_strategy,
     psum_weighted_mean,
@@ -119,6 +120,27 @@ def test_strategies_construct():
     assert fedavg_strategy().name == "fedavg"
     assert fedavgm_strategy().name == "fedavgm"
     assert fedadam_strategy().name == "fedadam"
+    assert fedyogi_strategy().name == "fedyogi"
+
+
+def test_fedyogi_round_applies_adaptive_delta():
+    """FedYogi's server transform must consume the aggregated delta like the other
+    adaptive strategies: first round's update magnitude ~ lr (Adam-family invariant
+    |update| <= lr * (1+eps') at step 0), direction matching the delta's sign."""
+    strat = fedyogi_strategy(learning_rate=0.1)
+    params = {"w": jnp.zeros(3)}
+    sos = strat.server_tx.init(params)
+    agg_delta = {"w": jnp.asarray([0.5, -0.25, 0.0])}
+    neg = jax.tree.map(jnp.negative, agg_delta)
+    updates, _ = strat.server_tx.update(neg, sos, params)
+    import optax
+
+    new = optax.apply_updates(params, updates)
+    w = np.asarray(new["w"])
+    # The zero-delta coordinate moves only by yogi's initial-accumulator epsilon
+    # artifact — negligible against lr, but not exactly zero like plain Adam.
+    assert w[0] > 0 and w[1] < 0 and abs(w[2]) < 1e-3 * 0.1
+    assert np.all(np.abs(w) <= 0.1 * 1.01)
 
 
 def test_server_lr_schedule_steps_per_round():
