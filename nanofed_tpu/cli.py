@@ -57,9 +57,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # Same up-front courtesy as above: the Coordinator refuses these too, with
         # a traceback (the control estimate is computed from the un-noised,
         # un-trimmed local trajectory).
-        print("error: --scaffold cannot be combined with --dp-epsilon or "
-              "--robust-trim — DP noise / robust trimming would bias the control "
-              "estimate every later round relies on", file=sys.stderr)
+        print("error: --scaffold cannot be combined with --dp-epsilon, "
+              "--robust-trim, or --robust-method — DP noise / robust "
+              "trimming/selection would bias the control estimate every later "
+              "round relies on", file=sys.stderr)
         return 2
 
     central_privacy = None

@@ -7,11 +7,15 @@ simulator never imports this package; it exists for true cross-device runs.  Req
 
 from nanofed_tpu.communication.codec import (
     ENCODING_Q8_DELTA,
+    ENCODING_TOPK8,
     decode_delta_q8,
+    decode_delta_topk8,
     decode_params,
     encode_delta_q8,
+    encode_delta_topk8,
     encode_params,
     reconstruct_q8,
+    reconstruct_topk8,
 )
 
 _NET_EXPORTS = {
@@ -38,13 +42,17 @@ def __getattr__(name: str):
 __all__ = [
     "ClientEndpoints",
     "ENCODING_Q8_DELTA",
+    "ENCODING_TOPK8",
     "HTTPClient",
     "HTTPServer",
     "NetworkCoordinator",
     "NetworkRoundConfig",
     "decode_delta_q8",
+    "decode_delta_topk8",
     "encode_delta_q8",
+    "encode_delta_topk8",
     "reconstruct_q8",
+    "reconstruct_topk8",
     "SecAggRoster",
     "ServerEndpoints",
     "decode_params",

@@ -17,8 +17,11 @@ import jax
 
 from nanofed_tpu.communication.codec import (
     ENCODING_Q8_DELTA,
+    ENCODING_TOPK8,
+    decode_delta_topk8,
     decode_params,
     encode_delta_q8,
+    encode_delta_topk8,
     encode_params,
     reconstruct_q8,
 )
@@ -94,6 +97,7 @@ class HTTPClient:
         timeout_s: float = 300.0,
         security_manager: Any | None = None,
         update_encoding: str = "npz",
+        topk_fraction: float = 0.05,
     ) -> None:
         """``security_manager`` (a ``nanofed_tpu.security.SecurityManager``) makes every
         submitted update carry an RSA-PSS signature header; pair it with a server
@@ -101,25 +105,34 @@ class HTTPClient:
 
         ``update_encoding="q8-delta"`` ships each update as its stochastically-rounded
         int8 round DELTA instead of full float params — ~4x fewer bytes on the
-        client->server wire (see ``codec.encode_delta_q8``).  Requires fetching the
-        global model through THIS client each round (the delta's base); signatures are
-        computed over the server's exact reconstruction, so signing composes."""
-        if update_encoding not in ("npz", ENCODING_Q8_DELTA):
+        client->server wire (see ``codec.encode_delta_q8``).
+        ``update_encoding="topk8-delta"`` additionally keeps only the top
+        ``topk_fraction`` of each leaf's coordinates by magnitude, with ERROR
+        FEEDBACK: the un-sent tail accumulates in this client and rides the next
+        round's delta, so the bias of top-k selection cancels over rounds
+        (Seide et al. 2014).  Both require fetching the global model through THIS
+        client each round (the delta's base); signatures are computed over the
+        server's exact reconstruction, so signing composes."""
+        if update_encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
             raise NanoFedError(
-                f"unknown update_encoding {update_encoding!r} "
-                f"(choose 'npz' or '{ENCODING_Q8_DELTA}')"
+                f"unknown update_encoding {update_encoding!r} (choose 'npz', "
+                f"'{ENCODING_Q8_DELTA}', or '{ENCODING_TOPK8}')"
             )
+        if not 0.0 < topk_fraction <= 1.0:
+            raise NanoFedError("topk_fraction must be in (0, 1]")
         self.server_url = server_url.rstrip("/")
         self.client_id = client_id
         self.endpoints = endpoints or ClientEndpoints()
         self.security_manager = security_manager
         self.update_encoding = update_encoding
+        self.topk_fraction = topk_fraction
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self._session: aiohttp.ClientSession | None = None
         self._log = Logger()
         self.current_round = 0
         self._secagg_session = ""  # cohort session nonce, cached from the roster
-        self._last_global: Params | None = None  # q8-delta base, set by fetch
+        self._last_global: Params | None = None  # compressed-delta base, set by fetch
+        self._residual: Params | None = None  # topk8 error-feedback accumulator
 
     @property
     def secagg_session(self) -> str:
@@ -160,7 +173,7 @@ class HTTPClient:
                 return None, round_number, False
             payload = await resp.read()
         params = decode_params(payload, like=like)
-        if self.update_encoding == ENCODING_Q8_DELTA:
+        if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
             # Pin the delta base.  Not kept for plain npz — it would hold a full
             # extra model copy per client process for nothing.
             self._last_global = params
@@ -181,24 +194,49 @@ class HTTPClient:
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
         }
-        if self.update_encoding == ENCODING_Q8_DELTA:
+        staged_residual: Params | None = None
+        if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
             import numpy as np
 
             if self._last_global is None:
                 raise NanoFedError(
-                    "q8-delta encoding needs the round's global model as its base — "
-                    "call fetch_global_model on this client before submit_update"
+                    f"{self.update_encoding} encoding needs the round's global model "
+                    "as its base — call fetch_global_model on this client before "
+                    "submit_update"
                 )
             delta = jax.tree.map(
                 lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
                 params, self._last_global,
             )
-            body = encode_delta_q8(delta)
-            # What the SERVER will reconstruct (dequantization is lossy; sign that,
-            # not the local pre-quantization params) — via the SHARED helper, so
-            # client and server arithmetic cannot drift apart.
-            signed_params = reconstruct_q8(self._last_global, body)
-            headers[HEADER_ENCODING] = ENCODING_Q8_DELTA
+            if self.update_encoding == ENCODING_TOPK8:
+                # Error feedback: last round's un-sent tail rides this delta, and
+                # this round's un-sent tail (selection AND quantization error) is
+                # kept for the next — the top-k bias cancels over rounds.
+                if self._residual is not None:
+                    delta = jax.tree.map(np.add, delta, self._residual)
+                body = encode_delta_topk8(delta, self.topk_fraction)
+                sent = decode_delta_topk8(body, like=self._last_global)
+                # STAGED, not committed: the sent mass only leaves the residual
+                # once the server ACCEPTS (a rejected submit must keep the whole
+                # delta accumulated or that mass is lost from both sides forever).
+                staged_residual = jax.tree.map(
+                    lambda d, s: d - np.asarray(s, np.float32), delta, sent
+                )
+                # Same float32 arithmetic as the server's reconstruct_topk8 —
+                # reusing the decode above instead of decoding the payload twice.
+                signed_params = jax.tree.map(
+                    lambda g, s: np.asarray(g, np.float32)
+                    + np.asarray(s, np.float32),
+                    self._last_global, sent,
+                )
+                headers[HEADER_ENCODING] = ENCODING_TOPK8
+            else:
+                body = encode_delta_q8(delta)
+                # What the SERVER will reconstruct (dequantization is lossy; sign
+                # that, not the local pre-quantization params) — via the SHARED
+                # helper, so client and server arithmetic cannot drift apart.
+                signed_params = reconstruct_q8(self._last_global, body)
+                headers[HEADER_ENCODING] = ENCODING_Q8_DELTA
         else:
             body = encode_params(params)
             signed_params = params
@@ -221,7 +259,12 @@ class HTTPClient:
                 except Exception:
                     message = (await resp.text())[:200]
                 self._log.warning("update rejected (HTTP %d): %s", resp.status, message)
+                # A rejected topk8 submit commits NOTHING: the staged residual is
+                # dropped, so the full delta (this round's + all accumulated tail)
+                # stays in the accumulator for the retry / next round.
                 return False
+        if staged_residual is not None:
+            self._residual = staged_residual
         return True
 
     # ------------------------------------------------------------------

@@ -28,6 +28,7 @@ from aiohttp import web
 
 from nanofed_tpu.communication.codec import (
     ENCODING_Q8_DELTA,
+    ENCODING_TOPK8,
     decode_params,
     encode_params,
 )
@@ -430,7 +431,7 @@ class HTTPServer:
                 )
             return await self._handle_masked_update(request, client_id, round_number, metrics)
         body = await request.read()
-        if encoding not in ("npz", ENCODING_Q8_DELTA):
+        if encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
             return web.json_response(
                 {"status": "error", "message": f"unknown encoding {encoding!r}"},
                 status=400,
@@ -438,12 +439,12 @@ class HTTPServer:
         try:
             # Offload the CPU-bound decode (up to 100 MB decompress + structure checks)
             # so concurrent /model and /status requests aren't stalled behind it.
-            if encoding == ENCODING_Q8_DELTA:
-                # Quantized round delta: reconstruct base + dequantized delta in
+            if encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
+                # Compressed round delta: reconstruct base + dequantized delta in
                 # numpy float32 — bit-identical to the client's signing-side
                 # reconstruction, so signature verification composes.
                 params = await asyncio.to_thread(
-                    self._reconstruct_q8_update, body
+                    self._reconstruct_compressed_update, body, encoding
                 )
             else:
                 params = await asyncio.to_thread(decode_params, body, like=self._params)
@@ -483,14 +484,16 @@ class HTTPServer:
             {"status": "success", "message": "update accepted", "update_id": client_id}
         )
 
-    def _reconstruct_q8_update(self, body: bytes) -> Params:
-        """q8-delta body -> full params via the SHARED codec helper (the client signs
-        this exact arithmetic).  self._params is read without the round lock (decode
-        runs in a worker thread), but the stale-round pre-check plus the
+    def _reconstruct_compressed_update(self, body: bytes, encoding: str) -> Params:
+        """Compressed-delta body -> full params via the SHARED codec helpers (the
+        client signs this exact arithmetic).  self._params is read without the round
+        lock (decode runs in a worker thread), but the stale-round pre-check plus the
         authoritative locked check after reconstruction reject any update whose base
         rotated mid-decode."""
-        from nanofed_tpu.communication.codec import reconstruct_q8
+        from nanofed_tpu.communication.codec import reconstruct_q8, reconstruct_topk8
 
+        if encoding == ENCODING_TOPK8:
+            return reconstruct_topk8(self._params, body)
         return reconstruct_q8(self._params, body)
 
     def _verify_update_signature(

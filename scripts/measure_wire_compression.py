@@ -43,7 +43,9 @@ def main() -> int:
 
     from nanofed_tpu.communication.codec import (
         decode_delta_q8,
+        decode_delta_topk8,
         encode_delta_q8,
+        encode_delta_topk8,
         encode_params,
     )
     from nanofed_tpu.data import federate, load_digits_dataset, pack_eval
@@ -76,6 +78,10 @@ def main() -> int:
     npz_full = len(encode_params(result.params))
     q8 = encode_delta_q8(delta, seed=0)
     q8_bytes = len(q8)
+    topk8_bytes = {
+        f"fraction={f}": len(encode_delta_topk8(delta, fraction=f, seed=0))
+        for f in (0.05, 0.01)
+    }
     # The reference's actual wire format for the same params: JSON float lists.
     json_bytes = len(json.dumps(
         jax.tree.map(lambda a: np.asarray(a).tolist(), result.params)
@@ -104,10 +110,12 @@ def main() -> int:
         small.apply, TrainingConfig(batch_size=16, local_epochs=4, learning_rate=0.2)
     )
 
-    def run_rounds(quantize: bool, rounds: int = 15) -> float:
+    def run_rounds(mode: str, rounds: int = 15) -> float:
+        """mode: 'dense' | 'q8' | 'topk8' (top-5% with per-client error feedback)."""
         gp = small.init(jax.random.key(0))
         counts = np.asarray(cd.mask).sum(axis=1)
         w = counts / counts.sum()
+        residuals = [None] * 8
         for r in range(rounds):
             rngs = stack_rngs(jax.random.fold_in(jax.random.key(1), r), 8)
             agg = None
@@ -118,15 +126,26 @@ def main() -> int:
                     lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
                     res.params, gp,
                 )
-                if quantize:
+                if mode == "q8":
                     d = decode_delta_q8(encode_delta_q8(d, seed=r * 8 + i), like=d)
+                elif mode == "topk8":
+                    if residuals[i] is not None:
+                        d = jax.tree.map(np.add, d, residuals[i])
+                    sent = decode_delta_topk8(
+                        encode_delta_topk8(d, fraction=0.05, seed=r * 8 + i), like=d
+                    )
+                    residuals[i] = jax.tree.map(
+                        lambda a, b: a - np.asarray(b, np.float32), d, sent
+                    )
+                    d = jax.tree.map(lambda s: np.asarray(s, np.float32), sent)
                 contrib = jax.tree.map(lambda z, wi=w[i]: wi * z, d)
                 agg = contrib if agg is None else jax.tree.map(np.add, agg, contrib)
             gp = jax.tree.map(lambda g, a: np.asarray(g, np.float32) + a, gp, agg)
         return float(evaluator(jax.tree.map(jnp.asarray, gp), eval_data)["accuracy"])
 
-    acc_plain = run_rounds(False)
-    acc_q8 = run_rounds(True)
+    acc_plain = run_rounds("dense")
+    acc_q8 = run_rounds("q8")
+    acc_topk8 = run_rounds("topk8")
 
     artifact = {
         "artifact": f"wire_compression_{args.round_tag}",
@@ -137,9 +156,13 @@ def main() -> int:
             "reference_json_float_lists": json_bytes,
             "npz_full_params": npz_full,
             "q8_delta": q8_bytes,
+            "topk8_delta": topk8_bytes,
         },
         "compression_vs_npz": round(npz_full / q8_bytes, 2),
         "compression_vs_reference_json": round(json_bytes / q8_bytes, 2),
+        "topk8_compression_vs_npz": {
+            k: round(npz_full / v, 1) for k, v in topk8_bytes.items()
+        },
         "reconstruction": {
             "max_abs_error": float(flat_err.max()),
             "mean_abs_error": float(flat_err.mean()),
@@ -148,10 +171,13 @@ def main() -> int:
         },
         "accuracy_parity_federation": {
             "config": "digits_mlp(64), 8 clients Dirichlet(0.2), 4 local epochs, "
-                      "lr 0.2, 15 rounds, every client delta quantized each round",
+                      "lr 0.2, 15 rounds, every client delta compressed each round "
+                      "(topk8: fraction=0.05 with per-client error feedback)",
             "final_accuracy_uncompressed": round(acc_plain, 4),
             "final_accuracy_q8": round(acc_q8, 4),
-            "accuracy_delta": round(acc_q8 - acc_plain, 4),
+            "final_accuracy_topk8_ef": round(acc_topk8, 4),
+            "accuracy_delta_q8": round(acc_q8 - acc_plain, 4),
+            "accuracy_delta_topk8": round(acc_topk8 - acc_plain, 4),
         },
         "platform": str(jax.devices()[0].platform),
         "elapsed_s": round(time.time() - t0, 1),

@@ -20,7 +20,9 @@ from nanofed_tpu.communication import (
     HTTPClient,
     HTTPServer,
     decode_delta_q8,
+    decode_delta_topk8,
     encode_delta_q8,
+    encode_delta_topk8,
     encode_params,
 )
 from nanofed_tpu.core.exceptions import NanoFedError
@@ -113,6 +115,73 @@ def test_q8_refuses_wrong_template_and_mixed_payloads():
 
 
 # ---------------------------------------------------------------------------
+# topk8: sparsification + error feedback
+# ---------------------------------------------------------------------------
+
+
+def test_topk8_keeps_the_largest_coordinates_exactly():
+    """The selected coordinates round-trip within one quantization step; every
+    unselected coordinate decodes to exactly zero; selection is by magnitude."""
+    delta = {"w": np.asarray([0.5, -0.001, 0.0, 0.3, -0.7, 0.002], np.float32)}
+    out = decode_delta_topk8(encode_delta_topk8(delta, fraction=0.5, seed=0),
+                             like=delta)
+    w = out["w"]
+    scale = 0.7 / 127.0
+    for i in (0, 3, 4):  # the three largest magnitudes
+        assert abs(w[i] - delta["w"][i]) <= scale * (1 + 1e-6)
+    for i in (1, 2, 5):
+        assert w[i] == 0.0
+
+
+def test_topk8_payload_is_much_smaller():
+    big = {"w": np.random.default_rng(0).normal(0, 0.01, (512, 256)).astype(np.float32)}
+    sparse = encode_delta_topk8(big, fraction=0.05, seed=0)
+    # ~20x fewer coordinates; indices cost u32 each, so expect >6x vs full npz.
+    assert len(sparse) < len(encode_params(big)) / 6
+
+
+def test_topk8_refuses_out_of_range_indices_and_bad_fraction():
+    delta = {"w": np.zeros((8,), np.float32)}
+    payload = encode_delta_topk8({"w": np.ones((16,), np.float32)}, fraction=0.5)
+    with pytest.raises(NanoFedError, match="out of range"):
+        decode_delta_topk8(payload, like=delta)
+    with pytest.raises(NanoFedError, match="fraction"):
+        encode_delta_topk8(delta, fraction=0.0)
+
+
+def test_error_feedback_ships_every_coordinate_eventually():
+    """The point of the residual: a coordinate too small to make any single round's
+    top-k still reaches the server once its accumulated residual grows past the
+    per-round winners.  A coordinate with |x| ships roughly every
+    (sum|x| / k) / |x| rounds in steady state — the config below puts the small
+    coordinate's period at ~20 rounds, well inside the 40 simulated.  Without the
+    residual it would NEVER ship (it is never in any single round's top-k)."""
+    rng = np.random.default_rng(0)
+    true_delta = rng.uniform(0.5, 1.5, (64,)).astype(np.float32)
+    true_delta[7] = 0.2  # too small for any single round's top 25%
+    rounds, fraction = 40, 0.25
+    residual = np.zeros_like(true_delta)
+    total_received = np.zeros_like(true_delta)
+    no_ef_received = np.zeros_like(true_delta)
+    for r in range(rounds):
+        d = {"w": true_delta + residual}
+        sent = decode_delta_topk8(
+            encode_delta_topk8(d, fraction=fraction, seed=r), like=d
+        )["w"]
+        residual = d["w"] - sent
+        total_received += sent
+        no_ef_received += decode_delta_topk8(
+            encode_delta_topk8({"w": true_delta}, fraction=fraction, seed=r),
+            like=d,
+        )["w"]
+    assert no_ef_received[7] == 0.0  # never top-k on its own — the bias is real
+    assert total_received[7] > 0.0  # the residual pushed it through
+    # And the time-averaged view tracks the true delta (residuals are bounded by
+    # the steady-state shipping threshold, so the error shrinks like 1/rounds).
+    np.testing.assert_allclose(total_received / rounds, true_delta, atol=0.35)
+
+
+# ---------------------------------------------------------------------------
 # Wire
 # ---------------------------------------------------------------------------
 
@@ -191,6 +260,86 @@ def test_q8_composes_with_signature_enforcement():
                 await c.fetch_global_model(like=params)
                 assert await c.submit_update(trained, {"loss": 0.1})
             assert server.num_updates() == 1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_topk8_over_http_with_error_feedback_state():
+    """Two topk8 rounds through the real server: reconstruction lands only on the
+    shipped coordinates, and the client's residual carries between submits."""
+    model = get_model("linear", in_features=8, num_classes=4)
+    params = model.init(jax.random.key(0))
+    trained = jax.tree.map(lambda p: p + 0.01 * jnp.ones_like(p), params)
+    port = PORT + 3
+
+    async def main():
+        server = HTTPServer(port=port)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                                  update_encoding="topk8-delta",
+                                  topk_fraction=0.25) as c:
+                await c.fetch_global_model(like=params)
+                assert await c.submit_update(trained, {"loss": 0.1})
+                assert c._residual is not None
+                res1 = sum(float(np.abs(np.asarray(x)).sum())
+                           for x in jax.tree.leaves(c._residual))
+                assert res1 > 0  # 75% of coordinates went un-sent
+                (u1,) = await server.drain_updates()
+                # Round 1: same model resubmitted — the residual should push
+                # previously-dropped coordinates through.
+                await server.publish_model(params, round_number=1)
+                await c.fetch_global_model(like=params)
+                assert await c.submit_update(trained, {"loss": 0.1})
+                (u2,) = await server.drain_updates()
+                got1 = np.concatenate([np.asarray(x).ravel()
+                                       for x in jax.tree.leaves(u1.params)])
+                got2 = np.concatenate([np.asarray(x).ravel()
+                                       for x in jax.tree.leaves(u2.params)])
+                base = np.concatenate([np.asarray(x).ravel()
+                                       for x in jax.tree.leaves(params)])
+                want = np.concatenate([np.asarray(x).ravel()
+                                       for x in jax.tree.leaves(trained)])
+                # Cumulative view converges toward the true update direction.
+                err1 = np.abs((got1 - base) - (want - base)).sum()
+                err2 = np.abs(((got1 - base) + (got2 - base)) / 2
+                              - (want - base)).sum()
+                assert err2 < err1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_rejected_topk8_submit_preserves_the_residual():
+    """Error feedback must commit only on server ACCEPTANCE: a rejected submit
+    (stale round here) keeps the accumulator exactly as it was, so no shipped-but-
+    never-applied mass is lost from both sides."""
+    model = get_model("linear", in_features=8, num_classes=4)
+    params = model.init(jax.random.key(0))
+    trained = jax.tree.map(lambda p: p + 0.01 * jnp.ones_like(p), params)
+    port = PORT + 4
+
+    async def main():
+        server = HTTPServer(port=port)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                                  update_encoding="topk8-delta",
+                                  topk_fraction=0.25) as c:
+                await c.fetch_global_model(like=params)
+                assert await c.submit_update(trained, {"loss": 0.1})
+                committed = jax.tree.map(lambda x: np.array(x), c._residual)
+                # Stale round: server rejects, residual must NOT move.
+                c.current_round = 7
+                assert not await c.submit_update(trained, {"loss": 0.1})
+                for a, b in zip(jax.tree.leaves(committed),
+                                jax.tree.leaves(c._residual)):
+                    np.testing.assert_array_equal(a, np.asarray(b))
         finally:
             await server.stop()
 
