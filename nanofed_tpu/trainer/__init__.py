@@ -13,6 +13,10 @@ from nanofed_tpu.trainer.local import (
     make_optimizer,
     stack_rngs,
 )
+from nanofed_tpu.trainer.personalization import (
+    make_personalized_evaluator,
+    split_client_data,
+)
 from nanofed_tpu.trainer.scaffold import (
     ScaffoldFitResult,
     make_scaffold_local_fit,
@@ -43,9 +47,11 @@ __all__ = [
     "make_grad_fn",
     "make_local_fit",
     "make_optimizer",
+    "make_personalized_evaluator",
     "make_private_local_fit",
     "make_scaffold_local_fit",
     "record_local_fit",
+    "split_client_data",
     "stack_zero_controls",
     "zero_controls",
     "SCHEDULES",
