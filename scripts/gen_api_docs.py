@@ -29,11 +29,15 @@ MODULES = [
                 "nanofed_tpu.models.mnist", "nanofed_tpu.models.resnet",
                 "nanofed_tpu.nn"]),
     ("trainer", ["nanofed_tpu.trainer.config", "nanofed_tpu.trainer.local",
-                 "nanofed_tpu.trainer.private", "nanofed_tpu.trainer.callbacks",
-                 "nanofed_tpu.trainer.api"]),
+                 "nanofed_tpu.trainer.private", "nanofed_tpu.trainer.scaffold",
+                 "nanofed_tpu.trainer.schedules",
+                 "nanofed_tpu.trainer.personalization",
+                 "nanofed_tpu.trainer.callbacks", "nanofed_tpu.trainer.api"]),
     ("aggregation", ["nanofed_tpu.aggregation.base", "nanofed_tpu.aggregation.fedavg",
-                     "nanofed_tpu.aggregation.privacy"]),
-    ("parallel", ["nanofed_tpu.parallel.mesh", "nanofed_tpu.parallel.round_step"]),
+                     "nanofed_tpu.aggregation.privacy",
+                     "nanofed_tpu.aggregation.robust"]),
+    ("parallel", ["nanofed_tpu.parallel.mesh", "nanofed_tpu.parallel.round_step",
+                  "nanofed_tpu.parallel.scaffold_step"]),
     ("privacy", ["nanofed_tpu.privacy.config", "nanofed_tpu.privacy.noise",
                  "nanofed_tpu.privacy.accounting", "nanofed_tpu.privacy.mechanisms"]),
     ("security", ["nanofed_tpu.security.validation", "nanofed_tpu.security.signing",
