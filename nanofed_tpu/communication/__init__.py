@@ -25,6 +25,7 @@ _NET_EXPORTS = {
     "ClientEndpoints": "http_client",
     "NetworkCoordinator": "network_coordinator",
     "NetworkRoundConfig": "network_coordinator",
+    "fedbuff_combine": "network_coordinator",
     "stack_model_updates": "network_coordinator",
     "SecAggRoster": "http_client",
 }
@@ -51,6 +52,7 @@ __all__ = [
     "decode_delta_topk8",
     "encode_delta_q8",
     "encode_delta_topk8",
+    "fedbuff_combine",
     "reconstruct_q8",
     "reconstruct_topk8",
     "SecAggRoster",

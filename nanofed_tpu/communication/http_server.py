@@ -32,6 +32,7 @@ from nanofed_tpu.communication.codec import (
     decode_params,
     encode_params,
 )
+from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import ModelUpdate, Params
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
@@ -74,23 +75,38 @@ class HTTPServer:
         max_request_size: int = MAX_REQUEST_SIZE,
         client_keys: dict[str, bytes] | None = None,
         require_signatures: bool = False,
+        staleness_window: int = 0,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
         (``HEADER_SIGNATURE``) from a registered client or it is rejected with 403 —
         this is where the signing capability (``nanofed_tpu.security.signing``, parity
-        ``nanofed/server/validation.py:138-212``) is enforced on the wire."""
+        ``nanofed/server/validation.py:138-212``) is enforced on the wire.
+
+        ``staleness_window=0`` (default) is the strict synchronous protocol: an
+        update is accepted only for the CURRENT round.  ``staleness_window=W > 0``
+        enables asynchronous federation (FedBuff, Nguyen et al. 2022): updates based
+        on any of the last ``W`` published versions are accepted and buffered with
+        their base round, the buffer SURVIVES ``publish_model`` (a straggler's
+        update for version v stays valid while v >= current - W), and compressed
+        deltas reconstruct against the version the client actually fetched.  One
+        buffered update per client (latest wins — a fast client's newer update
+        supersedes its unaggregated older one)."""
+        if staleness_window < 0:
+            raise ValueError("staleness_window must be >= 0")
         self.host = host
         self.port = port
         self.endpoints = endpoints or ServerEndpoints()
         self.client_keys = dict(client_keys or {})
         self.require_signatures = require_signatures
+        self.staleness_window = staleness_window
         self._log = Logger()
         self._lock = asyncio.Lock()
         self._updates: dict[str, ModelUpdate] = {}
         self._params: Params | None = None
         self._params_bytes: bytes | None = None
         self._round = 0
+        self._version_params: dict[int, Params] = {}  # async mode: base history
         self._training_active = True
         # Secure-aggregation state: a roster of (X25519 public key, sample count) per
         # client, opened by the round engine, and a separate buffer for masked payloads
@@ -142,7 +158,16 @@ class HTTPServer:
             self._params = params
             self._params_bytes = payload
             self._round = round_number
-            self._updates.clear()
+            if self.staleness_window > 0:
+                # Async mode: keep the window of base versions for delta
+                # reconstruction, and keep buffered updates — a straggler's update
+                # for an older version stays aggregatable while it is in-window.
+                self._version_params[round_number] = params
+                floor = round_number - self.staleness_window
+                for old in [r for r in self._version_params if r < floor]:
+                    del self._version_params[old]
+            else:
+                self._updates.clear()
             # A straggler's masked vector from a FAILED secure round must never leak
             # into the next round: its masks are bound to the OLD round number and
             # would not cancel (unmask_sum would silently produce garbage).
@@ -167,6 +192,15 @@ class HTTPServer:
             updates = list(self._updates.values())
             self._updates.clear()
         return updates
+
+    async def take_updates(self, k: int) -> list[ModelUpdate]:
+        """Atomically take up to ``k`` buffered updates in arrival order, LEAVING the
+        rest buffered — the async engine aggregates exactly K per step (FedBuff), and
+        surplus arrivals must wait for the next aggregation, not inflate this one."""
+        async with self._lock:
+            keys = list(self._updates.keys())[:k]
+            taken = [self._updates.pop(key) for key in keys]
+        return taken
 
     def stop_training(self) -> None:
         """Signal clients to stop polling (parity: ``server.py:313-317``)."""
@@ -409,11 +443,11 @@ class HTTPServer:
             )
         # Cheap stale-round rejection BEFORE reading/decompressing up to 100 MB; the
         # authoritative check re-runs under the lock below.
-        if round_number != self._round:
+        if not self._round_acceptable(round_number):
             return web.json_response(
                 {
                     "status": "error",
-                    "message": f"update for round {round_number}, server is on {self._round}",
+                    "message": self._round_rejection_message(round_number),
                 },
                 status=400,
             )
@@ -444,7 +478,7 @@ class HTTPServer:
                 # numpy float32 — bit-identical to the client's signing-side
                 # reconstruction, so signature verification composes.
                 params = await asyncio.to_thread(
-                    self._reconstruct_compressed_update, body, encoding
+                    self._reconstruct_compressed_update, body, encoding, round_number
                 )
             else:
                 params = await asyncio.to_thread(decode_params, body, like=self._params)
@@ -459,14 +493,14 @@ class HTTPServer:
             if verdict is not None:
                 return verdict
         async with self._lock:
-            # Stale-round rejection (parity: server.py:260-272).
-            if round_number != self._round:
+            # Stale-round rejection (parity: server.py:260-272); in async mode the
+            # window may have MOVED during the decode, so the authoritative
+            # re-check matters for correctness, not just races.
+            if not self._round_acceptable(round_number):
                 return web.json_response(
                     {
                         "status": "error",
-                        "message": (
-                            f"update for round {round_number}, server is on {self._round}"
-                        ),
+                        "message": self._round_rejection_message(round_number),
                     },
                     status=400,
                 )
@@ -484,17 +518,51 @@ class HTTPServer:
             {"status": "success", "message": "update accepted", "update_id": client_id}
         )
 
-    def _reconstruct_compressed_update(self, body: bytes, encoding: str) -> Params:
+    def _round_acceptable(self, round_number: int) -> bool:
+        """Sync mode: exactly the current round.  Async mode (staleness_window>0):
+        a version that was actually PUBLISHED and is still in the window — a
+        never-published in-range number (e.g. a negative round while the window
+        extends below 0) has no base params and must be refused, not guessed."""
+        if round_number == self._round:
+            return True
+        return self.staleness_window > 0 and round_number in self._version_params
+
+    def _round_rejection_message(self, round_number: int) -> str:
+        if self.staleness_window > 0:
+            return (
+                f"update for round {round_number} is outside the staleness window "
+                f"[{self._round - self.staleness_window}, {self._round}]"
+            )
+        return f"update for round {round_number}, server is on {self._round}"
+
+    def _reconstruct_compressed_update(
+        self, body: bytes, encoding: str, base_round: int
+    ) -> Params:
         """Compressed-delta body -> full params via the SHARED codec helpers (the
-        client signs this exact arithmetic).  self._params is read without the round
-        lock (decode runs in a worker thread), but the stale-round pre-check plus the
-        authoritative locked check after reconstruction reject any update whose base
-        rotated mid-decode."""
+        client signs this exact arithmetic).  The base is the params of the version
+        the CLIENT fetched — in async mode that may be an older in-window version,
+        which the history dict serves; sync mode only ever sees the current round.
+        State is read without the round lock (decode runs in a worker thread), but
+        the pre-check plus the authoritative locked check after reconstruction
+        reject any update whose base rotated out mid-decode."""
         from nanofed_tpu.communication.codec import reconstruct_q8, reconstruct_topk8
 
+        if self.staleness_window > 0:
+            base = self._version_params.get(base_round)
+            if base is None:
+                # The version was pruned mid-decode (or never published): refuse —
+                # reconstructing against the WRONG base would silently corrupt the
+                # delta (the locked round re-check would reject it anyway, but a
+                # signature check runs in between and must see honest inputs).
+                raise NanoFedError(
+                    f"base version {base_round} is no longer available for delta "
+                    "reconstruction"
+                )
+        else:
+            base = self._params
         if encoding == ENCODING_TOPK8:
-            return reconstruct_topk8(self._params, body)
-        return reconstruct_q8(self._params, body)
+            return reconstruct_topk8(base, body)
+        return reconstruct_q8(base, body)
 
     def _verify_update_signature(
         self, client_id: str, round_number: int, request: web.Request, params: Params

@@ -60,6 +60,26 @@ class NetworkRoundConfig:
     # derived from who actually enrolled (> n/2; see run()).
     max_clients: int | None = None
     enrollment_grace_s: float = 1.0
+    # Asynchronous buffered aggregation (FedBuff, Nguyen et al. 2022): aggregate as
+    # soon as async_buffer_k updates are buffered instead of waiting for a
+    # synchronized cohort; updates based on any of the last staleness_window
+    # published versions are accepted, discounted by (1 + staleness)^-alpha.
+    # num_rounds then counts AGGREGATIONS (model versions), not cohort rounds.
+    async_buffer_k: int | None = None
+    staleness_window: int = 4
+    staleness_exponent: float = 0.5
+    async_server_lr: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.async_buffer_k is not None:
+            if self.async_buffer_k < 1:
+                raise ValueError("async_buffer_k must be >= 1")
+            if self.staleness_window < 1:
+                raise ValueError("async mode needs staleness_window >= 1")
+            if self.staleness_exponent < 0:
+                raise ValueError("staleness_exponent must be >= 0")
+            if self.async_server_lr <= 0:
+                raise ValueError("async_server_lr must be > 0")
 
 
 def _metric(
@@ -100,6 +120,69 @@ def stack_model_updates(updates: list[ModelUpdate]) -> ClientUpdates:
         samples=weights,
     )
     return ClientUpdates(params=params, weights=weights, metrics=metrics)
+
+
+def fedbuff_combine(
+    global_params: Params,
+    updates: list[ModelUpdate],
+    version_params: dict[int, Params],
+    current_version: int,
+    staleness_exponent: float = 0.5,
+    server_lr: float = 1.0,
+) -> tuple[Params, dict[str, Any]]:
+    """FedBuff aggregation (Nguyen et al. 2022), pure: new params from a buffer of
+    possibly-stale updates.
+
+    Each update's DELTA is computed against the version the client actually trained
+    from (``version_params[update.round_number]``), discounted by
+    ``(1 + staleness)^-alpha``, and the DISCOUNTED deltas are averaged uniformly:
+    ``(1/K) * sum_i s(tau_i) * delta_i`` — the paper's unnormalized form, so an
+    all-stale buffer takes a genuinely SMALLER step (normalizing by the discount sum
+    would cancel a homogeneous discount and let outdated bases drag the model with
+    full force).  No sample-count weighting: it composes badly with staleness (a
+    slow hoarding client would dominate exactly when its information is oldest).
+    ``server_lr`` scales the applied step.
+
+    Updates whose base version has left ``version_params`` are skipped (reported in
+    the stats) — their delta is uncomputable.  Raises if nothing is aggregatable.
+    """
+    deltas, discounts, staleness_list, skipped = [], [], [], 0
+    for u in updates:
+        base = version_params.get(u.round_number)
+        if base is None:
+            skipped += 1
+            continue
+        s = current_version - u.round_number
+        deltas.append(jax.tree.map(
+            lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
+            u.params, base,
+        ))
+        discounts.append((1.0 + s) ** (-staleness_exponent))
+        staleness_list.append(s)
+    if not deltas:
+        raise ValueError(
+            f"no aggregatable updates: all {skipped} buffered bases have left the "
+            "version window"
+        )
+    k = len(deltas)
+    agg = None
+    for d, w in zip(deltas, discounts):
+        contrib = jax.tree.map(lambda x, w=w: (w / k) * x, d)
+        agg = contrib if agg is None else jax.tree.map(np.add, agg, contrib)
+    new_params = jax.tree.map(
+        lambda g, a: (np.asarray(g, np.float32) + server_lr * a).astype(
+            np.asarray(g).dtype
+        ),
+        global_params, agg,
+    )
+    stats = {
+        "num_aggregated": len(deltas),
+        "num_skipped_out_of_window": skipped,
+        "staleness": staleness_list,
+        "mean_staleness": float(np.mean(staleness_list)),
+        "discounts": [round(float(d), 4) for d in discounts],
+    }
+    return new_params, stats
 
 
 class NetworkCoordinator:
@@ -148,6 +231,34 @@ class NetworkCoordinator:
                 "sees masked (uniformly random) vectors, so it cannot compute "
                 "order statistics over individual updates — that blindness is the "
                 "point of secure aggregation"
+            )
+        if config.async_buffer_k is not None:
+            # Async federation composes with neither round-locked protocol:
+            # SecAgg masks are bound to ONE round's cohort (a stale masked vector
+            # cannot unmask against a moved-on roster), and the robust order
+            # statistics assume one cohort's comparable deltas — mixing staleness
+            # levels would let an attacker hide behind legitimately-stale honest
+            # updates.  Validation (per-update, stateless) would be fine but is
+            # deferred until someone needs it; refuse loudly rather than half-run.
+            bad = [name for name, v in (("secure", secure), ("robust", robust),
+                                        ("validation", validation)) if v is not None]
+            if bad:
+                raise ValueError(
+                    f"async_buffer_k cannot be combined with {', '.join(bad)} — "
+                    "asynchronous aggregation mixes staleness levels that these "
+                    "round-locked mechanisms assume away"
+                )
+            # The server enforces the window; wire it so users configure ONE place.
+            server.staleness_window = config.staleness_window
+        elif server.staleness_window > 0:
+            # A windowed server under the SYNC protocol would re-admit the exact
+            # cross-round contamination the sync buffer clear exists to prevent
+            # (a just-drained round's straggler counting toward the next round's
+            # barrier at full, undiscounted weight).
+            raise ValueError(
+                "server was built with staleness_window > 0 but the coordinator "
+                "is synchronous — set NetworkRoundConfig(async_buffer_k=...) or "
+                "use a sync server (staleness_window=0)"
             )
         self.server = server
         self.params = params
@@ -460,12 +571,83 @@ class NetworkCoordinator:
         self._log.info("round %d: %s", round_number, record["metrics"])
         return record
 
+    async def _wait_for_buffer(self, k: int) -> int:
+        """Async mode: poll until >= k updates are buffered or the timeout expires;
+        returns the buffered count at exit."""
+        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        while asyncio.get_event_loop().time() < deadline:
+            n = self.server.num_updates()
+            if n >= k:
+                return n
+            await asyncio.sleep(self.config.poll_interval_s)
+        return self.server.num_updates()
+
+    async def _run_async(self) -> list[dict[str, Any]]:
+        """FedBuff loop: each iteration publishes the current version, waits for
+        ``async_buffer_k`` buffered updates (of ANY in-window staleness — no cohort
+        barrier), and applies the staleness-discounted buffer aggregate.
+
+        ``num_rounds`` counts aggregations.  A timeout with a non-empty buffer
+        aggregates what arrived (a slow federation still makes progress); a timeout
+        with an empty buffer records a FAILED aggregation and re-publishes the same
+        version.  The coordinator's own version history mirrors the server's window
+        so deltas are computed against the base each client actually fetched.
+        """
+        k = self.config.async_buffer_k
+        version_params: dict[int, Params] = {}
+        version = 0
+        for agg_i in range(self.config.num_rounds):
+            await self.server.publish_model(self.params, version)
+            version_params[version] = self.params
+            for old in [v for v in version_params
+                        if v < version - self.config.staleness_window]:
+                del version_params[old]
+            got = await self._wait_for_buffer(k)
+            # Exactly K per aggregation (surplus stays buffered for the next one) —
+            # "buffer of K" means K, or the update-budget accounting lies.
+            updates = await self.server.take_updates(k)
+            if not updates:
+                record = {"aggregation": agg_i, "version": version,
+                          "status": "FAILED", "num_clients": 0,
+                          "reason": f"timeout with an empty buffer (wanted {k})"}
+                self.history.append(record)
+                self._log.warning("aggregation %d FAILED: empty buffer", agg_i)
+                continue
+            self.params, stats = fedbuff_combine(
+                self.params, updates, version_params, version,
+                staleness_exponent=self.config.staleness_exponent,
+                server_lr=self.config.async_server_lr,
+            )
+            version += 1
+            losses = [_metric(u.metrics, "loss", float("nan")) for u in updates]
+            finite = [v for v in losses if math.isfinite(v)]
+            record = {
+                "aggregation": agg_i, "version": version, "status": "COMPLETED",
+                "num_clients": stats["num_aggregated"],
+                "buffered_at_drain": got,
+                "metrics": {"loss": float(np.mean(finite)) if finite else None},
+                **stats,
+            }
+            self.history.append(record)
+            self._log.info(
+                "aggregation %d -> version %d: %d updates, staleness %s", agg_i,
+                version, stats["num_aggregated"], stats["staleness"],
+            )
+        await self.server.publish_model(self.params, version)
+        self.server.stop_training()
+        return self.history
+
     async def run(self) -> list[dict[str, Any]]:
         """All rounds, then signal termination to polling clients.
 
         In secure mode, opens secure-aggregation enrollment for ``min_clients`` and
         waits for the cohort to complete before round 0.
+
+        With ``async_buffer_k`` set, runs the FedBuff loop instead (see
+        ``_run_async``): no cohort barrier, aggregations fire on buffer fill.
         """
+        if self.config.async_buffer_k is not None:
+            return await self._run_async()
         if self.secure is not None:
             loop = asyncio.get_event_loop()
             tolerant = self.secure.dropout_tolerant
