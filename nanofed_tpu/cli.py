@@ -162,6 +162,27 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "window, which would close below the minimum", file=sys.stderr)
         return 2
 
+    if args.async_buffer is not None and (args.secure or args.validate):
+        # The coordinator refuses these too, with a traceback; say why up front.
+        print("error: --async-buffer cannot be combined with --secure or "
+              "--validate — asynchronous aggregation mixes staleness levels "
+              "these round-locked mechanisms assume away", file=sys.stderr)
+        return 2
+    if args.async_buffer is not None and args.async_buffer < 1:
+        print("error: --async-buffer must be >= 1", file=sys.stderr)
+        return 2
+    if args.async_buffer is not None and args.staleness_window is not None \
+            and args.staleness_window < 1:
+        print("error: --staleness-window must be >= 1 in async mode",
+              file=sys.stderr)
+        return 2
+    if args.staleness_window is not None and args.async_buffer is None:
+        # Same courtesy as --max-clients: a flag only async mode reads must not
+        # be silently ignored — the operator would believe a window is active.
+        print("error: --staleness-window only applies with --async-buffer",
+              file=sys.stderr)
+        return 2
+
     model = get_model(args.model)
     params = model.init(jax.random.key(args.seed))
     secure = None
@@ -203,6 +224,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     min_completion_rate=args.completion_rate,
                     round_timeout_s=args.timeout,
                     max_clients=args.max_clients,
+                    async_buffer_k=args.async_buffer,
+                    staleness_window=(
+                        args.staleness_window
+                        if args.staleness_window is not None else 4
+                    ),
                 ),
                 validation=validation,
                 secure=secure,
@@ -348,6 +374,15 @@ def main(argv: list[str] | None = None) -> int:
         help="validate every drained update (shape / finite / norm / cohort z-score); "
         "invalid clients are dropped from the round",
     )
+    serve.add_argument(
+        "--async-buffer", type=int, default=None, metavar="K",
+        help="asynchronous FedBuff mode: aggregate whenever K updates are "
+        "buffered instead of waiting for a synchronized cohort; --rounds then "
+        "counts aggregations. Incompatible with --secure/--validate")
+    serve.add_argument(
+        "--staleness-window", type=int, default=None,
+        help="async mode only: accept updates based on any of the last W "
+        "published versions (default 4; staleness discounted as (1+s)^-0.5)")
     serve.add_argument("--max-norm", type=float, default=100.0,
                        help="per-leaf norm cap for --validate")
 

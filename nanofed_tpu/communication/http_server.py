@@ -193,6 +193,14 @@ class HTTPServer:
             self._updates.clear()
         return updates
 
+    @property
+    def published_versions(self) -> dict[int, Params]:
+        """Async mode's version window — the SINGLE source of truth for which base
+        params are still reconstructable/aggregatable.  The round engine reads this
+        for delta computation instead of keeping its own copy (two pruning loops
+        that must stay bit-identical is how windows silently diverge)."""
+        return dict(self._version_params)
+
     async def take_updates(self, k: int) -> list[ModelUpdate]:
         """Atomically take up to ``k`` buffered updates in arrival order, LEAVING the
         rest buffered — the async engine aggregates exactly K per step (FedBuff), and

@@ -590,18 +590,14 @@ class NetworkCoordinator:
         ``num_rounds`` counts aggregations.  A timeout with a non-empty buffer
         aggregates what arrived (a slow federation still makes progress); a timeout
         with an empty buffer records a FAILED aggregation and re-publishes the same
-        version.  The coordinator's own version history mirrors the server's window
-        so deltas are computed against the base each client actually fetched.
+        version.  Deltas are computed against the server's published-version window
+        (``server.published_versions``) — the same map the wire acceptance and
+        compressed-delta reconstruction use, so the three can never disagree.
         """
         k = self.config.async_buffer_k
-        version_params: dict[int, Params] = {}
         version = 0
         for agg_i in range(self.config.num_rounds):
             await self.server.publish_model(self.params, version)
-            version_params[version] = self.params
-            for old in [v for v in version_params
-                        if v < version - self.config.staleness_window]:
-                del version_params[old]
             got = await self._wait_for_buffer(k)
             # Exactly K per aggregation (surplus stays buffered for the next one) —
             # "buffer of K" means K, or the update-budget accounting lies.
@@ -613,8 +609,11 @@ class NetworkCoordinator:
                 self.history.append(record)
                 self._log.warning("aggregation %d FAILED: empty buffer", agg_i)
                 continue
+            # The server's published-version window is the single source of truth
+            # for which bases are still reconstructable — no coordinator-side copy
+            # whose pruning could silently diverge.
             self.params, stats = fedbuff_combine(
-                self.params, updates, version_params, version,
+                self.params, updates, self.server.published_versions, version,
                 staleness_exponent=self.config.staleness_exponent,
                 server_lr=self.config.async_server_lr,
             )

@@ -100,6 +100,86 @@ def test_serve_secure_round(capsys):
     assert history[0]["status"] == "COMPLETED" and history[0]["secure"] is True
 
 
+def test_serve_async_buffer_round(capsys):
+    """`serve --async-buffer K` hosts FedBuff aggregations that real clients feed
+    with no cohort barrier."""
+    import socket
+
+    from nanofed_tpu.communication import HTTPClient
+    from nanofed_tpu.models import get_model
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+    model = get_model("digits_mlp")
+    init = model.init(jax.random.key(0))
+    rc_holder = {}
+
+    def run_server():
+        rc_holder["rc"] = main([
+            "serve", "--model", "digits_mlp", "--port", str(port), "--rounds", "3",
+            "--timeout", "30", "--async-buffer", "2", "--staleness-window", "4",
+        ])
+
+    async def run_client(cid, seed):
+        async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as c:
+            params = None
+            for _ in range(200):
+                try:
+                    params, rnd, active = await c.fetch_global_model(like=init)
+                    break
+                except Exception:
+                    await asyncio.sleep(0.05)
+            assert params is not None
+            while True:
+                try:
+                    params, rnd, active = await c.fetch_global_model(like=init)
+                    if not active:
+                        return
+                    fake = jax.tree.map(
+                        lambda p, s=seed: p + 0.01 * (s + 1) * np.ones_like(p),
+                        params,
+                    )
+                    await c.submit_update(fake, {"loss": 0.5, "num_samples": 10.0})
+                except Exception:
+                    return  # server already tore the socket down after the run
+                await asyncio.sleep(0.01)
+
+    async def clients():
+        await asyncio.gather(*(run_client(f"c{i}", i) for i in range(3)))
+
+    server_thread = threading.Thread(target=run_server, daemon=True)
+    server_thread.start()
+    asyncio.run(clients())
+    server_thread.join(timeout=60)
+    assert not server_thread.is_alive()
+    assert rc_holder["rc"] == 0
+    history = json.loads(capsys.readouterr().out)
+    completed = [h for h in history if h["status"] == "COMPLETED"]
+    assert len(completed) == 3
+    assert all(h["num_clients"] == 2 for h in completed)  # exactly K per step
+
+
+def test_serve_async_refuses_secure(capsys):
+    rc = main(["serve", "--async-buffer", "2", "--secure"])
+    assert rc == 2
+    assert "--async-buffer" in capsys.readouterr().err
+
+
+def test_serve_async_flag_validation(capsys):
+    """Mode-scoped flags fail fast instead of being silently ignored or escaping
+    as coordinator tracebacks."""
+    rc = main(["serve", "--staleness-window", "8"])
+    assert rc == 2
+    assert "--async-buffer" in capsys.readouterr().err
+    rc = main(["serve", "--async-buffer", "0"])
+    assert rc == 2
+    assert "must be >= 1" in capsys.readouterr().err
+    rc = main(["serve", "--async-buffer", "2", "--staleness-window", "0"])
+    assert rc == 2
+    assert "staleness-window" in capsys.readouterr().err
+
+
 def test_unknown_benchmark_name_errors():
     with pytest.raises(KeyError):
         main(["bench", "not_a_benchmark"])
