@@ -181,6 +181,18 @@ split and tests on its held-out split — one `jit(vmap(...))` over the whole
 population, reusing the rounds' exact local-fit program. Measured at scale:
 global 91.6% → personalized **99.4%** (`runs/personalization_r05.json`).""",
     # 16
+    """## 15. Asynchronous federation (FedBuff)
+
+The synchronous protocol is a barrier: every round waits for its slowest client.
+`NetworkRoundConfig(async_buffer_k=K)` (CLI: `serve --async-buffer K`) removes it —
+the server accepts updates based on any of the last `staleness_window` published
+versions and aggregates exactly K whenever they arrive, each delta computed against
+the version its client actually fetched and discounted by `(1+s)^-α` (Nguyen et al.
+2022). Below, three clients at different speeds feed a live aiohttp server: no
+aggregation waits for a cohort, and stale updates contribute at a discount instead
+of gating anyone. Measured at scale (`runs/asyncfed_r05.json`): 5.4× faster to the
+same update budget than the barrier, at higher accuracy.""",
+    # 17
     """## Where to go next
 
 - **Scale**: `client_chunk` trains 1000 clients on 8 chips in sequential chunks
@@ -515,6 +527,58 @@ print(f"on clients' OWN held-out data:")
 print(f"  global model:       {float(out['global_accuracy']):.4f}")
 print(f"  after 3 fine-tune epochs: {float(out['personal_accuracy']):.4f}"
       f"  (gain {float(out['personalization_gain']):+.4f})")""",
+    # P (after MD 16): FedBuff async federation over live aiohttp (top-level await)
+    """import asyncio
+from nanofed_tpu.communication import (HTTPClient, HTTPServer,
+                                       NetworkCoordinator, NetworkRoundConfig)
+from nanofed_tpu.trainer.local import make_local_fit as _mlf
+
+async_fit = jax.jit(_mlf(model.apply, TrainingConfig(batch_size=16, local_epochs=1,
+                                                     learning_rate=0.3)))
+async_init = model.init(jax.random.key(0))
+_ = async_fit(async_init, jax.tree.map(lambda a: jax.numpy.asarray(a[0]), client_data),
+              jax.random.key(0))  # warm the compile outside the timed federation
+
+async def nb_client(cid, idx, delay, port):
+    data = jax.tree.map(lambda a: jax.numpy.asarray(a[idx]), client_data)
+    async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as c:
+        while True:
+            try:
+                fetched, rnd, active = await c.fetch_global_model(like=async_init)
+                if not active:
+                    return
+                r = async_fit(jax.tree.map(jax.numpy.asarray, fetched), data,
+                              jax.random.key(idx * 100 + rnd))
+                await asyncio.sleep(delay)   # heterogeneous device speed
+                await c.submit_update(r.params, {"loss": float(r.metrics.loss),
+                                                 "num_samples": 100.0})
+            except Exception:
+                return
+
+import socket
+with socket.socket() as _s:      # pick a free port (portable notebook)
+    _s.bind(("127.0.0.1", 0))
+    PORT = _s.getsockname()[1]
+server = HTTPServer(port=PORT)
+coord = NetworkCoordinator(server, async_init, NetworkRoundConfig(
+    num_rounds=6, async_buffer_k=2, staleness_window=6,
+    round_timeout_s=20.0, poll_interval_s=0.01))
+await server.start()
+tasks = [asyncio.ensure_future(nb_client(f"c{i}", i, 0.08 if i == 0 else 0.02, PORT))
+         for i in range(3)]
+history = await coord.run()
+await asyncio.gather(*tasks)
+await server.stop()
+all_staleness = []
+for h in history:
+    s = h.get("staleness", [])   # FAILED records carry no staleness
+    all_staleness += s
+    print(f"aggregation {h['aggregation']} [{h['status']}]: "
+          f"{h['num_clients']} updates, staleness {s}")
+stale = sum(v > 0 for v in all_staleness)
+print(f"{stale}/{len(all_staleness)} aggregated updates were stale — "
+      "discounted by (1+s)^-0.5, and no aggregation waited for a cohort")
+assert stale > 0  # the demo only teaches what its own run shows""",
 ]
 
 
