@@ -16,7 +16,7 @@ from nanofed_tpu.core import (
 )
 from nanofed_tpu.utils import Logger, LogConfig, get_current_time, log_exec
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "ClientData",
