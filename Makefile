@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint bench example dryrun api-docs notebook accuracy clean
+.PHONY: test test-fast lint bench example dryrun api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -28,6 +28,11 @@ notebook:
 
 accuracy:
 	python scripts/record_accuracy.py
+
+# Digest the most recent run's telemetry.jsonl (phase durations, round outcomes,
+# headline counters) — see docs/observability.md.
+metrics-summary:
+	python -m nanofed_tpu.cli metrics-summary runs
 
 clean:
 	rm -rf runs/ .pytest_cache/ $$(find . -name __pycache__ -type d)

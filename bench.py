@@ -54,6 +54,7 @@ compiles.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
@@ -101,18 +102,25 @@ def _error_json(stage: str, metric: str = METRIC_FLAGSHIP) -> dict:
 
 
 def _timed_rounds(step, params, sos, data, weights, stack_rngs, padded, log_stage, t0,
-                  reps: int = 3):
+                  reps: int = 3, tracer=None):
     """Time ``reps`` steady-state rounds (caller has already run the compile/warm-up
-    round); returns the np.ndarray of per-round wall-clock seconds."""
+    round); returns the np.ndarray of per-round wall-clock seconds.  With a
+    ``tracer`` (observability ``SpanTracer``), each round is additionally recorded
+    as a ``round`` span so the workload's phase summary carries per-round timings."""
     import jax
     import numpy as np
 
     times = []
     for r in range(1, reps + 1):
+        span = (
+            tracer.span("round", rep=r) if tracer is not None
+            else contextlib.nullcontext()
+        )
         t = time.perf_counter()
-        res = step(params, sos, data, weights, stack_rngs(jax.random.key(r), padded))
-        params, sos = res.params, res.server_opt_state
-        jax.block_until_ready(params)
+        with span:
+            res = step(params, sos, data, weights, stack_rngs(jax.random.key(r), padded))
+            params, sos = res.params, res.server_opt_state
+            jax.block_until_ready(params)
         times.append(time.perf_counter() - t)
         log_stage(f"round {r}: {times[-1]:.4f}s", t0=t0)
     return np.asarray(times)
@@ -228,6 +236,13 @@ def compact_summary(results: list) -> dict:
         out["est_mfu_pct"] = flagship["est_mfu_pct"]
     if "error" in flagship:
         out["error"] = flagship["error"]
+    if "phases" in flagship:
+        # Compact round-phase digest (observability spans): phase -> total seconds.
+        # A handful of short keys, so the tail line stays driver-tail-buffer safe.
+        out["phases"] = {
+            name: round(digest["total_s"], 3)
+            for name, digest in flagship["phases"].items()
+        }
     parity = by_metric.get(METRIC_PARITY)
     if parity is not None:
         out["parity"] = {
@@ -345,7 +360,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         weights = compute_weights(num_samples) * (num_samples > 0)
         return data, weights, padded
 
-    def measure(name, metric, step, data, weights, padded, n_reps):
+    def measure(name, metric, step, data, weights, padded, n_reps, tracer=None):
         params = jax.device_put(model.init(jax.random.key(0)), repl)
         sos = jax.device_put(init_server_state(strategy, params), repl)
         log_stage(f"{name}: warm-up round (XLA compile; watchdog {COMPILE_TIMEOUT_S:.0f}s)", t0=t0)
@@ -354,12 +369,23 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             COMPILE_TIMEOUT_S,
             error_json=_error_json("compile", metric),
         ):
-            res = step(params, sos, data, weights, stack_rngs(jax.random.key(0), padded))
-            params, sos = res.params, res.server_opt_state
-            jax.block_until_ready(params)
+            span = (
+                tracer.span("compile") if tracer is not None
+                else contextlib.nullcontext()
+            )
+            with span:
+                res = step(params, sos, data, weights, stack_rngs(jax.random.key(0), padded))
+                params, sos = res.params, res.server_opt_state
+                jax.block_until_ready(params)
         log_stage(f"{name}: warm-up done; timing {n_reps} steady-state rounds", t0=t0)
         return _timed_rounds(step, params, sos, data, weights, stack_rngs, padded,
-                             log_stage, t0, reps=n_reps)
+                             log_stage, t0, reps=n_reps, tracer=tracer)
+
+    # Round-phase spans (observability subsystem): per-workload tracers record
+    # prepare/compile/round phases; each record carries its own ``phases`` digest and
+    # the compact tail summary keeps the flagship's totals (registry=False keeps the
+    # bench standalone — no process-wide metric state).
+    from nanofed_tpu.observability import SpanTracer
 
     if "parity" in workloads:
         # Tutorial-parity workload: 2 clients with 12k / 4k MNIST-shaped samples.
@@ -367,19 +393,27 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         # vs_baseline claims the SAME logical workload — bf16 is benchmarked in the
         # flagship line instead, where the claim is throughput, not parity.
         training = TrainingConfig(batch_size=64, local_epochs=2, learning_rate=0.1)
+        tracer = SpanTracer(registry=False)
         measurements = []
         for i, scale in enumerate(parity_scales):
-            a, b = 12_000 // scale, 16_000 // scale
-            data, weights, padded = prepare(b, [np.arange(0, a), np.arange(a, b)], 64)
-            step = build_round_step(model.apply, training, mesh, strategy, donate=True)
+            with tracer.span("prepare", scale=scale):
+                a, b = 12_000 // scale, 16_000 // scale
+                data, weights, padded = prepare(
+                    b, [np.arange(0, a), np.arange(a, b)], 64
+                )
+                step = build_round_step(
+                    model.apply, training, mesh, strategy, donate=True
+                )
             times = measure(f"parity@1/{scale}", METRIC_PARITY, step, data, weights,
-                            padded, reps if i == 0 else secondary_reps)
+                            padded, reps if i == 0 else secondary_reps,
+                            tracer=tracer)
             measurements.append((scale, times))
         out = finalize_measurements(measurements, REFERENCE_ROUND_S, {
             "metric": METRIC_PARITY,
             "unit": "s",
             "platform": str(devices[0].platform),
         })
+        out["phases"] = tracer.phase_summary()
         print(json.dumps(out), flush=True)
 
     if "flagship" in workloads:
@@ -391,19 +425,23 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         training = TrainingConfig(
             batch_size=64, local_epochs=2, learning_rate=0.1, compute_dtype="bfloat16"
         )
+        tracer = SpanTracer(registry=False)
         measurements = []
         for i, scale in enumerate(flagship_scales):
             n_clients = 1000 // scale
             chunk = 125 if scale == 1 else 1  # keep the streaming path
-            data, weights, padded = prepare(
-                60 * n_clients,
-                [np.arange(i * 60, (i + 1) * 60) for i in range(n_clients)], 64,
-            )
-            step = build_round_step(
-                model.apply, training, mesh, strategy, client_chunk=chunk, donate=True
-            )
+            with tracer.span("prepare", scale=scale):
+                data, weights, padded = prepare(
+                    60 * n_clients,
+                    [np.arange(i * 60, (i + 1) * 60) for i in range(n_clients)], 64,
+                )
+                step = build_round_step(
+                    model.apply, training, mesh, strategy, client_chunk=chunk,
+                    donate=True,
+                )
             times = measure(f"flagship@1/{scale}", METRIC_FLAGSHIP, step, data,
-                            weights, padded, reps if i == 0 else secondary_reps)
+                            weights, padded, reps if i == 0 else secondary_reps,
+                            tracer=tracer)
             measurements.append((scale, times))
         is_tpu = str(devices[0].platform) == "tpu"
         out = {
@@ -420,6 +458,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             ),
         }
         out = finalize_measurements(measurements, REFERENCE_FLAGSHIP_S, out)
+        out["phases"] = tracer.phase_summary()
         value = out["value"]
         out["rounds_per_sec"] = round(1.0 / value, 3)
         if on_cpu:

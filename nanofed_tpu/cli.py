@@ -118,6 +118,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         robust_trim_k=args.robust_trim,
         robust_method=args.robust_method,
         scaffold=args.scaffold,
+        telemetry_dir=args.telemetry_dir,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -147,6 +148,31 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "aggregation mode)", file=sys.stderr)
         return 2
 
+    if args.async_buffer is not None:
+        # Sync-only cohort flags are meaningless under FedBuff (no cohort barrier:
+        # aggregations fire on buffer fill, and the buffer size IS --async-buffer);
+        # silently accepting them would let an operator believe a completion gate
+        # or enrollment cap is active when nothing reads it — same courtesy as the
+        # --staleness-window refusal below.
+        explicit = [
+            flag for flag, value in (
+                ("--min-clients", args.min_clients),
+                ("--completion-rate", args.completion_rate),
+                ("--max-clients", args.max_clients),
+            ) if value is not None
+        ]
+        if explicit:
+            print(f"error: {', '.join(explicit)} only appl"
+                  f"{'ies' if len(explicit) == 1 else 'y'} to synchronous cohort "
+                  "rounds — asynchronous --async-buffer mode has no cohort "
+                  "barrier (aggregations fire when K updates are buffered)",
+                  file=sys.stderr)
+            return 2
+    min_clients = args.min_clients if args.min_clients is not None else 1
+    completion_rate = (
+        args.completion_rate if args.completion_rate is not None else 1.0
+    )
+
     if args.max_clients is not None and not args.dropout_tolerant:
         # Only the tolerant enrollment window reads the cap; silently ignoring it
         # would let an operator believe a larger cohort can enroll when the
@@ -156,9 +182,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "--min-clients)", file=sys.stderr)
         return 2
 
-    if args.max_clients is not None and args.max_clients < args.min_clients:
+    if args.max_clients is not None and args.max_clients < min_clients:
         print(f"error: --max-clients ({args.max_clients}) must be >= --min-clients "
-              f"({args.min_clients}) — reaching the cap freezes the enrollment "
+              f"({min_clients}) — reaching the cap freezes the enrollment "
               "window, which would close below the minimum", file=sys.stderr)
         return 2
 
@@ -199,8 +225,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # announces it to clients in the roster payload — a static value computed
         # from min_clients would be wrong for any larger roster.
         floor = (
-            max(2, args.min_clients - 1) if args.dropout_tolerant
-            else args.min_clients
+            max(2, min_clients - 1) if args.dropout_tolerant
+            else min_clients
         )
         secure = SecureAggregationConfig(
             min_clients=floor,
@@ -220,8 +246,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 server, params,
                 NetworkRoundConfig(
                     num_rounds=args.rounds,
-                    min_clients=args.min_clients,
-                    min_completion_rate=args.completion_rate,
+                    min_clients=min_clients,
+                    min_completion_rate=completion_rate,
                     round_timeout_s=args.timeout,
                     max_clients=args.max_clients,
                     async_buffer_k=args.async_buffer,
@@ -232,6 +258,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 ),
                 validation=validation,
                 secure=secure,
+                telemetry_dir=args.telemetry_dir,
             )
             return await coordinator.run()
         finally:
@@ -245,6 +272,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         return 1
     print(json.dumps(history, indent=2, default=str))
     return 0 if all(h["status"] == "COMPLETED" for h in history) else 1
+
+
+def _cmd_metrics_summary(args: argparse.Namespace) -> int:
+    """Digest a run's ``telemetry.jsonl`` (observability subsystem): per-phase span
+    durations, round outcomes, and headline counters, as one JSON document."""
+    from nanofed_tpu.observability import find_latest_telemetry, summarize_telemetry
+
+    path = find_latest_telemetry(args.path)
+    if path is None:
+        print(f"error: no telemetry.jsonl found under {args.path!r} — run with "
+              "--telemetry-dir (or the default runs dir with metrics saving on) "
+              "first", file=sys.stderr)
+        return 1
+    print(json.dumps(summarize_telemetry(path), indent=2))
+    return 0
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
@@ -339,6 +381,12 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--dp-delta", type=float, default=1e-5)
     run.add_argument("--dp-clip", type=float, default=1.0,
                      help="central-DP per-update clip norm C")
+    run.add_argument(
+        "--telemetry-dir", default=None,
+        help="write the run's telemetry.jsonl (phase spans + round records + final "
+        "metrics snapshot) here instead of the default <out-dir>; read it back "
+        "with `nanofed-tpu metrics-summary`",
+    )
 
     serve = sub.add_parser(
         "serve", help="host a real-network federation server (binary HTTP transport)"
@@ -347,8 +395,14 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=8080)
     serve.add_argument("--rounds", type=int, default=2)
-    serve.add_argument("--min-clients", type=int, default=1)
-    serve.add_argument("--completion-rate", type=float, default=1.0)
+    serve.add_argument(
+        "--min-clients", type=int, default=None,
+        help="synchronous rounds: cohort size to wait for (default 1); "
+        "incompatible with --async-buffer")
+    serve.add_argument(
+        "--completion-rate", type=float, default=None,
+        help="synchronous rounds: fraction of --min-clients required before "
+        "aggregating (default 1.0); incompatible with --async-buffer")
     serve.add_argument("--timeout", type=float, default=300.0)
     serve.add_argument("--seed", type=int, default=0)
     serve.add_argument(
@@ -385,6 +439,22 @@ def main(argv: list[str] | None = None) -> int:
         "published versions (default 4; staleness discounted as (1+s)^-0.5)")
     serve.add_argument("--max-norm", type=float, default=100.0,
                        help="per-leaf norm cap for --validate")
+    serve.add_argument(
+        "--telemetry-dir", default=None,
+        help="write this server run's telemetry.jsonl (round/phase spans + round "
+        "records) here; live metrics are always scrapable at GET /metrics",
+    )
+
+    summary = sub.add_parser(
+        "metrics-summary",
+        help="digest a run's telemetry.jsonl: per-phase durations, round outcomes, "
+        "headline counters",
+    )
+    summary.add_argument(
+        "path", nargs="?", default="runs",
+        help="a telemetry.jsonl, a run dir containing one, or a tree to search "
+        "for the most recent one (default: runs)",
+    )
 
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
@@ -402,6 +472,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_bench(args)
     if args.cmd == "serve":
         return _cmd_serve(args)
+    if args.cmd == "metrics-summary":
+        return _cmd_metrics_summary(args)
     return _cmd_run(args)
 
 

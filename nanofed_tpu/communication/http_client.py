@@ -36,6 +36,7 @@ from nanofed_tpu.communication.http_server import (
 )
 from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import Params
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
 from nanofed_tpu.utils.logger import Logger
 
 
@@ -98,6 +99,7 @@ class HTTPClient:
         security_manager: Any | None = None,
         update_encoding: str = "npz",
         topk_fraction: float = 0.05,
+        registry: MetricsRegistry | None = None,
     ) -> None:
         """``security_manager`` (a ``nanofed_tpu.security.SecurityManager``) makes every
         submitted update carry an RSA-PSS signature header; pair it with a server
@@ -133,6 +135,33 @@ class HTTPClient:
         self._secagg_session = ""  # cohort session nonce, cached from the roster
         self._last_global: Params | None = None  # compressed-delta base, set by fetch
         self._residual: Params | None = None  # topk8 error-feedback accumulator
+        # After a REJECTED topk8 submit the whole un-sent delta is folded into
+        # _residual; _pending_base remembers the local params that fold covered, so
+        # an immediate retry measures only the training since the fold (zero on an
+        # identical retry) instead of double-counting the round's delta.
+        self._pending_base: Params | None = None
+        # Client-side wire metrics (observability subsystem).
+        reg = registry or get_registry()
+        self._m_bytes_tx = reg.counter(
+            "nanofed_client_bytes_sent_total",
+            "Request body bytes sent by HTTP clients, by endpoint",
+            labels=("endpoint",),
+        )
+        self._m_bytes_rx = reg.counter(
+            "nanofed_client_bytes_received_total",
+            "Response body bytes fetched by HTTP clients, by endpoint",
+            labels=("endpoint",),
+        )
+        self._m_submissions = reg.counter(
+            "nanofed_client_submissions_total",
+            "Update submissions by result (accepted / rejected)",
+            labels=("result",),
+        )
+        self._m_codec_ratio = reg.gauge(
+            "nanofed_client_codec_ratio",
+            "Last update's wire bytes / raw float32 bytes, by encoding",
+            labels=("encoding",),
+        )
 
     @property
     def secagg_session(self) -> str:
@@ -172,11 +201,16 @@ class HTTPClient:
             if resp.headers.get(HEADER_STATUS) == "terminated":
                 return None, round_number, False
             payload = await resp.read()
+        self._m_bytes_rx.inc(len(payload), endpoint="model")
         params = decode_params(payload, like=like)
         if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
             # Pin the delta base.  Not kept for plain npz — it would hold a full
             # extra model copy per client process for nothing.
             self._last_global = params
+            # A fresh base resets the retry bookkeeping: the next delta is measured
+            # against THIS global (any mass a rejected submit left behind is already
+            # accumulated in _residual, which rides the next delta as usual).
+            self._pending_base = None
         return params, round_number, True
 
     async def submit_update(self, params: Params, metrics: dict[str, Any]) -> bool:
@@ -204,9 +238,17 @@ class HTTPClient:
                     "as its base — call fetch_global_model on this client before "
                     "submit_update"
                 )
+            # After a rejected topk8 submit, _residual already holds everything up
+            # to _pending_base — measure only the training SINCE the fold, or an
+            # immediate retry would double-count the round's delta.
+            delta_base = (
+                self._pending_base
+                if self._pending_base is not None
+                else self._last_global
+            )
             delta = jax.tree.map(
                 lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
-                params, self._last_global,
+                params, delta_base,
             )
             if self.update_encoding == ENCODING_TOPK8:
                 # Error feedback: last round's un-sent tail rides this delta, and
@@ -240,6 +282,11 @@ class HTTPClient:
         else:
             body = encode_params(params)
             signed_params = params
+        raw_bytes = sum(int(leaf.size) * 4 for leaf in jax.tree.leaves(params))
+        if raw_bytes:
+            self._m_codec_ratio.set(
+                len(body) / raw_bytes, encoding=self.update_encoding
+            )
         if self.security_manager is not None:
             import base64
 
@@ -251,6 +298,7 @@ class HTTPClient:
                 headers[HEADER_METRICS],
             )
             headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
+        self._m_bytes_tx.inc(len(body), endpoint="update")
         async with session.post(url, data=body, headers=headers) as resp:
             if resp.status != 200:
                 # Framework error pages (413 too-large, 500) are text, not JSON.
@@ -259,12 +307,22 @@ class HTTPClient:
                 except Exception:
                     message = (await resp.text())[:200]
                 self._log.warning("update rejected (HTTP %d): %s", resp.status, message)
-                # A rejected topk8 submit commits NOTHING: the staged residual is
-                # dropped, so the full delta (this round's + all accumulated tail)
-                # stays in the accumulator for the retry / next round.
+                self._m_submissions.inc(result="rejected")
+                if self.update_encoding == ENCODING_TOPK8:
+                    # A rejected submit applied NOTHING server-side: fold the WHOLE
+                    # combined delta (this round's progress + all accumulated tail)
+                    # into the accumulator so true error-feedback semantics hold
+                    # across a dropped round — the mass rides the next round's
+                    # delta instead of vanishing from both sides forever.
+                    # _pending_base pins where the fold stopped, so an immediate
+                    # retry contributes only post-fold training (see submit above).
+                    self._residual = delta
+                    self._pending_base = params
                 return False
         if staged_residual is not None:
             self._residual = staged_residual
+            self._pending_base = None
+        self._m_submissions.inc(result="accepted")
         return True
 
     # ------------------------------------------------------------------

@@ -32,8 +32,8 @@ from nanofed_tpu.communication.codec import (
     decode_params,
     encode_params,
 )
-from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import ModelUpdate, Params
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
 
@@ -58,6 +58,7 @@ class ServerEndpoints:
     update: str = "/update"
     status: str = "/status"
     test: str = "/test"
+    metrics: str = "/metrics"
     secagg_register: str = "/secagg/register"
     secagg_roster: str = "/secagg/roster"
     secagg_shares: str = "/secagg/shares"
@@ -76,6 +77,7 @@ class HTTPServer:
         client_keys: dict[str, bytes] | None = None,
         require_signatures: bool = False,
         staleness_window: int = 0,
+        registry: MetricsRegistry | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -91,7 +93,12 @@ class HTTPServer:
         update for version v stays valid while v >= current - W), and compressed
         deltas reconstruct against the version the client actually fetched.  One
         buffered update per client (latest wins — a fast client's newer update
-        supersedes its unaggregated older one)."""
+        supersedes its unaggregated older one).
+
+        ``registry`` (default: the process-wide one) receives this server's wire
+        metrics — bytes tx/rx per endpoint, update acceptances/rejections by reason,
+        secure-aggregation evictions — and is what ``GET /metrics`` renders in
+        Prometheus text format."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
         self.host = host
@@ -134,11 +141,32 @@ class HTTPServer:
         self._round_share_senders: dict[str, dict[str, str]] = {}  # sender -> its deposit
         self._unmask_request: dict[str, Any] | None = None
         self._unmask_reveals: dict[str, dict[str, Any]] = {}
+        # Wire metrics (observability subsystem): counted at the handler level so
+        # every scrape of /metrics reflects what actually crossed this server's wire.
+        self.metrics_registry = registry or get_registry()
+        self._m_bytes_rx = self.metrics_registry.counter(
+            "nanofed_bytes_received_total",
+            "Request body bytes received, by endpoint", labels=("endpoint",),
+        )
+        self._m_bytes_tx = self.metrics_registry.counter(
+            "nanofed_bytes_sent_total",
+            "Response body bytes served, by endpoint", labels=("endpoint",),
+        )
+        self._m_updates = self.metrics_registry.counter(
+            "nanofed_updates_total",
+            "Client update submissions by kind (plain/masked) and result",
+            labels=("kind", "result"),
+        )
+        self._m_evictions = self.metrics_registry.counter(
+            "nanofed_secagg_evictions_total",
+            "Clients evicted from the secure-aggregation cohort",
+        )
         self._app = web.Application(client_max_size=max_request_size)
         self._app.router.add_get(self.endpoints.model, self._handle_get_model)
         self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
         self._app.router.add_get(self.endpoints.status, self._handle_status)
         self._app.router.add_get(self.endpoints.test, self._handle_test)
+        self._app.router.add_get(self.endpoints.metrics, self._handle_metrics)
         self._app.router.add_post(self.endpoints.secagg_register, self._handle_secagg_register)
         self._app.router.add_get(self.endpoints.secagg_roster, self._handle_secagg_roster)
         self._app.router.add_post(self.endpoints.secagg_shares, self._handle_secagg_shares_post)
@@ -357,6 +385,9 @@ class HTTPServer:
         active set would otherwise flip ``secagg_shares_complete()`` true for the
         ROUND IN PROGRESS, serving surviving pollers an epk/inbox view inconsistent
         with the participants list they deposited against."""
+        newly = set(client_ids) - self._secagg_evicted
+        if newly:
+            self._m_evictions.inc(len(newly))
         self._secagg_evicted.update(client_ids)
         self._round_share_epks.clear()
         self._round_share_bhs.clear()
@@ -414,44 +445,54 @@ class HTTPServer:
                 status=200,
                 headers={HEADER_STATUS: "terminated", HEADER_ROUND: str(self._round)},
             )
-        if self._params_bytes is None:
+        body = self._params_bytes
+        if body is None:
             return web.json_response(
                 {"status": "error", "message": "no model published"}, status=503
             )
+        self._m_bytes_tx.inc(len(body), endpoint="model")
         return web.Response(
-            body=self._params_bytes,
+            body=body,
             content_type="application/octet-stream",
             headers={HEADER_STATUS: "training", HEADER_ROUND: str(self._round)},
         )
+
+    def _reject_update(self, reason: str, kind: str = "plain") -> None:
+        self._m_updates.inc(kind=kind, result=reason)
 
     async def _handle_submit_update(self, request: web.Request) -> web.StreamResponse:
         client_id = request.headers.get(HEADER_CLIENT)
         round_header = request.headers.get(HEADER_ROUND)
         if not client_id or round_header is None:
+            self._reject_update("missing_headers")
             return web.json_response(
                 {"status": "error", "message": "missing client/round headers"}, status=400
             )
         try:
             round_number = int(round_header)
         except ValueError:
+            self._reject_update("bad_round_header")
             return web.json_response(
                 {"status": "error", "message": f"bad round: {round_header!r}"}, status=400
             )
         try:
             metrics: dict[str, Any] = json.loads(request.headers.get(HEADER_METRICS, "{}"))
         except json.JSONDecodeError:
+            self._reject_update("bad_metrics_header")
             return web.json_response(
                 {"status": "error", "message": "bad metrics header"}, status=400
             )
         if self._params is None:
             # No template yet: decode_params(like=None) would skip shape/structure
             # validation entirely and buffer an arbitrary payload for round 0.
+            self._reject_update("no_model")
             return web.json_response(
                 {"status": "error", "message": "no model published"}, status=503
             )
         # Cheap stale-round rejection BEFORE reading/decompressing up to 100 MB; the
         # authoritative check re-runs under the lock below.
         if not self._round_acceptable(round_number):
+            self._reject_update("stale_round")
             return web.json_response(
                 {
                     "status": "error",
@@ -465,6 +506,7 @@ class HTTPServer:
                 # Masked payloads are uint32 fixed-point with their own codec; a
                 # client that ALSO asks for q8-delta is misconfigured — refuse
                 # rather than silently interpret the body one way or the other.
+                self._reject_update("bad_encoding", kind="masked")
                 return web.json_response(
                     {"status": "error",
                      "message": f"encoding {encoding!r} cannot combine with "
@@ -473,9 +515,42 @@ class HTTPServer:
                 )
             return await self._handle_masked_update(request, client_id, round_number, metrics)
         body = await request.read()
+        self._m_bytes_rx.inc(len(body), endpoint="update")
         if encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
+            self._reject_update("bad_encoding")
             return web.json_response(
                 {"status": "error", "message": f"unknown encoding {encoding!r}"},
+                status=400,
+            )
+        # Snapshot the (round, base-params) pair UNDER THE LOCK before dispatching the
+        # decode thread: publish_model can advance the round mid-decode, and a decode
+        # against the NEW params would hand the signature check a reconstruction the
+        # client never signed — a raced straggler would then see a misleading 403
+        # signature failure instead of the accurate 400 stale-round rejection.  (The
+        # locked re-check after the decode remains the authority on acceptance.)
+        async with self._lock:
+            if not self._round_acceptable(round_number):
+                self._reject_update("stale_round")
+                return web.json_response(
+                    {
+                        "status": "error",
+                        "message": self._round_rejection_message(round_number),
+                    },
+                    status=400,
+                )
+            base = (
+                self._version_params.get(round_number)
+                if self.staleness_window > 0
+                else self._params
+            )
+        if base is None:
+            # _round_acceptable passed under the lock, so async mode's window held
+            # the version; this is unreachable short of state corruption — refuse
+            # rather than reconstruct against a guessed base.
+            self._reject_update("stale_round")
+            return web.json_response(
+                {"status": "error",
+                 "message": self._round_rejection_message(round_number)},
                 status=400,
             )
         try:
@@ -486,11 +561,12 @@ class HTTPServer:
                 # numpy float32 — bit-identical to the client's signing-side
                 # reconstruction, so signature verification composes.
                 params = await asyncio.to_thread(
-                    self._reconstruct_compressed_update, body, encoding, round_number
+                    self._reconstruct_compressed_update, body, encoding, base
                 )
             else:
-                params = await asyncio.to_thread(decode_params, body, like=self._params)
+                params = await asyncio.to_thread(decode_params, body, like=base)
         except Exception as e:
+            self._reject_update("bad_payload")
             return web.json_response(
                 {"status": "error", "message": f"bad payload: {e}"}, status=400
             )
@@ -499,12 +575,14 @@ class HTTPServer:
                 self._verify_update_signature, client_id, round_number, request, params
             )
             if verdict is not None:
+                self._reject_update("bad_signature")
                 return verdict
         async with self._lock:
             # Stale-round rejection (parity: server.py:260-272); in async mode the
             # window may have MOVED during the decode, so the authoritative
             # re-check matters for correctness, not just races.
             if not self._round_acceptable(round_number):
+                self._reject_update("stale_round")
                 return web.json_response(
                     {
                         "status": "error",
@@ -520,6 +598,7 @@ class HTTPServer:
                 timestamp=get_current_time().isoformat(),
             )
             accepted = len(self._updates)
+        self._m_updates.inc(kind="plain", result="accepted")
         self._log.info("update from %s (round %d, %d buffered)", client_id, round_number,
                        accepted)
         return web.json_response(
@@ -544,30 +623,17 @@ class HTTPServer:
         return f"update for round {round_number}, server is on {self._round}"
 
     def _reconstruct_compressed_update(
-        self, body: bytes, encoding: str, base_round: int
+        self, body: bytes, encoding: str, base: Params
     ) -> Params:
         """Compressed-delta body -> full params via the SHARED codec helpers (the
-        client signs this exact arithmetic).  The base is the params of the version
-        the CLIENT fetched — in async mode that may be an older in-window version,
-        which the history dict serves; sync mode only ever sees the current round.
-        State is read without the round lock (decode runs in a worker thread), but
-        the pre-check plus the authoritative locked check after reconstruction
-        reject any update whose base rotated out mid-decode."""
+        client signs this exact arithmetic).  ``base`` is the params of the version
+        the CLIENT fetched, SNAPSHOTTED under the round lock by the caller before
+        this runs in a worker thread — in async mode that may be an older in-window
+        version from the history dict; sync mode only ever sees the current round.
+        Snapshotting (rather than re-reading ``self._params`` here) keeps the
+        signature check downstream honest when publish_model races the decode."""
         from nanofed_tpu.communication.codec import reconstruct_q8, reconstruct_topk8
 
-        if self.staleness_window > 0:
-            base = self._version_params.get(base_round)
-            if base is None:
-                # The version was pruned mid-decode (or never published): refuse —
-                # reconstructing against the WRONG base would silently corrupt the
-                # delta (the locked round re-check would reject it anyway, but a
-                # signature check runs in between and must see honest inputs).
-                raise NanoFedError(
-                    f"base version {base_round} is no longer available for delta "
-                    "reconstruction"
-                )
-        else:
-            base = self._params
         if encoding == ENCODING_TOPK8:
             return reconstruct_topk8(base, body)
         return reconstruct_q8(base, body)
@@ -1032,6 +1098,7 @@ class HTTPServer:
         import numpy as np
 
         if client_id not in self._secagg_roster:
+            self._reject_update("not_enrolled", kind="masked")
             return web.json_response(
                 {"status": "error", "message": f"{client_id!r} not enrolled"}, status=403
             )
@@ -1040,11 +1107,13 @@ class HTTPServer:
             # compromised) and the active cohort no longer includes it — accepting
             # its vector would inflate the masked-update count and let it push a
             # slow-but-alive member past the round barrier into eviction.
+            self._reject_update("evicted", kind="masked")
             return web.json_response(
                 {"status": "error",
                  "message": f"{client_id!r} was evicted from this cohort"}, status=403
             )
         body = await request.read()
+        self._m_bytes_rx.inc(len(body), endpoint="update")
         if self.require_signatures:
             from nanofed_tpu.security.signing import verify_masked_signature
 
@@ -1053,6 +1122,7 @@ class HTTPServer:
                 body, client_id, round_number, request.headers.get(HEADER_METRICS, "{}"),
             )
             if verdict is not None:
+                self._reject_update("bad_signature", kind="masked")
                 return verdict
         try:
             with np.load(io.BytesIO(body)) as z:
@@ -1065,11 +1135,13 @@ class HTTPServer:
                     f"expected uint32[{expected_size}], got {masked.dtype}{masked.shape}"
                 )
         except Exception as e:
+            self._reject_update("bad_payload", kind="masked")
             return web.json_response(
                 {"status": "error", "message": f"bad masked payload: {e}"}, status=400
             )
         async with self._lock:
             if round_number != self._round:
+                self._reject_update("stale_round", kind="masked")
                 return web.json_response(
                     {"status": "error",
                      "message": f"update for round {round_number}, server is on {self._round}"},
@@ -1077,6 +1149,7 @@ class HTTPServer:
                 )
             self._masked_updates[client_id] = (masked, metrics)
             accepted = len(self._masked_updates)
+        self._m_updates.inc(kind="masked", result="accepted")
         self._log.info("masked update from %s (round %d, %d buffered)", client_id,
                        round_number, accepted)
         return web.json_response(
@@ -1096,6 +1169,16 @@ class HTTPServer:
 
     async def _handle_test(self, request: web.Request) -> web.StreamResponse:
         return web.json_response({"status": "success", "message": "server is running"})
+
+    async def _handle_metrics(self, request: web.Request) -> web.StreamResponse:
+        """Prometheus text exposition of the attached registry — the whole process's
+        instruments, not just this server's (one scrape sees coordinator round/phase
+        metrics alongside the wire counters)."""
+        text = self.metrics_registry.render_prometheus()
+        return web.Response(
+            body=text.encode("utf-8"),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
 
     # ------------------------------------------------------------------
     # Lifecycle (parity: server.py:319-340)

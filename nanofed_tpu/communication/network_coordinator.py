@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from dataclasses import dataclass, replace
-from typing import Any
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +33,9 @@ from nanofed_tpu.aggregation.robust import (
 )
 from nanofed_tpu.communication.http_server import HTTPServer
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate, Params
-from nanofed_tpu.security.secure_agg import SecureAggregationConfig, unmask_sum
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.observability.spans import SpanTracer
+from nanofed_tpu.observability.telemetry import RunTelemetry
 from nanofed_tpu.security.validation import (
     ValidationConfig,
     ValidationResult,
@@ -42,6 +46,12 @@ from nanofed_tpu.security.validation import (
     validate_shape,
 )
 from nanofed_tpu.utils.logger import Logger
+
+if TYPE_CHECKING:
+    # Imported lazily at runtime: secure_agg needs the ``cryptography`` package,
+    # which the plain (non-secure) network path must not require just to import
+    # this module.
+    from nanofed_tpu.security.secure_agg import SecureAggregationConfig
 
 
 @dataclass(frozen=True)
@@ -218,13 +228,21 @@ class NetworkCoordinator:
         validation: ValidationConfig | None = None,
         secure: SecureAggregationConfig | None = None,
         robust: RobustAggregationConfig | None = None,
+        telemetry_dir: str | Path | None = None,
+        registry: MetricsRegistry | None = None,
     ):
         """``robust`` (a ``RobustAggregationConfig``) swaps the weighted FedAvg of
         drained updates for the coordinate-wise trimmed mean — the network path is
         where actual Byzantine clients live (the simulator's clients are our own
         code).  Incompatible with ``secure``: masked vectors are uniformly random,
         so per-coordinate order statistics are meaningless until after unmasking,
-        and the server never sees unmasked individuals by design."""
+        and the server never sees unmasked individuals by design.
+
+        ``telemetry_dir`` enables the per-run telemetry artifact: every round's
+        phase spans and outcome stream into ``<telemetry_dir>/telemetry.jsonl``
+        (plus a final registry snapshot on ``run()`` exit).  Round metrics and span
+        durations always flow into ``registry`` (default: the server's, so one
+        ``GET /metrics`` scrape covers the wire counters AND the round engine)."""
         if robust is not None and secure is not None:
             raise ValueError(
                 "robust= cannot be combined with secure=: the server only ever "
@@ -268,6 +286,32 @@ class NetworkCoordinator:
         self.robust = robust
         self.history: list[dict[str, Any]] = []
         self._log = Logger()
+        self.metrics_registry = registry or server.metrics_registry
+        self.telemetry = (
+            RunTelemetry(telemetry_dir, registry=self.metrics_registry)
+            if telemetry_dir is not None
+            else None
+        )
+        self._tracer = (
+            self.telemetry.tracer
+            if self.telemetry is not None
+            # keep_records=False: only the histogram consumes these spans — a
+            # long-lived engine must not accumulate every round's records.
+            else SpanTracer(registry=self.metrics_registry, keep_records=False)
+        )
+        self._m_rounds = self.metrics_registry.counter(
+            "nanofed_rounds_total", "Federation rounds by outcome", labels=("status",)
+        )
+        self._m_round_duration = self.metrics_registry.histogram(
+            "nanofed_round_duration_seconds", "Wall time per federation round"
+        )
+        self._m_cohort = self.metrics_registry.gauge(
+            "nanofed_cohort_size", "Clients whose updates entered the last aggregate"
+        )
+        self._m_validation_rejects = self.metrics_registry.counter(
+            "nanofed_validation_rejections_total",
+            "Drained updates rejected by host-path validation",
+        )
 
     async def _wait_for_clients(self, required: int) -> bool:
         """Poll the update buffer until ``required`` updates arrive or timeout
@@ -486,6 +530,8 @@ class NetworkCoordinator:
             return record
         # Clients pre-scaled by their published normalized weight, so the masked
         # modular sum IS the weighted mean once the pairwise masks cancel.
+        from nanofed_tpu.security.secure_agg import unmask_sum
+
         self.params = unmask_sum(
             [masked[c] for c in cohort], self.params, self.secure
         )
@@ -501,17 +547,38 @@ class NetworkCoordinator:
         return record
 
     async def train_round(self, round_number: int) -> dict[str, Any]:
-        await self.server.publish_model(self.params, round_number)
+        """One federation round, instrumented: the round and its phases (publish →
+        cohort-sample → aggregate) are recorded as spans, the outcome lands in
+        ``nanofed_rounds_total`` / ``nanofed_round_duration_seconds``, and — with a
+        ``telemetry_dir`` — the round record is appended to ``telemetry.jsonl``."""
+        t0 = time.perf_counter()
+        with self._tracer.span("round", round=round_number):
+            record = await self._train_round_inner(round_number)
+        duration = time.perf_counter() - t0
+        self._m_rounds.inc(status=str(record.get("status", "?")).lower())
+        self._m_round_duration.observe(duration)
+        self._m_cohort.set(record.get("num_clients", 0))
+        if self.telemetry is not None:
+            self.telemetry.record("round", duration_s=round(duration, 6), **record)
+        return record
+
+    async def _train_round_inner(self, round_number: int) -> dict[str, Any]:
+        with self._tracer.span("publish", round=round_number):
+            await self.server.publish_model(self.params, round_number)
         required = max(1, math.ceil(self.config.min_clients * self.config.min_completion_rate))
         if self.secure is not None:
-            return await self._secure_round(round_number, required)
-        ok = await self._wait_for_clients(required)
-        updates = await self.server.drain_updates()
+            with self._tracer.span("secure-aggregate", round=round_number):
+                return await self._secure_round(round_number, required)
+        with self._tracer.span("cohort-sample", round=round_number):
+            ok = await self._wait_for_clients(required)
+            updates = await self.server.drain_updates()
         num_received = len(updates)
         num_rejected = 0
         if self.validation is not None and updates:
             updates = self._validate_updates(updates)
             num_rejected = num_received - len(updates)
+            if num_rejected:
+                self._m_validation_rejects.inc(num_rejected)
         if not ok or len(updates) < required:
             self._log.warning(
                 "round %d FAILED: %d/%d updates (%d rejected)",
@@ -521,6 +588,20 @@ class NetworkCoordinator:
                       "num_clients": len(updates), "num_rejected": num_rejected}
             self.history.append(record)
             return record
+        with self._tracer.span("aggregate", round=round_number,
+                               num_clients=len(updates)):
+            record = self._aggregate_round(round_number, updates, num_rejected)
+        if record["status"] == "COMPLETED":
+            self._log.info("round %d: %s", round_number, record["metrics"])
+        self.history.append(record)
+        return record
+
+    def _aggregate_round(
+        self, round_number: int, updates: list[ModelUpdate], num_rejected: int
+    ) -> dict[str, Any]:
+        """Stack the drained updates and fold them into the global params (plain
+        weighted FedAvg, or the robust estimator when configured); pure aggregation,
+        split out so the ``aggregate`` span covers exactly the on-device reduce."""
         stacked = stack_model_updates(updates)
         if self.robust is not None:
             # FedAvg over params IS a mean of client params, so the trimmed mean
@@ -542,13 +623,11 @@ class NetworkCoordinator:
                     "round %d FAILED: %d updates < robust floor %d",
                     round_number, len(updates), robust_floor(self.robust),
                 )
-                record = {"round": round_number, "status": "FAILED",
-                          "num_clients": len(updates),
-                          "num_rejected": num_rejected,
-                          "reason": (f"{len(updates)} updates below the robust "
-                                     f"floor {robust_floor(self.robust)}")}
-                self.history.append(record)
-                return record
+                return {"round": round_number, "status": "FAILED",
+                        "num_clients": len(updates),
+                        "num_rejected": num_rejected,
+                        "reason": (f"{len(updates)} updates below the robust "
+                                   f"floor {robust_floor(self.robust)}")}
             self.params = out["params"]
             round_metrics = {"loss": float(out["loss"]),
                              "accuracy": float(out["accuracy"])}
@@ -560,16 +639,13 @@ class NetworkCoordinator:
                 "accuracy": float((stacked.metrics.accuracy * stacked.weights).sum()
                                   / stacked.weights.sum()),
             }
-        record = {
+        return {
             "round": round_number,
             "status": "COMPLETED",
             "num_clients": len(updates),
             "num_rejected": num_rejected,
             "metrics": round_metrics,
         }
-        self.history.append(record)
-        self._log.info("round %d: %s", round_number, record["metrics"])
-        return record
 
     async def _wait_for_buffer(self, k: int) -> int:
         """Async mode: poll until >= k updates are buffered or the timeout expires;
@@ -597,41 +673,58 @@ class NetworkCoordinator:
         k = self.config.async_buffer_k
         version = 0
         for agg_i in range(self.config.num_rounds):
-            await self.server.publish_model(self.params, version)
-            got = await self._wait_for_buffer(k)
-            # Exactly K per aggregation (surplus stays buffered for the next one) —
-            # "buffer of K" means K, or the update-budget accounting lies.
-            updates = await self.server.take_updates(k)
-            if not updates:
-                record = {"aggregation": agg_i, "version": version,
-                          "status": "FAILED", "num_clients": 0,
-                          "reason": f"timeout with an empty buffer (wanted {k})"}
-                self.history.append(record)
-                self._log.warning("aggregation %d FAILED: empty buffer", agg_i)
-                continue
-            # The server's published-version window is the single source of truth
-            # for which bases are still reconstructable — no coordinator-side copy
-            # whose pruning could silently diverge.
-            self.params, stats = fedbuff_combine(
-                self.params, updates, self.server.published_versions, version,
-                staleness_exponent=self.config.staleness_exponent,
-                server_lr=self.config.async_server_lr,
-            )
-            version += 1
-            losses = [_metric(u.metrics, "loss", float("nan")) for u in updates]
-            finite = [v for v in losses if math.isfinite(v)]
-            record = {
-                "aggregation": agg_i, "version": version, "status": "COMPLETED",
-                "num_clients": stats["num_aggregated"],
-                "buffered_at_drain": got,
-                "metrics": {"loss": float(np.mean(finite)) if finite else None},
-                **stats,
-            }
+            t0 = time.perf_counter()
+            with self._tracer.span("round", aggregation=agg_i, version=version):
+                with self._tracer.span("publish", aggregation=agg_i):
+                    await self.server.publish_model(self.params, version)
+                with self._tracer.span("cohort-sample", aggregation=agg_i):
+                    got = await self._wait_for_buffer(k)
+                    # Exactly K per aggregation (surplus stays buffered for the next
+                    # one) — "buffer of K" means K, or the update-budget accounting
+                    # lies.
+                    updates = await self.server.take_updates(k)
+                if not updates:
+                    record = {"aggregation": agg_i, "version": version,
+                              "status": "FAILED", "num_clients": 0,
+                              "reason": f"timeout with an empty buffer (wanted {k})"}
+                    self._log.warning("aggregation %d FAILED: empty buffer", agg_i)
+                else:
+                    # The server's published-version window is the single source of
+                    # truth for which bases are still reconstructable — no
+                    # coordinator-side copy whose pruning could silently diverge.
+                    with self._tracer.span("aggregate", aggregation=agg_i,
+                                           num_clients=len(updates)):
+                        self.params, stats = fedbuff_combine(
+                            self.params, updates, self.server.published_versions,
+                            version,
+                            staleness_exponent=self.config.staleness_exponent,
+                            server_lr=self.config.async_server_lr,
+                        )
+                    version += 1
+                    losses = [_metric(u.metrics, "loss", float("nan")) for u in updates]
+                    finite = [v for v in losses if math.isfinite(v)]
+                    record = {
+                        "aggregation": agg_i, "version": version,
+                        "status": "COMPLETED",
+                        "num_clients": stats["num_aggregated"],
+                        "buffered_at_drain": got,
+                        "metrics": {"loss": float(np.mean(finite)) if finite else None},
+                        **stats,
+                    }
+                    self._log.info(
+                        "aggregation %d -> version %d: %d updates, staleness %s",
+                        agg_i, version, stats["num_aggregated"], stats["staleness"],
+                    )
             self.history.append(record)
-            self._log.info(
-                "aggregation %d -> version %d: %d updates, staleness %s", agg_i,
-                version, stats["num_aggregated"], stats["staleness"],
-            )
+            duration = time.perf_counter() - t0
+            self._m_rounds.inc(status=record["status"].lower())
+            self._m_round_duration.observe(duration)
+            self._m_cohort.set(record["num_clients"])
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "round", duration_s=round(duration, 6),
+                    **{key: v for key, v in record.items() if key != "discounts"},
+                )
         await self.server.publish_model(self.params, version)
         self.server.stop_training()
         return self.history
@@ -645,6 +738,15 @@ class NetworkCoordinator:
         With ``async_buffer_k`` set, runs the FedBuff loop instead (see
         ``_run_async``): no cohort barrier, aggregations fire on buffer fill.
         """
+        try:
+            return await self._run_all_rounds()
+        finally:
+            # Final metrics snapshot + handle release; a raised enrollment timeout
+            # still leaves every completed round's telemetry on disk.
+            if self.telemetry is not None:
+                self.telemetry.close()
+
+    async def _run_all_rounds(self) -> list[dict[str, Any]]:
         if self.config.async_buffer_k is not None:
             return await self._run_async()
         if self.secure is not None:

@@ -70,6 +70,7 @@ def run_experiment(
     robust_trim_k: int | None = None,
     robust_method: str | None = None,
     scaffold: bool = False,
+    telemetry_dir: str | Path | None = None,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -126,6 +127,7 @@ def run_experiment(
         client_chunk=client_chunk,
         robust=robust,
         scaffold=scaffold,
+        telemetry_dir=telemetry_dir,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()

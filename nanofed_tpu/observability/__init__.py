@@ -1,0 +1,48 @@
+"""Observability subsystem: metrics registry, federation spans, run telemetry.
+
+The capability SURVEY.md §5 calls out as missing from the reference (whose only
+instrument is a wall-time decorator), built natively: a zero-dependency, thread-safe
+:class:`MetricsRegistry` (counters / gauges / histograms with labels, Prometheus text
+exposition — served at ``GET /metrics`` by ``communication.http_server``), a nestable
+:class:`SpanTracer` for the federation loop's phase structure (round → cohort-sample →
+local-train → aggregate → publish; JSONL + Chrome-trace export, composing with the
+device captures from ``utils.profiling.trace``), and :class:`RunTelemetry`, the per-run
+``telemetry.jsonl`` artifact both coordinators write.
+
+See ``docs/observability.md`` for the span taxonomy, metric inventory, and how to
+scrape ``/metrics`` or read ``telemetry.jsonl``.
+"""
+
+from nanofed_tpu.observability.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from nanofed_tpu.observability.spans import SPAN_HISTOGRAM, SpanRecord, SpanTracer
+from nanofed_tpu.observability.telemetry import (
+    TELEMETRY_FILENAME,
+    RunTelemetry,
+    find_latest_telemetry,
+    install_jax_event_bridge,
+    summarize_telemetry,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RunTelemetry",
+    "SPAN_HISTOGRAM",
+    "SpanRecord",
+    "SpanTracer",
+    "TELEMETRY_FILENAME",
+    "find_latest_telemetry",
+    "get_registry",
+    "install_jax_event_bridge",
+    "summarize_telemetry",
+]

@@ -1,0 +1,233 @@
+"""Per-run telemetry artifact and the JAX-event bridge.
+
+``RunTelemetry`` owns one run's ``telemetry.jsonl``: an append-only stream of typed
+JSON records — ``span`` records streamed from a :class:`~nanofed_tpu.observability.
+spans.SpanTracer` as each phase closes, ``round`` records appended by the coordinator
+after each round, and a final ``metrics_snapshot`` of the whole registry on ``close()``.
+Append-per-record (with a flush) means a crashed run still has every completed round
+and phase on disk — the failure mode the reference's end-of-run metrics JSON cannot
+cover.
+
+``install_jax_event_bridge`` forwards ``jax.monitoring`` events (compilation-cache
+hits/misses, backend init, compile durations) into the metrics registry, which is how
+the coordinator's "compile-cache hits" show up on ``/metrics`` without touching any
+private JAX API surface.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.observability.spans import SpanRecord, SpanTracer
+
+TELEMETRY_FILENAME = "telemetry.jsonl"
+
+
+class RunTelemetry:
+    """One run's telemetry sink: a tracer wired to stream spans into
+    ``<run_dir>/telemetry.jsonl``, plus typed record appends for round results.
+
+    Usage (what both coordinators do)::
+
+        tel = RunTelemetry(run_dir)
+        with tel.span("round", round=r):
+            with tel.span("local-train"):
+                ...
+        tel.record("round", round=r, status="COMPLETED", duration_s=1.2)
+        ...
+        tel.close()   # appends the final metrics_snapshot record
+    """
+
+    def __init__(
+        self,
+        run_dir: str | Path,
+        registry: MetricsRegistry | None = None,
+        annotate_device: bool = True,
+    ) -> None:
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.path = self.run_dir / TELEMETRY_FILENAME
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        # Line-buffered append handle: one flushed write per record, so concurrent
+        # writers (the aiohttp loop + worker threads) interleave whole lines only.
+        self._file = self.path.open("a", buffering=1)
+        self._closed = False
+        self.tracer = SpanTracer(
+            registry=self.registry,
+            on_close=self._on_span_close,
+            annotate_device=annotate_device,
+        )
+
+    def span(self, name: str, **attrs: Any):
+        return self.tracer.span(name, **attrs)
+
+    def _on_span_close(self, record: SpanRecord) -> None:
+        self.record("span", **record.to_dict())
+
+    def record(self, record_type: str, **fields: Any) -> None:
+        """Append one typed JSON line; silently a no-op after ``close()`` (a late
+        straggler span must not raise inside a finally block)."""
+        line = json.dumps({"type": record_type, "t": round(time.time(), 3), **fields})
+        with self._lock:
+            if self._closed:
+                return
+            self._file.write(line + "\n")
+
+    def close(self) -> None:
+        """Append the final registry snapshot and release the file handle.
+        Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            snapshot = json.dumps(
+                {"type": "metrics_snapshot", "t": round(time.time(), 3),
+                 "metrics": self.registry.snapshot()}
+            )
+            self._file.write(snapshot + "\n")
+            self._closed = True
+            self._file.close()
+
+
+_jax_bridge_installed = False
+_jax_bridge_lock = threading.Lock()
+
+
+def _sanitize_event(event: str) -> str:
+    """JAX event names are slash-paths ('/jax/compilation_cache/cache_hits');
+    keep them readable as label VALUES but drop anything exotic."""
+    return re.sub(r"[^a-zA-Z0-9_/.:-]", "_", event)
+
+
+def install_jax_event_bridge(registry: MetricsRegistry | None = None) -> bool:
+    """Forward ``jax.monitoring`` events into the registry (idempotent, process-wide):
+
+    * ``nanofed_jax_events_total{event=...}`` — occurrence counters; compilation-cache
+      hits arrive as ``/jax/compilation_cache/cache_hits``.
+    * ``nanofed_jax_event_duration_seconds{event=...}`` — duration events (backend
+      init, tracing, compilation).
+
+    Returns False when JAX's monitoring module is unavailable.  Only ever installs
+    against ONE registry (the first caller's): jax.monitoring keeps listeners forever,
+    so re-installing per-run would double-count.
+    """
+    global _jax_bridge_installed
+    with _jax_bridge_lock:
+        if _jax_bridge_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        reg = registry or get_registry()
+        events = reg.counter(
+            "nanofed_jax_events_total",
+            "jax.monitoring occurrence events (compile-cache hits/misses, ...)",
+            labels=("event",),
+        )
+        durations = reg.histogram(
+            "nanofed_jax_event_duration_seconds",
+            "jax.monitoring duration events (backend init, compilation, ...)",
+            labels=("event",),
+        )
+
+        def _on_event(event: str, **kwargs: Any) -> None:
+            events.inc(event=_sanitize_event(event))
+
+        def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
+            durations.observe(float(duration), event=_sanitize_event(event))
+
+        try:
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _jax_bridge_installed = True
+        return True
+
+
+def find_latest_telemetry(root: str | Path) -> Path | None:
+    """The most recently modified ``telemetry.jsonl`` under ``root`` (``root`` may
+    also point directly at a run dir or at the file itself)."""
+    root = Path(root)
+    if root.is_file():
+        return root
+    direct = root / TELEMETRY_FILENAME
+    if direct.exists():
+        return direct
+    candidates = sorted(
+        root.glob(f"**/{TELEMETRY_FILENAME}"), key=lambda p: p.stat().st_mtime
+    )
+    return candidates[-1] if candidates else None
+
+
+def summarize_telemetry(path: str | Path) -> dict[str, Any]:
+    """Digest one ``telemetry.jsonl``: per-phase span stats (count/total/mean/p50/max),
+    round outcomes, and headline counters from the final metrics snapshot.  This is
+    the ``nanofed-tpu metrics-summary`` subcommand's engine — pure, so it is
+    unit-testable without running a federation."""
+    path = Path(path)
+    spans: dict[str, list[float]] = {}
+    rounds: dict[str, int] = {}
+    round_durations: list[float] = []
+    snapshot: dict[str, Any] | None = None
+    malformed = 0
+    with path.open() as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                malformed += 1  # a crash mid-write leaves at most one torn tail line
+                continue
+            rtype = rec.get("type")
+            if rtype == "span":
+                spans.setdefault(rec.get("name", "?"), []).append(
+                    float(rec.get("duration_s", 0.0))
+                )
+            elif rtype == "round":
+                status = str(rec.get("status", "?"))
+                rounds[status] = rounds.get(status, 0) + 1
+                if "duration_s" in rec:
+                    round_durations.append(float(rec["duration_s"]))
+            elif rtype == "metrics_snapshot":
+                snapshot = rec.get("metrics")
+
+    def _digest(durs: list[float]) -> dict[str, float]:
+        durs = sorted(durs)
+        n = len(durs)
+        return {
+            "count": n,
+            "total_s": round(math.fsum(durs), 6),
+            "mean_s": round(math.fsum(durs) / n, 6),
+            "p50_s": round(durs[n // 2], 6),
+            "max_s": round(durs[-1], 6),
+        }
+
+    out: dict[str, Any] = {
+        "telemetry": str(path),
+        "rounds": rounds,
+        "phases": {name: _digest(d) for name, d in sorted(spans.items())},
+    }
+    if round_durations:
+        out["round_duration"] = _digest(round_durations)
+    if snapshot is not None:
+        headline = {}
+        for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",
+                     "nanofed_bytes_sent_total", "nanofed_updates_total",
+                     "nanofed_dropouts_total"):
+            if name in snapshot:
+                headline[name] = snapshot[name]["values"]
+        out["counters"] = headline
+    if malformed:
+        out["malformed_lines"] = malformed
+    return out

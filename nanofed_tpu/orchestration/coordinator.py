@@ -39,6 +39,9 @@ from nanofed_tpu.aggregation.fedavg import compute_weights
 from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import ClientData, Params
 from nanofed_tpu.models.base import Model
+from nanofed_tpu.observability.registry import get_registry
+from nanofed_tpu.observability.spans import SpanTracer
+from nanofed_tpu.observability.telemetry import RunTelemetry, install_jax_event_bridge
 from nanofed_tpu.orchestration.types import RoundMetrics, RoundStatus, TrainingProgress
 from nanofed_tpu.parallel.mesh import (
     make_mesh,
@@ -126,6 +129,7 @@ class Coordinator:
         robust=None,
         scaffold: bool = False,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
+        telemetry_dir: str | Path | None = None,
     ) -> None:
         self.model = model
         self.config = config
@@ -319,6 +323,40 @@ class Coordinator:
         if config.save_metrics:
             (self.base_dir / "metrics").mkdir(parents=True, exist_ok=True)
 
+        # Observability: round/phase metrics always flow into the process registry;
+        # with save_metrics (or an explicit telemetry_dir) the run additionally gets
+        # a telemetry.jsonl artifact of every phase span and round record.  The JAX
+        # event bridge surfaces compile-cache hits/misses alongside them.
+        install_jax_event_bridge()
+        tel_dir = (
+            Path(telemetry_dir)
+            if telemetry_dir is not None
+            else (self.base_dir if config.save_metrics else None)
+        )
+        self.telemetry = RunTelemetry(tel_dir) if tel_dir is not None else None
+        self._tracer = (
+            self.telemetry.tracer
+            if self.telemetry is not None
+            # keep_records=False: only the histogram consumes these spans — a
+            # long-lived engine must not accumulate every round's records.
+            else SpanTracer(keep_records=False)
+        )
+        _registry = (
+            self.telemetry.registry if self.telemetry is not None else get_registry()
+        )
+        self._m_rounds = _registry.counter(
+            "nanofed_rounds_total", "Federation rounds by outcome", labels=("status",)
+        )
+        self._m_round_duration = _registry.histogram(
+            "nanofed_round_duration_seconds", "Wall time per federation round"
+        )
+        self._m_cohort = _registry.gauge(
+            "nanofed_cohort_size", "Clients whose updates entered the last aggregate"
+        )
+        self._m_dropouts = _registry.counter(
+            "nanofed_dropouts_total", "Sampled clients that dropped out of a round"
+        )
+
         # Resume (improvement over the reference, where recovery isn't integrated).
         if self.state_store is not None:
             restored = self.state_store.restore_latest()
@@ -387,55 +425,74 @@ class Coordinator:
         """Generator over rounds (parity with the async generator
         ``Coordinator.start_training``, ``coordinator.py:384-405``)."""
         with self._log.context("coordinator"):
-            while self.current_round < self.config.num_rounds:
-                metrics = self._train_round(self.current_round)
-                self.history.append(metrics)
-                # The checkpoint is written FIRST, before any released artifact of the
-                # round (metrics JSON, versioned model): a crash between them then
-                # loses at most an artifact, never an accounting event.  The reverse
-                # order would let a persisted noised release outlive its accountant
-                # entry — a resumed run would re-release round r with fresh noise
-                # while reporting an ε that counts only one of the two releases.
-                if self.state_store is not None:
-                    ckpt_metrics = metrics.to_dict()
-                    if self.privacy_accountant is not None:
-                        ckpt_metrics["privacy_accountant"] = (
-                            self.privacy_accountant.state_dict()
-                        )
-                    ckpt_server_state = self.server_state
-                    if self.scaffold:
-                        # The controls ARE round state: resuming without them would
-                        # silently restart every client's correction from zero.
-                        ckpt_server_state = {
-                            "opt": self.server_state,
-                            "scaffold_c_global": self.c_global,
-                            "scaffold_c_stack": self.c_stack,
-                        }
-                    self.state_store.checkpoint(
-                        round_number=metrics.round_id,
-                        params=self.params,
-                        server_state=ckpt_server_state,
-                        metrics=ckpt_metrics,
-                        status=(
-                            "COMPLETED"
-                            if metrics.status == RoundStatus.COMPLETED
-                            else "FAILED"
-                        ),
-                    )
-                if self.config.save_metrics:
-                    self._save_round_metrics(metrics)
-                if self.model_manager is not None and metrics.status == RoundStatus.COMPLETED:
-                    self.model_manager.save_model(
-                        self.params,
-                        metadata={
-                            "round": metrics.round_id,
-                            "metrics": metrics.agg_metrics,
-                        },
-                    )
-                if self.on_round_end is not None:
-                    self.on_round_end(metrics)
-                self.current_round += 1
-                yield metrics
+            try:
+                while self.current_round < self.config.num_rounds:
+                    metrics = self._train_round(self.current_round)
+                    self.history.append(metrics)
+                    with self._tracer.span("publish", round=metrics.round_id):
+                        self._publish_round(metrics)
+                    if self.on_round_end is not None:
+                        self.on_round_end(metrics)
+                    self.current_round += 1
+                    yield metrics
+            finally:
+                # Final registry snapshot only when ALL rounds ran: a caller that
+                # abandons the generator early (early stopping, interrupt) may
+                # resume via a fresh start_training() on the same coordinator, and
+                # a closed sink would silently drop every later record.  The cost
+                # of not closing on abandonment is an open line-buffered handle
+                # (every record is already flushed) and no metrics_snapshot line.
+                if (
+                    self.telemetry is not None
+                    and self.current_round >= self.config.num_rounds
+                ):
+                    self.telemetry.close()
+
+    def _publish_round(self, metrics: RoundMetrics) -> None:
+        """Release the round's artifacts — checkpoint, metrics JSON, versioned model.
+
+        The checkpoint is written FIRST, before any released artifact of the
+        round (metrics JSON, versioned model): a crash between them then
+        loses at most an artifact, never an accounting event.  The reverse
+        order would let a persisted noised release outlive its accountant
+        entry — a resumed run would re-release round r with fresh noise
+        while reporting an ε that counts only one of the two releases."""
+        if self.state_store is not None:
+            ckpt_metrics = metrics.to_dict()
+            if self.privacy_accountant is not None:
+                ckpt_metrics["privacy_accountant"] = (
+                    self.privacy_accountant.state_dict()
+                )
+            ckpt_server_state = self.server_state
+            if self.scaffold:
+                # The controls ARE round state: resuming without them would
+                # silently restart every client's correction from zero.
+                ckpt_server_state = {
+                    "opt": self.server_state,
+                    "scaffold_c_global": self.c_global,
+                    "scaffold_c_stack": self.c_stack,
+                }
+            self.state_store.checkpoint(
+                round_number=metrics.round_id,
+                params=self.params,
+                server_state=ckpt_server_state,
+                metrics=ckpt_metrics,
+                status=(
+                    "COMPLETED"
+                    if metrics.status == RoundStatus.COMPLETED
+                    else "FAILED"
+                ),
+            )
+        if self.config.save_metrics:
+            self._save_round_metrics(metrics)
+        if self.model_manager is not None and metrics.status == RoundStatus.COMPLETED:
+            self.model_manager.save_model(
+                self.params,
+                metadata={
+                    "round": metrics.round_id,
+                    "metrics": metrics.agg_metrics,
+                },
+            )
 
     def _sample_cohort(self, round_id: int) -> np.ndarray:
         """Draw this round's participant cohort (replaces the HTTP wait barrier),
@@ -461,9 +518,30 @@ class Coordinator:
 
     @log_exec
     def _train_round(self, round_id: int) -> RoundMetrics:
+        """One round, instrumented: the round and its phases land as spans (and in
+        the ``nanofed_span_duration_seconds`` histogram), the outcome in
+        ``nanofed_rounds_total`` / ``nanofed_round_duration_seconds``, and — when
+        telemetry is on — as a ``round`` record in ``telemetry.jsonl``."""
+        t0 = time.perf_counter()
+        with self._tracer.span("round", round=round_id):
+            metrics = self._train_round_impl(round_id)
+        duration = time.perf_counter() - t0
+        self._m_rounds.inc(status=metrics.status.name.lower())
+        self._m_round_duration.observe(duration)
+        self._m_cohort.set(metrics.num_clients)
+        self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "round", round=round_id, status=metrics.status.name,
+                num_clients=metrics.num_clients, duration_s=round(duration, 6),
+            )
+        return metrics
+
+    def _train_round_impl(self, round_id: int) -> RoundMetrics:
         t0 = time.perf_counter()
         cohort = self.cohort_size
-        survived = self._sample_cohort(round_id)
+        with self._tracer.span("cohort-sample", round=round_id):
+            survived = self._sample_cohort(round_id)
         required = int(np.ceil(cohort * self.config.min_completion_rate))
         if len(survived) < max(required, 1):
             self._log.warning(
@@ -478,24 +556,26 @@ class Coordinator:
                 timestamp=_now_iso(),
             )
 
-        if self._cohort_mode:
-            # Gather the cohort's rows.  Dropped + padding slots point at row 0 with
-            # weight 0: their CONTRIBUTION is zero in every reduce, though their
-            # (static-shape) local fit still executes — the waste is bounded by the
-            # dropout fraction + device padding of K_pad, vs the full-N path burning
-            # N - K slots every round.
-            idx = np.zeros(self._step_clients, dtype=np.int32)
-            idx[: len(survived)] = survived
-            mask = np.zeros(self._step_clients, dtype=np.float32)
-            mask[: len(survived)] = 1.0
-            idx_dev = jnp.asarray(idx)
-            data = self._gather_cohort(self._data, idx_dev)
-            weights = compute_weights(self._num_samples[idx_dev], jnp.asarray(mask))
-        else:
-            data = self._data
-            mask = np.zeros(self._padded_clients, dtype=np.float32)
-            mask[survived] = 1.0
-            weights = compute_weights(self._num_samples, jnp.asarray(mask))
+        with self._tracer.span("cohort-gather", round=round_id,
+                               cohort=len(survived)):
+            if self._cohort_mode:
+                # Gather the cohort's rows.  Dropped + padding slots point at row 0
+                # with weight 0: their CONTRIBUTION is zero in every reduce, though
+                # their (static-shape) local fit still executes — the waste is
+                # bounded by the dropout fraction + device padding of K_pad, vs the
+                # full-N path burning N - K slots every round.
+                idx = np.zeros(self._step_clients, dtype=np.int32)
+                idx[: len(survived)] = survived
+                mask = np.zeros(self._step_clients, dtype=np.float32)
+                mask[: len(survived)] = 1.0
+                idx_dev = jnp.asarray(idx)
+                data = self._gather_cohort(self._data, idx_dev)
+                weights = compute_weights(self._num_samples[idx_dev], jnp.asarray(mask))
+            else:
+                data = self._data
+                mask = np.zeros(self._padded_clients, dtype=np.float32)
+                mask[survived] = 1.0
+                weights = compute_weights(self._num_samples, jnp.asarray(mask))
 
         # Device RNG stack: seed-deterministic without DP.  Under central DP the round
         # step derives the server NOISE key from this stack (round_step.py
@@ -526,65 +606,75 @@ class Coordinator:
             decay_every=self.config.lr_decay_every,
             gamma=self.config.lr_decay_gamma,
         )
-        if self.scaffold:
-            c_rows = (
-                self._gather_controls(self.c_stack, idx_dev)
-                if self._cohort_mode
-                else self.c_stack
-            )
-            result = self._round_step(
-                self.params, self.server_state, self.c_global, c_rows,
-                data, weights, rngs, jnp.float32(lr_scale),
-            )
-            self.c_global = result.c_global
-            if self._cohort_mode:
-                # Participants' control rows move by their delta; padding/dropped
-                # slots add exact zeros (collision-safe though they alias row 0).
-                self.c_stack = self._scatter_add_controls(
-                    self.c_stack, idx_dev, result.delta_c
+        # The device step fuses local training AND the psum aggregation into one XLA
+        # program, so "local-train" covers both (attr says so); "aggregate" below is
+        # the host-side post-aggregation work.  block_until_ready inside the span
+        # makes its duration the real device time, not dispatch time.
+        with self._tracer.span("local-train", round=round_id,
+                               fused="train+aggregate"):
+            if self.scaffold:
+                c_rows = (
+                    self._gather_controls(self.c_stack, idx_dev)
+                    if self._cohort_mode
+                    else self.c_stack
                 )
+                result = self._round_step(
+                    self.params, self.server_state, self.c_global, c_rows,
+                    data, weights, rngs, jnp.float32(lr_scale),
+                )
+                self.c_global = result.c_global
+                if self._cohort_mode:
+                    # Participants' control rows move by their delta; padding/dropped
+                    # slots add exact zeros (collision-safe though they alias row 0).
+                    self.c_stack = self._scatter_add_controls(
+                        self.c_stack, idx_dev, result.delta_c
+                    )
+                else:
+                    # Rows already align with the stack — a fused elementwise add,
+                    # not a scatter (which GSPMD may lower with cross-device index
+                    # traffic).
+                    self.c_stack = self._add_controls(self.c_stack, result.delta_c)
             else:
-                # Rows already align with the stack — a fused elementwise add, not a
-                # scatter (which GSPMD may lower with cross-device index traffic).
-                self.c_stack = self._add_controls(self.c_stack, result.delta_c)
-        else:
-            result = self._round_step(
-                self.params, self.server_state, data, weights, rngs,
-                jnp.float32(lr_scale),
-            )
-        self.params = result.params
-        self.server_state = result.server_opt_state
+                result = self._round_step(
+                    self.params, self.server_state, data, weights, rngs,
+                    jnp.float32(lr_scale),
+                )
+            self.params = result.params
+            self.server_state = result.server_opt_state
+            jax.block_until_ready(self.params)
 
-        agg = {k: float(v) for k, v in result.metrics.items()}
-        if self.config.lr_schedule != "constant":
-            agg["lr_scale"] = round(lr_scale, 6)
-        for count_key in ("participating_clients", "valid_clients"):
-            if count_key in agg:
-                agg[count_key] = int(agg[count_key])
+        with self._tracer.span("aggregate", round=round_id):
+            agg = {k: float(v) for k, v in result.metrics.items()}
+            if self.config.lr_schedule != "constant":
+                agg["lr_scale"] = round(lr_scale, 6)
+            for count_key in ("participating_clients", "valid_clients"):
+                if count_key in agg:
+                    agg[count_key] = int(agg[count_key])
 
-        if self.privacy_accountant is not None:
-            from nanofed_tpu.aggregation.privacy import record_central_privacy
+            if self.privacy_accountant is not None:
+                from nanofed_tpu.aggregation.privacy import record_central_privacy
 
-            record_central_privacy(
-                self.privacy_accountant,
-                self.central_privacy,
-                sampling_rate=self.cohort_size / self.num_clients,
-            )
-            spent = self.privacy_accountant.get_privacy_spent(
-                self.central_privacy.privacy.delta
-            )
-            agg["privacy_epsilon"] = spent.epsilon_spent
-            agg["privacy_delta"] = spent.delta_spent
+                record_central_privacy(
+                    self.privacy_accountant,
+                    self.central_privacy,
+                    sampling_rate=self.cohort_size / self.num_clients,
+                )
+                spent = self.privacy_accountant.get_privacy_spent(
+                    self.central_privacy.privacy.delta
+                )
+                agg["privacy_epsilon"] = spent.epsilon_spent
+                agg["privacy_delta"] = spent.delta_spent
 
-        eval_metrics: dict[str, float] = {}
-        if (
-            self._evaluator is not None
-            and self.config.eval_every > 0
-            and (round_id + 1) % self.config.eval_every == 0
-        ):
-            eval_metrics = {
-                k: float(v) for k, v in self._evaluator(self.params, self._eval_data).items()
-            }
+            eval_metrics: dict[str, float] = {}
+            if (
+                self._evaluator is not None
+                and self.config.eval_every > 0
+                and (round_id + 1) % self.config.eval_every == 0
+            ):
+                eval_metrics = {
+                    k: float(v)
+                    for k, v in self._evaluator(self.params, self._eval_data).items()
+                }
 
         # Per-client detail for the metrics file (parity: coordinator.py:247-280).  Only
         # consumed by _save_round_metrics — skip the device->host transfers otherwise.

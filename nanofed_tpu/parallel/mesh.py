@@ -22,6 +22,30 @@ from nanofed_tpu.core.types import ClientData
 
 CLIENT_AXIS = "clients"
 
+# shard_map graduated from jax.experimental into the jax namespace; support both so
+# the round-step builders run on every JAX the image may carry (same call signature).
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - depends on the installed jax version
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def pcast_varying(tree, axis_name: str):
+    """Mark a replicated pytree as device-varying inside a ``shard_map`` body.
+
+    Newer JAX's replication checker requires the explicit ``lax.pcast(...,
+    to="varying")`` before replicated inputs feed per-device compute; older JAX has
+    no pcast (and no varying/unvarying distinction at the type level), where the
+    identity is exactly equivalent.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return jax.tree.map(
+            lambda x: lax.pcast(x, (axis_name,), to="varying"), tree
+        )
+    return tree
+
 
 def initialize_distributed(
     coordinator_address: str | None = None,

@@ -29,7 +29,7 @@ from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_met
 from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
 from nanofed_tpu.aggregation.robust import RobustAggregationConfig, robust_aggregate
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
-from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.parallel.mesh import CLIENT_AXIS, pcast_varying, shard_map
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
 from nanofed_tpu.security.validation import (
     ValidationConfig,
@@ -234,7 +234,7 @@ def build_round_step(
     def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale):
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
-        gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
+        gp_v = pcast_varying(gp, axis_name)
         # The schedule scale is replicated data closed over by the per-client fit (the
         # same scalar for every client in the round).
         fit = (
@@ -365,7 +365,7 @@ def build_round_step(
         sq_norms = jax.vmap(tree_sq_norm)(delta)
         return new_gp, new_sos, metrics, result.metrics, sq_norms
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P(), P()),

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
 from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
-from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.parallel.mesh import CLIENT_AXIS, pcast_varying, shard_map
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn
 from nanofed_tpu.trainer.scaffold import make_scaffold_local_fit
@@ -93,8 +93,8 @@ def build_scaffold_round_step(
     local_fit = make_scaffold_local_fit(apply_fn, training, grad_fn=grad_fn)
 
     def shard_body(gp, sos, c_global, c_stack, data: ClientData, weights, rngs, lr_scale):
-        gp_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), gp)
-        cg_v = jax.tree.map(lambda x: lax.pcast(x, (axis_name,), to="varying"), c_global)
+        gp_v = pcast_varying(gp, axis_name)
+        cg_v = pcast_varying(c_global, axis_name)
         fit = lambda g, d, r, ci: local_fit(g, d, r, cg_v, ci, lr_scale=lr_scale)
         c_local = rngs.shape[0]
         chunking = client_chunk is not None and client_chunk < c_local
@@ -151,7 +151,7 @@ def build_scaffold_round_step(
         sq_norms = jax.vmap(tree_sq_norm)(delta_y)
         return new_gp, new_sos, new_c_global, delta_c, metrics, result.metrics, sq_norms
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(axis_name), P(axis_name), P(axis_name),

@@ -36,17 +36,37 @@ from dataclasses import dataclass
 from typing import Iterable, Sequence
 
 import numpy as np
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    _CRYPTOGRAPHY_ERROR: str | None = None
+except ImportError as _e:  # pragma: no cover - depends on the environment
+    # The pure fixed-point/Shamir/mask arithmetic (quantize, dequantize, PRG
+    # expansion, share reconstruction) is numpy-only and must stay importable
+    # without the optional ``cryptography`` package; anything touching X25519 /
+    # HKDF / AES-GCM raises a pointed error at call time instead.
+    hashes = serialization = AESGCM = HKDF = None  # type: ignore[assignment]
+    X25519PrivateKey = X25519PublicKey = None  # type: ignore[assignment]
+    _CRYPTOGRAPHY_ERROR = str(_e)
 
 from nanofed_tpu.core.exceptions import AggregationError
 from nanofed_tpu.core.types import Params
 from nanofed_tpu.utils.trees import tree_ravel
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise ImportError(
+            "secure aggregation's key agreement and share sealing require the "
+            f"'cryptography' package, which failed to import: {_CRYPTOGRAPHY_ERROR}"
+        )
 
 
 @dataclass(frozen=True)
@@ -111,6 +131,7 @@ class ClientKeyPair:
 
     @staticmethod
     def generate() -> "ClientKeyPair":
+        _require_cryptography()
         return ClientKeyPair(private=X25519PrivateKey.generate())
 
     def public_bytes(self) -> bytes:
@@ -125,6 +146,7 @@ def _pair_seed(my_key: ClientKeyPair, peer_public: bytes, round_context: bytes) 
     Symmetric by construction (X25519(sk_i, pk_j) == X25519(sk_j, pk_i)), so both ends of
     the pair expand the identical mask and the ± cancellation is exact.
     """
+    _require_cryptography()
     shared = my_key.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
     return HKDF(
         algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg", info=round_context
@@ -148,6 +170,7 @@ def _prg_uint32(seed: bytes, size: int) -> np.ndarray:
 def _self_mask_seed(self_seed: bytes, round_context: bytes) -> bytes:
     """Per-round self-mask seed: the enrollment-time 32-byte secret ``b_i`` is shared
     ONCE, so each round's self mask must be a fresh derivation bound to the round."""
+    _require_cryptography()
     return HKDF(
         algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg-self",
         info=round_context,
@@ -464,6 +487,7 @@ def _transport_key(my_key: ClientKeyPair, peer_public: bytes) -> bytes:
     """Pairwise AES-256 key for share transport through the (untrusted-for-content)
     server — an HKDF derivation of the same X25519 agreement as the mask seeds, under
     a DIFFERENT salt so transport keys and mask seeds are cryptographically independent."""
+    _require_cryptography()
     shared = my_key.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
     return HKDF(
         algorithm=hashes.SHA256(), length=32, salt=b"nanofed-tpu-secagg-share",
@@ -683,6 +707,7 @@ def recover_unmasked_sum(
     Returns the corrected uint32 sum = the quantized weighted sum of the SURVIVORS'
     updates; the caller dequantizes and renormalizes by the survivors' weight mass.
     """
+    _require_cryptography()
     config = config or SecureAggregationConfig()
     t = config.threshold
     survivors = [c for c in client_order if c in masked_updates]
@@ -770,6 +795,7 @@ class TransportBox:
     """
 
     def __init__(self, key: bytes | None = None) -> None:
+        _require_cryptography()
         self._key = key if key is not None else AESGCM.generate_key(bit_length=256)
 
     @property

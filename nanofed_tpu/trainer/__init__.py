@@ -2,7 +2,12 @@
 TorchTrainer/PrivateTrainer/TrainingConfig/Callback/MetricsLogger)."""
 
 from nanofed_tpu.trainer.api import Trainer
-from nanofed_tpu.trainer.callbacks import BaseCallback, Callback, MetricsLogger
+from nanofed_tpu.trainer.callbacks import (
+    BaseCallback,
+    Callback,
+    MetricsLogger,
+    TelemetryCallback,
+)
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import (
     LocalFitResult,
@@ -35,6 +40,7 @@ from nanofed_tpu.trainer.private import (
 __all__ = [
     "BaseCallback",
     "Callback",
+    "TelemetryCallback",
     "LocalFitResult",
     "MetricsLogger",
     "ScaffoldFitResult",

@@ -12,8 +12,11 @@ is post-hoc.
 from __future__ import annotations
 
 import json
+import math
 from pathlib import Path
 from typing import Any, Protocol, runtime_checkable
+
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
 
 
 @runtime_checkable
@@ -38,6 +41,66 @@ class BaseCallback:
 
     def on_batch_end(self, epoch: int, batch: int, metrics: dict[str, Any]) -> None:  # noqa: B027
         pass
+
+
+class TelemetryCallback(BaseCallback):
+    """Bridges per-epoch / per-batch local-training metrics into the metrics
+    registry (observability subsystem), so client-side training progress shows up
+    on ``GET /metrics`` next to the round engine's counters.
+
+    Per-epoch: ``nanofed_local_epochs_total{client=...}`` increments and the last
+    loss/accuracy land in ``nanofed_local_last_loss`` / ``_last_accuracy`` gauges,
+    with the loss distribution in the ``nanofed_local_epoch_loss`` histogram.
+    Per-batch: ``nanofed_local_batches_total{client=...}``.  Non-numeric or
+    non-finite metric values are skipped (the callback must never fail training).
+    """
+
+    def __init__(self, client_id: str = "client",
+                 registry: MetricsRegistry | None = None) -> None:
+        self._client_id = client_id
+        reg = registry or get_registry()
+        self._epochs = reg.counter(
+            "nanofed_local_epochs_total", "Local training epochs completed",
+            labels=("client",),
+        )
+        self._batches = reg.counter(
+            "nanofed_local_batches_total", "Local training batches completed",
+            labels=("client",),
+        )
+        self._last_loss = reg.gauge(
+            "nanofed_local_last_loss", "Last epoch's training loss",
+            labels=("client",),
+        )
+        self._last_accuracy = reg.gauge(
+            "nanofed_local_last_accuracy", "Last epoch's training accuracy",
+            labels=("client",),
+        )
+        self._loss_hist = reg.histogram(
+            "nanofed_local_epoch_loss", "Per-epoch training loss distribution",
+            labels=("client",),
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0),
+        )
+
+    @staticmethod
+    def _finite(metrics: dict[str, Any], key: str) -> float | None:
+        try:
+            v = float(metrics.get(key))
+        except (TypeError, ValueError):
+            return None
+        return v if math.isfinite(v) else None
+
+    def on_epoch_end(self, epoch: int, metrics: dict[str, Any]) -> None:
+        self._epochs.inc(client=self._client_id)
+        loss = self._finite(metrics, "loss")
+        if loss is not None:
+            self._last_loss.set(loss, client=self._client_id)
+            self._loss_hist.observe(loss, client=self._client_id)
+        accuracy = self._finite(metrics, "accuracy")
+        if accuracy is not None:
+            self._last_accuracy.set(accuracy, client=self._client_id)
+
+    def on_batch_end(self, epoch: int, batch: int, metrics: dict[str, Any]) -> None:
+        self._batches.inc(client=self._client_id)
 
 
 class MetricsLogger(BaseCallback):

@@ -37,6 +37,7 @@ def test_run_with_calibrated_dp(tmp_path, capsys):
 
 def test_serve_secure_round(capsys):
     """`nanofed-tpu serve --secure` hosts a masked round that real clients complete."""
+    pytest.importorskip("cryptography")
     from nanofed_tpu.communication import HTTPClient
     from nanofed_tpu.models import get_model
     from nanofed_tpu.security.secure_agg import (
@@ -166,6 +167,28 @@ def test_serve_async_refuses_secure(capsys):
     assert "--async-buffer" in capsys.readouterr().err
 
 
+def test_serve_async_refuses_sync_only_cohort_flags(capsys):
+    """Satellite regression: the sync-only cohort flags (--min-clients,
+    --completion-rate, --max-clients) error when explicitly combined with
+    --async-buffer, matching the --staleness-window refusal — FedBuff has no
+    cohort barrier, so nothing would read them."""
+    rc = main(["serve", "--async-buffer", "2", "--min-clients", "3"])
+    assert rc == 2
+    assert "--min-clients" in capsys.readouterr().err
+    rc = main(["serve", "--async-buffer", "2", "--completion-rate", "0.5"])
+    assert rc == 2
+    assert "--completion-rate" in capsys.readouterr().err
+    rc = main(["serve", "--async-buffer", "2", "--max-clients", "5"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--max-clients" in err and "async" in err
+    rc = main(["serve", "--async-buffer", "2",
+               "--min-clients", "3", "--completion-rate", "0.5"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--min-clients" in err and "--completion-rate" in err
+
+
 def test_serve_async_flag_validation(capsys):
     """Mode-scoped flags fail fast instead of being silently ignored or escaping
     as coordinator tracebacks."""
@@ -178,6 +201,27 @@ def test_serve_async_flag_validation(capsys):
     rc = main(["serve", "--async-buffer", "2", "--staleness-window", "0"])
     assert rc == 2
     assert "staleness-window" in capsys.readouterr().err
+
+
+def test_metrics_summary_subcommand(tmp_path, capsys):
+    """`nanofed-tpu metrics-summary` digests a run's telemetry.jsonl; a tree with
+    none exits 1 with a pointer at --telemetry-dir."""
+    import json as _json
+
+    from nanofed_tpu.observability import MetricsRegistry, RunTelemetry
+
+    tel = RunTelemetry(tmp_path / "run1", registry=MetricsRegistry())
+    with tel.span("round", round=0):
+        pass
+    tel.record("round", round=0, status="COMPLETED", duration_s=0.125)
+    tel.close()
+    assert main(["metrics-summary", str(tmp_path)]) == 0
+    summary = _json.loads(capsys.readouterr().out)
+    assert summary["rounds"] == {"COMPLETED": 1}
+    assert summary["phases"]["round"]["count"] == 1
+
+    assert main(["metrics-summary", str(tmp_path / "empty")]) == 1
+    assert "--telemetry-dir" in capsys.readouterr().err
 
 
 def test_unknown_benchmark_name_errors():

@@ -181,6 +181,7 @@ def test_robust_aggregation_over_the_wire():
 
 
 def test_robust_refuses_secure_mode():
+    pytest.importorskip("cryptography")
     from nanofed_tpu.aggregation import RobustAggregationConfig
     from nanofed_tpu.security.secure_agg import SecureAggregationConfig
 
@@ -255,6 +256,7 @@ def test_metrics_coercion_survives_malicious_values():
 def test_signature_enforcement_end_to_end():
     """require_signatures: unsigned and wrong-key updates are rejected with 403, a
     properly signed update is buffered (INVALID_SIGNATURE wire parity)."""
+    pytest.importorskip("cryptography")
     from nanofed_tpu.security import SecurityManager
 
     model = get_model("linear", in_features=4, num_classes=2)

@@ -10,6 +10,12 @@ runs over real aiohttp sockets: enroll -> deposit sealed Shamir shares -> mask (
 round, while a delivered-but-presumed-dropped update stays private behind its self mask.
 """
 
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="secure-aggregation protocol tests need the optional crypto dependency"
+)
+
 import asyncio
 import json
 

@@ -13,6 +13,12 @@ exists but its coordinator never calls it): a NaN-injecting or oversized network
 client is dropped before aggregation.
 """
 
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="secure-aggregation protocol tests need the optional crypto dependency"
+)
+
 import asyncio
 
 import jax

@@ -231,6 +231,7 @@ def test_q8_composes_with_signature_enforcement():
     """The client signs the server's exact reconstruction (base + dequantized delta),
     so require_signatures accepts a compressed update from the right key and still
     rejects an impostor."""
+    pytest.importorskip("cryptography")
     from nanofed_tpu.security import SecurityManager
 
     model = get_model("linear", in_features=4, num_classes=2)
@@ -314,10 +315,13 @@ def test_topk8_over_http_with_error_feedback_state():
     asyncio.run(main())
 
 
-def test_rejected_topk8_submit_preserves_the_residual():
-    """Error feedback must commit only on server ACCEPTANCE: a rejected submit
-    (stale round here) keeps the accumulator exactly as it was, so no shipped-but-
-    never-applied mass is lost from both sides."""
+def test_rejected_topk8_submit_folds_delta_into_residual():
+    """True error feedback across a dropped round: a REJECTED submit applied
+    nothing server-side, so the WHOLE combined delta (this round's progress + the
+    accumulated tail) folds into the accumulator — the mass rides the next
+    accepted delta instead of vanishing from both sides.  Retries are idempotent:
+    a second rejection with the same params must not grow the accumulator (the
+    fold's base is pinned in ``_pending_base``)."""
     model = get_model("linear", in_features=8, num_classes=4)
     params = model.init(jax.random.key(0))
     trained = jax.tree.map(lambda p: p + 0.01 * jnp.ones_like(p), params)
@@ -332,14 +336,35 @@ def test_rejected_topk8_submit_preserves_the_residual():
                                   update_encoding="topk8-delta",
                                   topk_fraction=0.25) as c:
                 await c.fetch_global_model(like=params)
-                assert await c.submit_update(trained, {"loss": 0.1})
-                committed = jax.tree.map(lambda x: np.array(x), c._residual)
-                # Stale round: server rejects, residual must NOT move.
+                # Stale round: server rejects -> the full delta is now accumulated.
                 c.current_round = 7
                 assert not await c.submit_update(trained, {"loss": 0.1})
-                for a, b in zip(jax.tree.leaves(committed),
-                                jax.tree.leaves(c._residual)):
-                    np.testing.assert_array_equal(a, np.asarray(b))
+                full_delta = jax.tree.map(
+                    lambda p, g: np.asarray(p, np.float32)
+                    - np.asarray(g, np.float32),
+                    trained, params,
+                )
+                for want, got in zip(jax.tree.leaves(full_delta),
+                                     jax.tree.leaves(c._residual)):
+                    np.testing.assert_allclose(np.asarray(got), want, atol=1e-7)
+                # Idempotent retry: a SECOND rejection with the same params adds
+                # nothing (delta is measured from the pinned fold base, = zero).
+                assert not await c.submit_update(trained, {"loss": 0.1})
+                for want, got in zip(jax.tree.leaves(full_delta),
+                                     jax.tree.leaves(c._residual)):
+                    np.testing.assert_allclose(np.asarray(got), want, atol=1e-7)
+                # Accepted retry at the right round: conservation — what the server
+                # applied plus what stayed accumulated is exactly ONE delta.
+                c.current_round = 0
+                assert await c.submit_update(trained, {"loss": 0.1})
+                (update,) = await server.drain_updates()
+                for got, base, res, want in zip(
+                    jax.tree.leaves(update.params), jax.tree.leaves(params),
+                    jax.tree.leaves(c._residual), jax.tree.leaves(full_delta),
+                ):
+                    sent = np.asarray(got, np.float32) - np.asarray(base, np.float32)
+                    np.testing.assert_allclose(sent + np.asarray(res), want,
+                                               atol=1e-3)
         finally:
             await server.stop()
 

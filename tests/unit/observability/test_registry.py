@@ -1,0 +1,146 @@
+"""Metrics registry: instrument semantics, label handling, thread safety, and the
+Prometheus text exposition format (the exact shape a scraper parses)."""
+
+import threading
+
+import pytest
+
+from nanofed_tpu.observability import MetricsRegistry, get_registry
+
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("nanofed_rounds_total", "rounds", labels=("status",))
+    c.inc(status="completed")
+    c.inc(2, status="completed")
+    c.inc(status="failed")
+    assert c.value(status="completed") == 3
+    assert c.value(status="failed") == 1
+    assert c.value(status="never-seen") == 0
+
+
+def test_counter_refuses_decrease_and_label_mismatch():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", labels=("a",))
+    with pytest.raises(ValueError, match="decrease"):
+        c.inc(-1, a="x")
+    with pytest.raises(ValueError, match="labels"):
+        c.inc(b="x")
+    with pytest.raises(ValueError, match="labels"):
+        c.inc()  # missing the declared label entirely
+
+
+def test_gauge_set_and_inc():
+    reg = MetricsRegistry()
+    g = reg.gauge("g")
+    g.set(5)
+    g.inc(-2)
+    assert g.value() == 3
+
+
+def test_histogram_buckets_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.sample_count() == 3
+    assert h.sample_sum() == pytest.approx(2.55)
+    lines = h.collect()
+    assert 'h_seconds_bucket{le="0.1"} 1' in lines
+    assert 'h_seconds_bucket{le="1"} 2' in lines  # cumulative
+    assert 'h_seconds_bucket{le="+Inf"} 3' in lines
+    assert "h_seconds_count 3" in lines
+
+
+def test_idempotent_registration_and_kind_mismatch():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", labels=("k",))
+    assert reg.counter("x_total", labels=("k",)) is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total", labels=("k",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("x_total", labels=("other",))
+
+
+def test_histogram_bucket_mismatch_refused_but_omission_adopts():
+    reg = MetricsRegistry()
+    a = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    # Omitting buckets adopts the registered boundaries.
+    assert reg.histogram("h_seconds") is a
+    # An EXPLICIT disagreement raises, like kind/label mismatches.
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h_seconds", buckets=(0.5,))
+
+
+def test_invalid_names_refused():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("ok_total", labels=("bad-label",))
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    c = reg.counter("nanofed_rounds_total", "Rounds by outcome", labels=("status",))
+    c.inc(2, status="completed")
+    g = reg.gauge("nanofed_cohort_size", "Cohort")
+    g.set(7)
+    text = reg.render_prometheus()
+    assert "# HELP nanofed_rounds_total Rounds by outcome\n" in text
+    assert "# TYPE nanofed_rounds_total counter\n" in text
+    assert 'nanofed_rounds_total{status="completed"} 2\n' in text
+    assert "# TYPE nanofed_cohort_size gauge\n" in text
+    assert "nanofed_cohort_size 7\n" in text
+    assert text.endswith("\n")
+
+
+def test_label_value_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", labels=("v",))
+    c.inc(v='a"b\\c\nd')
+    line = c.collect()[0]
+    assert line == 'esc_total{v="a\\"b\\\\c\\nd"} 1'
+
+
+def test_integer_rendering_has_no_decimal_point():
+    reg = MetricsRegistry()
+    c = reg.counter("n_total")
+    c.inc(3)
+    assert c.collect() == ["n_total 3"]
+    g = reg.gauge("ratio")
+    g.set(0.25)
+    assert g.collect() == ["ratio 0.25"]
+
+
+def test_thread_safety_under_contention():
+    reg = MetricsRegistry()
+    c = reg.counter("hammer_total", labels=("t",))
+    h = reg.histogram("hammer_seconds", buckets=(0.5,))
+
+    def work(tid):
+        for _ in range(1000):
+            c.inc(t=tid % 2)
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(t=0) + c.value(t=1) == 8000
+    assert h.sample_count() == 8000
+
+
+def test_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total", labels=("x",)).inc(x="1")
+    reg.histogram("b_seconds", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["a_total"] == {"kind": "counter", "values": {"1": 1.0}}
+    assert snap["b_seconds"]["kind"] == "histogram"
+    assert snap["b_seconds"]["values"][""]["count"] == 1
+
+
+def test_default_registry_is_process_wide():
+    assert get_registry() is get_registry()

@@ -1,0 +1,160 @@
+"""Span tracer: nesting, the registry histogram bridge, exports, and the
+RunTelemetry / summarize_telemetry round trip."""
+
+import json
+
+from nanofed_tpu.observability import (
+    SPAN_HISTOGRAM,
+    MetricsRegistry,
+    RunTelemetry,
+    SpanTracer,
+    find_latest_telemetry,
+    summarize_telemetry,
+)
+
+
+def test_span_nesting_depth_and_parent():
+    tracer = SpanTracer(registry=False, annotate_device=False)
+    with tracer.span("round", round=0):
+        with tracer.span("local-train"):
+            pass
+        with tracer.span("aggregate"):
+            pass
+    records = {r.name: r for r in tracer.records}
+    assert records["round"].depth == 0 and records["round"].parent_id is None
+    for child in ("local-train", "aggregate"):
+        assert records[child].depth == 1
+        assert records[child].parent_id == records["round"].span_id
+    # Children close before the parent, and the parent's duration covers them.
+    assert records["round"].duration_s >= records["local-train"].duration_s
+
+
+def test_span_records_survive_exceptions():
+    tracer = SpanTracer(registry=False, annotate_device=False)
+    try:
+        with tracer.span("round"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert [r.name for r in tracer.records] == ["round"]
+    # The stack unwound: a following span is a fresh root, not a child.
+    with tracer.span("next"):
+        pass
+    assert tracer.records[-1].depth == 0
+
+
+def test_span_histogram_bridge():
+    reg = MetricsRegistry()
+    tracer = SpanTracer(registry=reg, annotate_device=False)
+    with tracer.span("round"):
+        pass
+    with tracer.span("round"):
+        pass
+    h = reg.histogram(SPAN_HISTOGRAM, labels=("span",))
+    assert h.sample_count(span="round") == 2
+
+
+def test_phase_summary():
+    tracer = SpanTracer(registry=False, annotate_device=False)
+    for _ in range(3):
+        with tracer.span("round"):
+            pass
+    summary = tracer.phase_summary()
+    assert summary["round"]["count"] == 3
+    assert summary["round"]["total_s"] >= summary["round"]["max_s"]
+    assert set(summary["round"]) == {"count", "total_s", "max_s", "mean_s"}
+
+
+def test_jsonl_and_chrome_trace_export(tmp_path):
+    tracer = SpanTracer(registry=False, annotate_device=False)
+    with tracer.span("round", round=3):
+        with tracer.span("local-train"):
+            pass
+    jsonl = tracer.export_jsonl(tmp_path / "spans.jsonl")
+    lines = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    assert {r["name"] for r in lines} == {"round", "local-train"}
+    assert next(r for r in lines if r["name"] == "round")["attrs"] == {"round": 3}
+
+    chrome = tracer.export_chrome_trace(tmp_path / "trace.json")
+    doc = json.loads(chrome.read_text())
+    events = doc["traceEvents"]
+    assert all(e["ph"] == "X" for e in events)
+    assert {e["name"] for e in events} == {"round", "local-train"}
+    assert all("ts" in e and "dur" in e and "pid" in e for e in events)
+
+
+def test_run_telemetry_round_trip(tmp_path):
+    reg = MetricsRegistry()
+    tel = RunTelemetry(tmp_path, registry=reg, annotate_device=False)
+    rounds = reg.counter("nanofed_rounds_total", labels=("status",))
+    with tel.span("round", round=0):
+        with tel.span("local-train"):
+            pass
+    rounds.inc(status="completed")
+    tel.record("round", round=0, status="COMPLETED", duration_s=0.25)
+    tel.close()
+    # close() is idempotent; records after close are dropped, not raised.
+    tel.close()
+    tel.record("span", name="late")
+
+    path = find_latest_telemetry(tmp_path)
+    assert path == tmp_path / "telemetry.jsonl"
+    summary = summarize_telemetry(path)
+    assert summary["rounds"] == {"COMPLETED": 1}
+    assert summary["phases"]["round"]["count"] == 1
+    assert summary["phases"]["local-train"]["count"] == 1
+    assert summary["round_duration"]["p50_s"] == 0.25
+    assert summary["counters"]["nanofed_rounds_total"] == {"completed": 1.0}
+    # The late post-close records never landed.
+    names = [json.loads(line)["type"] for line in path.read_text().splitlines()]
+    assert names.count("metrics_snapshot") == 1
+    assert names[-1] == "metrics_snapshot"
+
+
+def test_summarize_tolerates_torn_tail_line(tmp_path):
+    p = tmp_path / "telemetry.jsonl"
+    p.write_text(
+        json.dumps({"type": "round", "status": "COMPLETED", "duration_s": 1.0})
+        + "\n"
+        + '{"type": "round", "status": "COMPL'  # crash mid-write
+    )
+    summary = summarize_telemetry(p)
+    assert summary["rounds"] == {"COMPLETED": 1}
+    assert summary["malformed_lines"] == 1
+
+
+def test_streaming_tracer_does_not_retain_records():
+    """A tracer with an on_close sink (the long-lived coordinator shape) must not
+    accumulate records in memory — the sink and the histogram see every span."""
+    seen = []
+    tracer = SpanTracer(registry=False, on_close=seen.append, annotate_device=False)
+    for _ in range(5):
+        with tracer.span("round"):
+            pass
+    assert len(seen) == 5
+    assert tracer.records == []
+    # Explicit opt-in restores retention even with a sink (bench's shape).
+    keeper = SpanTracer(registry=False, on_close=seen.append,
+                        annotate_device=False, keep_records=True)
+    with keeper.span("round"):
+        pass
+    assert len(keeper.records) == 1
+
+
+def test_tracer_threads_nest_independently():
+    import threading
+
+    tracer = SpanTracer(registry=False, annotate_device=False)
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        with tracer.span(name):
+            barrier.wait(timeout=5)
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Both spans overlap in time but neither is the other's child.
+    assert all(r.depth == 0 and r.parent_id is None for r in tracer.records)

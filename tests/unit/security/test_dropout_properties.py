@@ -9,8 +9,13 @@ survivors' double-masked vectors and removing (a) reconstructed self masks and
 quantized sum — bit-for-bit modular arithmetic, not approximately.
 """
 
-import numpy as np
 import pytest
+
+pytest.importorskip(
+    "cryptography", reason="secure-aggregation protocol tests need the optional crypto dependency"
+)
+
+import numpy as np
 
 from nanofed_tpu.core.exceptions import AggregationError
 from nanofed_tpu.security.secure_agg import (

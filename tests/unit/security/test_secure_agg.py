@@ -2,10 +2,15 @@
 ``tests/unit/server/aggregator/test_secure.py:55-272`` (round-trips, tamper detection,
 min-client enforcement) against the honest constructions."""
 
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="secure-aggregation protocol tests need the optional crypto dependency"
+)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from cryptography.exceptions import InvalidTag
 
 from nanofed_tpu.core.exceptions import AggregationError

@@ -1,6 +1,12 @@
 """Signing tests — parity with the reference's sign/verify round-trips
 (``tests/unit/server/test_validation.py``, SecurityManager section)."""
 
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="secure-aggregation protocol tests need the optional crypto dependency"
+)
+
 import jax.numpy as jnp
 
 from nanofed_tpu.security import SecurityManager, canonical_bytes, verify_signature

@@ -154,6 +154,25 @@ def test_compact_summary_distills_both_metrics_and_stays_short():
     assert len(json.dumps(out)) < 600
 
 
+def test_compact_summary_carries_round_phase_digest():
+    """The observability spans' phase summary rides the tail line as a compact
+    phase -> total-seconds map (and the line stays tail-buffer safe)."""
+    results = [
+        {"metric": METRIC_FLAGSHIP, "value": 2.0, "unit": "s",
+         "vs_baseline": 100.0, "platform": "tpu",
+         "phases": {
+             "prepare": {"count": 1, "total_s": 1.23456, "max_s": 1.2, "mean_s": 1.2},
+             "compile": {"count": 1, "total_s": 10.5, "max_s": 10.5, "mean_s": 10.5},
+             "round": {"count": 3, "total_s": 6.0, "max_s": 2.1, "mean_s": 2.0},
+         }},
+    ]
+    out = compact_summary(results)
+    assert out["phases"] == {"prepare": 1.235, "compile": 10.5, "round": 6.0}
+    import json
+
+    assert len(json.dumps(out)) < 600
+
+
 def test_compact_summary_tpu_carries_mfu():
     results = [
         {"metric": METRIC_FLAGSHIP, "value": 0.9, "unit": "s",

@@ -1,0 +1,55 @@
+"""TelemetryCallback: local-training metrics bridged into the metrics registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.data import pack_clients, synthetic_classification
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.models import get_model
+from nanofed_tpu.observability import MetricsRegistry
+from nanofed_tpu.trainer import TelemetryCallback, Trainer, TrainingConfig
+
+
+def _one_client(n=64, in_dim=8, classes=2, batch=16) -> ClientData:
+    ds = synthetic_classification(n, classes, (in_dim,), seed=0)
+    cd = pack_clients(ds, [np.arange(n)], batch_size=batch)
+    return ClientData(*(jnp.asarray(a[0]) for a in cd))
+
+
+def test_callback_bridges_epochs_and_batches_into_registry():
+    reg = MetricsRegistry()
+    m = get_model("linear", in_features=8, num_classes=2)
+    params = m.init(jax.random.key(0))
+    trainer = Trainer(
+        m.apply,
+        TrainingConfig(batch_size=16, local_epochs=3, collect_batch_metrics=True),
+        callbacks=[TelemetryCallback(client_id="c7", registry=reg)],
+    )
+    trainer.fit(params, _one_client(), jax.random.key(1))
+
+    epochs = reg.counter("nanofed_local_epochs_total", labels=("client",))
+    batches = reg.counter("nanofed_local_batches_total", labels=("client",))
+    last_loss = reg.gauge("nanofed_local_last_loss", labels=("client",))
+    hist = reg.histogram("nanofed_local_epoch_loss", labels=("client",))
+    assert epochs.value(client="c7") == 3
+    assert batches.value(client="c7") == 3 * (64 // 16)
+    assert last_loss.value(client="c7") > 0
+    assert hist.sample_count(client="c7") == 3
+
+
+def test_callback_skips_non_finite_and_non_numeric_metrics():
+    reg = MetricsRegistry()
+    cb = TelemetryCallback(client_id="x", registry=reg)
+    cb.on_epoch_end(0, {"loss": float("nan"), "accuracy": "oops"})
+    cb.on_epoch_end(1, {"loss": 0.5})
+    assert reg.counter("nanofed_local_epochs_total", labels=("client",)).value(
+        client="x"
+    ) == 2
+    # Only the finite loss was recorded.
+    assert reg.histogram("nanofed_local_epoch_loss", labels=("client",)).sample_count(
+        client="x"
+    ) == 1
+    assert reg.gauge("nanofed_local_last_loss", labels=("client",)).value(
+        client="x"
+    ) == 0.5
