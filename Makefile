@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint bench example dryrun api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint bench bench-smoke example dryrun api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -13,6 +13,11 @@ lint:
 
 bench:
 	python bench.py
+
+# Tiny fused-vs-single-round timing sanity on CPU (seconds, not minutes): catches
+# perf-plumbing regressions (fused engine, dispatch/host_sync spans) in tier-1.
+bench-smoke:
+	python -m pytest tests/integration/test_bench_smoke.py -q -s
 
 example:
 	python examples/mnist/run_experiment.py --synthetic

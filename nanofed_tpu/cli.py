@@ -119,6 +119,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         robust_method=args.robust_method,
         scaffold=args.scaffold,
         telemetry_dir=args.telemetry_dir,
+        rounds_per_block=args.rounds_per_block,
+        client_metrics_every=args.client_metrics_every,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -339,6 +341,19 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument(
         "--dtype", default=None, choices=["bfloat16", "float32"],
         help="local-training compute dtype (mixed precision when bfloat16)",
+    )
+    run.add_argument(
+        "--rounds-per-block", type=int, default=1,
+        help="fuse this many rounds into ONE device program (lax.scan inside a "
+        "single jit): no Python dispatch, no block_until_ready, no metrics "
+        "transfer between fused rounds — host sync only at block boundaries. "
+        "Falls back to single rounds for --scaffold/--robust-*/--dp-epsilon",
+    )
+    run.add_argument(
+        "--client-metrics-every", type=int, default=1,
+        help="dump per-client metric detail (weights/losses/update norms) into the "
+        "round metrics JSON every N rounds; 0 = never. At 1000 clients each dump "
+        "is a 1000-element device->host conversion",
     )
     run.add_argument(
         "--lr-schedule", default="constant",

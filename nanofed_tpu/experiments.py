@@ -71,12 +71,19 @@ def run_experiment(
     robust_method: str | None = None,
     scaffold: bool = False,
     telemetry_dir: str | Path | None = None,
+    rounds_per_block: int = 1,
+    client_metrics_every: int = 1,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
 
     ``central_privacy`` (a ``PrivacyAwareAggregationConfig``) turns the reduce into
     DP-FedAvg — clipping + Gaussian noise at the aggregation step.
+
+    ``rounds_per_block > 1`` fuses that many rounds into one device program (host
+    sync only at block boundaries — see ``parallel.multi_round``); unsupported
+    configurations (SCAFFOLD, robust, central DP) fall back to single rounds.
+    ``client_metrics_every`` samples the per-client metrics JSON detail (0 = never).
 
     ``client_chunk`` bounds per-device memory when clients >> chips: each device trains
     its resident clients in sequential chunks of this many (``lax.map`` over ``vmap``)
@@ -114,6 +121,8 @@ def run_experiment(
             lr_min_factor=lr_min_factor,
             lr_decay_every=lr_decay_every,
             lr_decay_gamma=lr_decay_gamma,
+            rounds_per_block=rounds_per_block,
+            client_metrics_every=client_metrics_every,
         ),
         training=TrainingConfig(
             batch_size=batch_size,

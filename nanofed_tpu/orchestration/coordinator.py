@@ -50,10 +50,15 @@ from nanofed_tpu.parallel.mesh import (
     replicated_sharding,
     shard_client_data,
 )
+from nanofed_tpu.parallel.multi_round import build_round_block, stack_round_keys
 from nanofed_tpu.parallel.round_step import build_round_step, init_server_state
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn, make_evaluator, stack_rngs
-from nanofed_tpu.trainer.schedules import SCHEDULES, lr_schedule_scale
+from nanofed_tpu.trainer.schedules import (
+    SCHEDULES,
+    lr_schedule_scale,
+    lr_schedule_scales,
+)
 from nanofed_tpu.utils.logger import Logger, log_exec
 
 
@@ -75,6 +80,18 @@ class CoordinatorConfig:
     base_dir: str | Path = "runs"
     save_metrics: bool = True
     eval_every: int = 0  # 0 = never evaluate during training
+    # Fused multi-round execution (parallel.multi_round): dispatch this many rounds
+    # as ONE device program and sync the host only at block boundaries — the
+    # per-round Python dispatch / block_until_ready / metrics-transfer tax is paid
+    # once per block.  1 = the classic single-round loop.  Configurations the fused
+    # engine doesn't cover (SCAFFOLD, robust aggregation, central DP) fall back to
+    # the single-round path automatically.
+    rounds_per_block: int = 1
+    # Per-client metrics detail (weights / losses / update norms, a [C]-sized
+    # device->host transfer + JSON dump) lands in the round metrics file every N
+    # rounds; 0 = never.  At 1000 clients the default per-round dump is a
+    # 1000-element host conversion nobody may read — sample it down.
+    client_metrics_every: int = 1
     # Per-round client-lr schedule (trainer.schedules): the scale streams into the
     # compiled round step as a traced scalar, so a decaying lr costs zero recompiles.
     # Pure function of the round index — resumed runs continue the schedule exactly.
@@ -100,6 +117,10 @@ class CoordinatorConfig:
             raise ValueError("lr_min_factor must be in [0, 1]")
         if self.lr_decay_every < 1:
             raise ValueError("lr_decay_every must be >= 1")
+        if self.rounds_per_block < 1:
+            raise ValueError("rounds_per_block must be >= 1")
+        if self.client_metrics_every < 0:
+            raise ValueError("client_metrics_every must be >= 0 (0 = never)")
         if not 0.0 < self.lr_decay_gamma <= 1.0:
             # gamma=0 would zero every update from the first decay on (full-cost
             # silent no-op rounds); gamma>1 silently GROWS the lr each decay.
@@ -264,6 +285,55 @@ class Coordinator:
                 validation=validation, robust=robust, client_chunk=client_chunk,
                 donate=True,
             )
+        # Fused multi-round execution: R rounds as one scanned device program,
+        # host sync only at block boundaries.  Falls back to the single-round path
+        # (built above — it also finishes ragged tail blocks) for configurations
+        # the fused engine doesn't cover yet.
+        self._round_block = None
+        self._fused_fallback_reason: str | None = None
+        if config.rounds_per_block > 1:
+            unsupported = [
+                name for name, active in (
+                    ("SCAFFOLD", scaffold),
+                    ("robust aggregation", robust is not None),
+                    ("central DP", central_privacy is not None),
+                    # Blocks are cut at eval boundaries, so an eval cadence
+                    # shorter than the block length would leave _block_len
+                    # unable to ever emit a full block — the knob would be a
+                    # silent no-op; say so instead of building a dead program.
+                    ("eval_every < rounds_per_block",
+                     0 < config.eval_every < config.rounds_per_block),
+                ) if active
+            ]
+            if unsupported:
+                self._fused_fallback_reason = " + ".join(unsupported)
+                self._log.info(
+                    "rounds_per_block=%d requested but %s is not fused yet; "
+                    "using the single-round path",
+                    config.rounds_per_block, self._fused_fallback_reason,
+                )
+            else:
+                self._round_block = build_round_block(
+                    model.apply, self.training, self.mesh, self.strategy,
+                    num_clients=self.num_clients,
+                    padded_clients=self._padded_clients,
+                    step_clients=self._step_clients,
+                    cohort_size=self.cohort_size,
+                    dropout_rate=config.dropout_rate,
+                    min_completion_rate=config.min_completion_rate,
+                    grad_fn=grad_fn, local_fit=local_fit, validation=validation,
+                    client_chunk=client_chunk,
+                    collect_client_detail=(
+                        config.save_metrics and config.client_metrics_every > 0
+                    ),
+                    # Explicit, never derived: _cohort_mode can be False with a
+                    # sub-population cohort (client_chunk that doesn't divide the
+                    # cohort padding), and True with step == padded (a 97%-cohort
+                    # pads to the population width) — the block must lay out the
+                    # mask exactly as _train_block builds it.
+                    cohort_mode=self._cohort_mode,
+                    donate=True,
+                )
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None
         )
@@ -423,10 +493,24 @@ class Coordinator:
 
     def start_training(self) -> Iterator[RoundMetrics]:
         """Generator over rounds (parity with the async generator
-        ``Coordinator.start_training``, ``coordinator.py:384-405``)."""
+        ``Coordinator.start_training``, ``coordinator.py:384-405``).
+
+        With ``rounds_per_block > 1`` (and a fused-capable configuration), full
+        blocks of R rounds run as ONE device program: the host syncs, publishes,
+        checkpoints, and yields only at block boundaries.  A consumer that
+        abandons the generator mid-block therefore resumes at the block edge —
+        early-exit granularity is the block, which is the knob's contract."""
         with self._log.context("coordinator"):
             try:
                 while self.current_round < self.config.num_rounds:
+                    n = self._block_len()
+                    if n > 1:
+                        # _train_block publishes + advances state for the whole
+                        # block before anything is yielded, so abandonment cannot
+                        # leave params ahead of the recorded round counter.
+                        for metrics in self._train_block(n):
+                            yield metrics
+                        continue
                     metrics = self._train_round(self.current_round)
                     self.history.append(metrics)
                     with self._tracer.span("publish", round=metrics.round_id):
@@ -448,7 +532,7 @@ class Coordinator:
                 ):
                     self.telemetry.close()
 
-    def _publish_round(self, metrics: RoundMetrics) -> None:
+    def _publish_round(self, metrics: RoundMetrics, persist_state: bool = True) -> None:
         """Release the round's artifacts — checkpoint, metrics JSON, versioned model.
 
         The checkpoint is written FIRST, before any released artifact of the
@@ -456,8 +540,13 @@ class Coordinator:
         loses at most an artifact, never an accounting event.  The reverse
         order would let a persisted noised release outlive its accountant
         entry — a resumed run would re-release round r with fresh noise
-        while reporting an ε that counts only one of the two releases."""
-        if self.state_store is not None:
+        while reporting an ε that counts only one of the two releases.
+
+        ``persist_state=False`` (mid-block rounds of a fused block) skips the
+        checkpoint and versioned model: ``self.params`` already holds the
+        block-END state, which must only ever be persisted under the block's
+        final round id."""
+        if self.state_store is not None and persist_state:
             ckpt_metrics = metrics.to_dict()
             if self.privacy_accountant is not None:
                 ckpt_metrics["privacy_accountant"] = (
@@ -485,7 +574,11 @@ class Coordinator:
             )
         if self.config.save_metrics:
             self._save_round_metrics(metrics)
-        if self.model_manager is not None and metrics.status == RoundStatus.COMPLETED:
+        if (
+            self.model_manager is not None
+            and persist_state
+            and metrics.status == RoundStatus.COMPLETED
+        ):
             self.model_manager.save_model(
                 self.params,
                 metadata={
@@ -515,6 +608,183 @@ class Coordinator:
             keep = host_rng.random(len(sampled)) >= self.config.dropout_rate
             sampled = sampled[keep]
         return sampled
+
+    # ------------------------------------------------------------------
+    # Fused multi-round blocks
+    # ------------------------------------------------------------------
+
+    def _block_len(self) -> int:
+        """Rounds to run next as one fused block; 1 = the single-round path.
+
+        Only FULL blocks of ``rounds_per_block`` rounds run fused (one compiled
+        scan length, shared with every other full block); ragged tails and the
+        rounds leading into an eval boundary finish on the already-compiled
+        single-round program instead of paying a fresh compile per length.
+        """
+        rpb = self.config.rounds_per_block
+        if self._round_block is None or rpb <= 1:
+            return 1
+        n = min(rpb, self.config.num_rounds - self.current_round)
+        if self.config.eval_every > 0:
+            # Blocks must END on eval boundaries: eval (and any decision made on
+            # it) is host work, and the fused block admits no mid-block sync.
+            n = min(n, self.config.eval_every
+                    - (self.current_round % self.config.eval_every))
+        return n if n == rpb else 1
+
+    def _train_block(self, n: int) -> list[RoundMetrics]:
+        """Run ``n`` rounds as one fused device block.
+
+        Host work splits into exactly two phases, each its own span so phase
+        summaries separate device compute from host-blocked time: ``dispatch``
+        (sample cohorts, stack per-round inputs, enqueue the block — returns as
+        soon as XLA accepts the program, no blocking) and ``host_sync`` (the one
+        ``block_until_ready`` + stacked-metrics fetch at the block boundary).
+        Cohorts, keys, and lr scales are the SAME pure host functions of the
+        round index the single-round path uses, so a fused run reproduces the
+        unfused trajectory round for round."""
+        cfg = self.config
+        first = self.current_round
+        rounds = list(range(first, first + n))
+        required = max(1, int(np.ceil(self.cohort_size * cfg.min_completion_rate)))
+        t0 = time.perf_counter()
+
+        with self._tracer.span("dispatch", round=first, rounds=n):
+            with self._tracer.span("cohort-sample", round=first, rounds=n):
+                idx_rows = np.zeros((n, self._step_clients), dtype=np.int32)
+                mask_rows = np.zeros((n, self._step_clients), dtype=np.float32)
+                survived_counts = []
+                for i, r in enumerate(rounds):
+                    survived = self._sample_cohort(r)
+                    survived_counts.append(len(survived))
+                    if self._cohort_mode:
+                        idx_rows[i, : len(survived)] = survived
+                        mask_rows[i, : len(survived)] = 1.0
+                    else:
+                        mask_rows[i, survived] = 1.0
+            lr_scales = lr_schedule_scales(
+                cfg.lr_schedule, first, n, cfg.num_rounds,
+                min_factor=cfg.lr_min_factor, decay_every=cfg.lr_decay_every,
+                gamma=cfg.lr_decay_gamma,
+            )
+            result = self._round_block(
+                self.params, self.server_state, self._data, self._num_samples,
+                stack_round_keys(cfg.seed, rounds),
+                jnp.asarray(lr_scales, jnp.float32),
+                jnp.asarray(idx_rows) if self._cohort_mode else None,
+                jnp.asarray(mask_rows),
+            )
+            self.params = result.params
+            self.server_state = result.server_opt_state
+
+        with self._tracer.span("host_sync", round=first, rounds=n):
+            jax.block_until_ready(self.params)
+            stacked = {k: np.asarray(v) for k, v in result.metrics.items()}
+            detail = None
+            # Fetch the [R, K] per-client stacks only when some round in this
+            # block will actually dump them — client_metrics_every exists to skip
+            # exactly this device->host conversion.
+            if result.client_metrics is not None and any(
+                self._client_detail_due(r) for r in rounds
+            ):
+                detail = {
+                    "weights": np.asarray(result.weights),
+                    "client_loss": np.asarray(result.client_metrics.loss),
+                    "client_accuracy": np.asarray(result.client_metrics.accuracy),
+                    "update_sq_norms": np.asarray(result.update_sq_norms),
+                }
+        block_duration = time.perf_counter() - t0
+        per_round_s = block_duration / n
+
+        out: list[RoundMetrics] = []
+        for i, r in enumerate(rounds):
+            if survived_counts[i] < required:
+                self._log.warning(
+                    "round %d FAILED: %d/%d clients completed (< %d required)",
+                    r, survived_counts[i], self.cohort_size, required,
+                )
+                metrics = RoundMetrics(
+                    round_id=r,
+                    status=RoundStatus.FAILED,
+                    num_clients=survived_counts[i],
+                    duration_s=per_round_s,
+                    timestamp=_now_iso(),
+                )
+            else:
+                agg = {k: float(v[i]) for k, v in stacked.items()}
+                if cfg.lr_schedule != "constant":
+                    agg["lr_scale"] = round(lr_scales[i], 6)
+                for count_key in ("participating_clients", "valid_clients"):
+                    if count_key in agg:
+                        agg[count_key] = int(agg[count_key])
+                eval_metrics: dict[str, float] = {}
+                if (
+                    self._evaluator is not None
+                    and cfg.eval_every > 0
+                    and (r + 1) % cfg.eval_every == 0
+                ):
+                    # Only ever the block's LAST round (_block_len cuts blocks at
+                    # eval boundaries), so self.params IS this round's model.
+                    eval_metrics = {
+                        k: float(v)
+                        for k, v in self._evaluator(self.params, self._eval_data).items()
+                    }
+                self._log.info(
+                    "round %d: loss=%.4f acc=%.4f clients=%d (fused %d-round "
+                    "block, %.2fs/round)",
+                    r, agg.get("loss", float("nan")),
+                    agg.get("accuracy", float("nan")), survived_counts[i],
+                    n, per_round_s,
+                )
+                metrics = RoundMetrics(
+                    round_id=r,
+                    status=RoundStatus.COMPLETED,
+                    num_clients=survived_counts[i],
+                    agg_metrics=agg,
+                    eval_metrics=eval_metrics,
+                    duration_s=per_round_s,
+                    timestamp=_now_iso(),
+                )
+
+            self._m_rounds.inc(status=metrics.status.name.lower())
+            self._m_round_duration.observe(per_round_s)
+            self._m_cohort.set(metrics.num_clients)
+            self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "round", round=r, status=metrics.status.name,
+                    num_clients=metrics.num_clients,
+                    duration_s=round(per_round_s, 6), fused=True,
+                    rounds_per_block=n,
+                )
+
+            self._last_client_detail = None
+            if (
+                detail is not None
+                and metrics.status == RoundStatus.COMPLETED
+                and self._client_detail_due(r)
+            ):
+                self._last_client_detail = {
+                    k: v[i].tolist() for k, v in detail.items()
+                }
+                if self._cohort_mode:
+                    self._last_client_detail["client_ids"] = idx_rows[i].tolist()
+
+            self.history.append(metrics)
+            with self._tracer.span("publish", round=r):
+                # Checkpoint / versioned model only at the block boundary: a
+                # mid-block checkpoint would pair round r's id with the block's
+                # END params and make a resume re-apply rounds r+1..end.
+                self._publish_round(metrics, persist_state=(i == n - 1))
+            if self.on_round_end is not None:
+                self.on_round_end(metrics)
+            self.current_round += 1
+            out.append(metrics)
+        return out
+
+    def _client_detail_due(self, round_id: int) -> bool:
+        every = self.config.client_metrics_every
+        return every > 0 and round_id % every == 0
 
     @log_exec
     def _train_round(self, round_id: int) -> RoundMetrics:
@@ -677,12 +947,19 @@ class Coordinator:
                 }
 
         # Per-client detail for the metrics file (parity: coordinator.py:247-280).  Only
-        # consumed by _save_round_metrics — skip the device->host transfers otherwise.
+        # consumed by _save_round_metrics — skip the device->host transfers otherwise;
+        # ``client_metrics_every`` samples the dump down further (at 1000 clients each
+        # dump is a 1000-element host conversion nobody may read).
         # Under central DP the per-client detail is NOT persisted: the weight vector
         # reveals exactly who participated (voiding amplification-by-subsampling for an
         # artifact-reading adversary), and per-client losses/update norms are
         # statistics of the un-noised deltas — information the DP release never covers.
-        if self.config.save_metrics and self.central_privacy is None:
+        self._last_client_detail = None
+        if (
+            self.config.save_metrics
+            and self.central_privacy is None
+            and self._client_detail_due(round_id)
+        ):
             self._last_client_detail = {
                 "weights": np.asarray(weights).tolist(),
                 "client_loss": np.asarray(result.client_metrics.loss).tolist(),
@@ -766,7 +1043,10 @@ class Coordinator:
 
     def _save_round_metrics(self, metrics: RoundMetrics) -> None:
         payload: dict[str, Any] = metrics.to_dict()
-        if metrics.status == RoundStatus.COMPLETED and hasattr(self, "_last_client_detail"):
+        if (
+            metrics.status == RoundStatus.COMPLETED
+            and getattr(self, "_last_client_detail", None) is not None
+        ):
             payload["clients"] = self._last_client_detail
         if self.central_privacy is not None:
             # Honest scoping of what the accounted (ε, δ) covers: eval metrics are

@@ -15,9 +15,15 @@ from nanofed_tpu.parallel.mesh import (
     replicated_sharding,
     shard_client_data,
 )
+from nanofed_tpu.parallel.multi_round import (
+    RoundBlockResult,
+    build_round_block,
+    stack_round_keys,
+)
 from nanofed_tpu.parallel.round_step import (
     RoundStepResult,
     build_round_step,
+    build_sharded_round,
     init_server_state,
 )
 from nanofed_tpu.parallel.scaffold_step import (
@@ -27,12 +33,16 @@ from nanofed_tpu.parallel.scaffold_step import (
 
 __all__ = [
     "CLIENT_AXIS",
+    "RoundBlockResult",
     "RoundStepResult",
     "ScaffoldStepResult",
+    "build_round_block",
     "build_round_step",
     "build_scaffold_round_step",
+    "build_sharded_round",
     "client_sharding",
     "init_server_state",
+    "stack_round_keys",
     "initialize_distributed",
     "make_mesh",
     "pad_client_count",

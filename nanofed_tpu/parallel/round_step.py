@@ -52,7 +52,7 @@ class RoundStepResult(NamedTuple):
 RoundStepFn = Callable[..., RoundStepResult]
 
 
-def build_round_step(
+def build_sharded_round(
     apply_fn: Callable[..., jax.Array],
     training: TrainingConfig,
     mesh: Mesh,
@@ -64,17 +64,22 @@ def build_round_step(
     robust: RobustAggregationConfig | None = None,
     client_chunk: int | None = None,
     axis_name: str = CLIENT_AXIS,
-    donate: bool = False,
-) -> RoundStepFn:
-    """Compile the round function for a mesh.
+) -> Callable:
+    """Build the UN-jitted ``shard_map`` round program.
 
-    Returns ``round_step(global_params, server_opt_state, data, weights, rngs,
-    lr_scale=1.0)`` where ``data`` leaves are ``[C, N, ...]`` sharded over
-    ``axis_name``, ``weights`` is ``[C]`` (sample counts x participation mask — zero
-    drops a client out of the reduction), and ``rngs`` is ``[C]`` per-client keys.
-    Initialize ``server_opt_state`` with ``init_server_state``.  ``lr_scale`` is a
-    TRACED scalar multiplying every local optimizer step — the per-round lr-schedule
-    hook (``trainer.schedules``): varying it across rounds does not retrace.
+    Returns ``sharded(global_params, server_opt_state, data, weights, rngs,
+    noise_rng, lr_scale) -> (params, server_opt_state, metrics, client_metrics,
+    update_sq_norms)`` — the SPMD body that ``build_round_step`` wraps in one
+    ``jit`` per round, and that ``parallel.multi_round.build_round_block`` scans
+    over R rounds inside a SINGLE ``jit`` (the fused multi-round engine).  Both
+    callers share this one program, so a fused block is the same math as R
+    single-round calls by construction.
+
+    ``data`` leaves are ``[C, N, ...]`` sharded over ``axis_name``, ``weights`` is
+    ``[C]`` (sample counts x participation mask — zero drops a client out of the
+    reduction), and ``rngs`` is ``[C]`` per-client keys.  ``lr_scale`` is a TRACED
+    scalar multiplying every local optimizer step — the per-round lr-schedule hook
+    (``trainer.schedules``): varying it across rounds does not retrace.
 
     ``local_fit`` overrides the default fit (e.g. ``make_private_local_fit`` for DP-SGD
     clients); it must have the ``local_fit(global_params, data, rng)`` signature.
@@ -115,10 +120,6 @@ def build_round_step(
     (rejected clients are excluded before the trim); refused alongside
     ``central_privacy`` (the trimmed mean's DP sensitivity differs from the clipped
     mean's — combining them silently would void the stated (ε, δ)).
-
-    ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
-    params-sized HBM copy per round) — the caller must then treat the inputs as consumed
-    and keep only the returned arrays, as ``Coordinator`` does.
     """
     strategy = strategy or fedavg_strategy()
     if robust is not None and central_privacy is not None:
@@ -365,11 +366,47 @@ def build_round_step(
         sq_norms = jax.vmap(tree_sq_norm)(delta)
         return new_gp, new_sos, metrics, result.metrics, sq_norms
 
-    sharded = shard_map(
+    return shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P(), P()),
         out_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
+    )
+
+
+def build_round_step(
+    apply_fn: Callable[..., jax.Array],
+    training: TrainingConfig,
+    mesh: Mesh,
+    strategy: Strategy | None = None,
+    grad_fn: GradFn | None = None,
+    local_fit: Callable | None = None,
+    central_privacy: PrivacyAwareAggregationConfig | None = None,
+    validation: ValidationConfig | None = None,
+    robust: RobustAggregationConfig | None = None,
+    client_chunk: int | None = None,
+    axis_name: str = CLIENT_AXIS,
+    donate: bool = False,
+) -> RoundStepFn:
+    """Compile the single-round function for a mesh.
+
+    Returns ``round_step(global_params, server_opt_state, data, weights, rngs,
+    lr_scale=1.0)``; initialize ``server_opt_state`` with ``init_server_state``.
+    All configuration semantics (``central_privacy``, ``validation``, ``robust``,
+    ``client_chunk``, ``local_fit``/``grad_fn``, the traced ``lr_scale``) are
+    documented on :func:`build_sharded_round`, which builds the SPMD program this
+    wraps — the fused R-round engine (``parallel.multi_round``) scans the SAME
+    program, so the two paths cannot drift.
+
+    ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
+    params-sized HBM copy per round) — the caller must then treat the inputs as consumed
+    and keep only the returned arrays, as ``Coordinator`` does.
+    """
+    sharded = build_sharded_round(
+        apply_fn, training, mesh, strategy,
+        grad_fn=grad_fn, local_fit=local_fit, central_privacy=central_privacy,
+        validation=validation, robust=robust, client_chunk=client_chunk,
+        axis_name=axis_name,
     )
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())

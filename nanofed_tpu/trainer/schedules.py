@@ -72,3 +72,26 @@ def lr_schedule_scale(
     if schedule == "cosine":
         return min_factor + (1.0 - min_factor) * 0.5 * (1.0 + math.cos(math.pi * frac))
     return 1.0 + (min_factor - 1.0) * frac  # linear
+
+
+def lr_schedule_scales(
+    schedule: str,
+    first_round: int,
+    num_rounds: int,
+    total_rounds: int,
+    *,
+    min_factor: float = 0.0,
+    decay_every: int = 10,
+    gamma: float = 0.5,
+) -> list[float]:
+    """The ``[R]`` scale vector for rounds ``first_round .. first_round+num_rounds-1``
+    — what a fused round block (``parallel.multi_round``) consumes as its traced
+    per-round schedule array.  Element r is exactly ``lr_schedule_scale`` of that
+    round, so a fused run follows the schedule identically to a single-round run."""
+    return [
+        lr_schedule_scale(
+            schedule, first_round + i, total_rounds,
+            min_factor=min_factor, decay_every=decay_every, gamma=gamma,
+        )
+        for i in range(num_rounds)
+    ]

@@ -37,6 +37,7 @@ MODULES = [
                      "nanofed_tpu.aggregation.privacy",
                      "nanofed_tpu.aggregation.robust"]),
     ("parallel", ["nanofed_tpu.parallel.mesh", "nanofed_tpu.parallel.round_step",
+                  "nanofed_tpu.parallel.multi_round",
                   "nanofed_tpu.parallel.scaffold_step"]),
     ("privacy", ["nanofed_tpu.privacy.config", "nanofed_tpu.privacy.noise",
                  "nanofed_tpu.privacy.accounting", "nanofed_tpu.privacy.mechanisms"]),

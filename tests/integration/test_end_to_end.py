@@ -205,8 +205,16 @@ def test_cohort_gather_equals_full_mask_round(mlp, tmp_path, devices):
     """Partial participation runs the round step over the GATHERED cohort (K_pad
     clients) instead of all N zero-weighted — at q=0.1 that is 10x less compute.
     The optimization must be invisible: same seed, same cohorts, identical released
-    params as the full-N masked path."""
-    cd = federate(_data(n=256), num_clients=16, scheme="iid", batch_size=8)
+    params as the full-N masked path.
+
+    Single-batch clients (batch_size == the 16-sample per-client capacity): the
+    gathered and full-N rounds are different compiled programs, and some jaxlib CPU
+    backends (observed on 0.4.36) draw a context-DEPENDENT (valid, deterministic,
+    but program-specific) epoch-shuffle permutation inside fused shard_map programs.
+    One batch per client makes the shuffle a within-batch permutation, which every
+    sum-reduction is invariant to — the equivalence this test pins (gather indices,
+    client-stable keys, weighting) stays exact on every backend."""
+    cd = federate(_data(n=256), num_clients=16, scheme="iid", batch_size=16)
 
     def make():
         return Coordinator(
@@ -216,7 +224,7 @@ def test_cohort_gather_equals_full_mask_round(mlp, tmp_path, devices):
                 num_rounds=3, participation_rate=0.25, seed=5, base_dir=tmp_path,
                 save_metrics=False,
             ),
-            training=TrainingConfig(batch_size=8),
+            training=TrainingConfig(batch_size=16),
         )
 
     gathered = make()

@@ -1,0 +1,245 @@
+"""Fused multi-round Coordinator integration: rounds_per_block blocks must be
+invisible (same trajectory as the single-round loop), fall back transparently for
+unsupported configs, and surface the dispatch/host_sync phase split.
+
+Single-batch clients in the equivalence tests — the fused and single-round paths
+are different compiled programs, and the multi-batch epoch shuffle is not
+bit-stable across program structures on every jaxlib CPU backend (see
+test_round_step.py for the diagnosis).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from nanofed_tpu.data import federate, pack_eval, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig, RoundStatus
+from nanofed_tpu.trainer import TrainingConfig
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    return get_model("mlp", in_features=16, hidden=32, num_classes=4)
+
+
+def _data(n=256, classes=4, feat=16, seed=0):
+    return synthetic_classification(n, classes, (feat,), seed=seed)
+
+
+def _make(mlp, cd, tmp_path, sub, **cfg_kwargs):
+    base = tmp_path / sub
+    return Coordinator(
+        model=mlp,
+        train_data=cd,
+        config=CoordinatorConfig(base_dir=base, **cfg_kwargs),
+        training=TrainingConfig(batch_size=16),
+    )
+
+
+def test_fused_blocks_match_single_round_trajectory(mlp, tmp_path, devices):
+    """rounds_per_block=2 over 4 rounds (cohort mode, q=0.25) reproduces the
+    single-round run: same params, same per-round metrics, same cohorts."""
+    cd = federate(_data(), num_clients=16, scheme="iid", batch_size=16)
+    kw = dict(num_rounds=4, participation_rate=0.25, seed=7)
+    fused = _make(mlp, cd, tmp_path, "fused", rounds_per_block=2, **kw)
+    assert fused._round_block is not None and fused._cohort_mode
+    single = _make(mlp, cd, tmp_path, "single", **kw)
+    fused_rounds = fused.run()
+    single_rounds = single.run()
+
+    for a, b in zip(jax.tree.leaves(fused.params), jax.tree.leaves(single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    assert len(fused_rounds) == 4
+    for f, s in zip(fused_rounds, single_rounds):
+        assert f.round_id == s.round_id and f.status == s.status
+        assert f.num_clients == s.num_clients
+        np.testing.assert_allclose(
+            f.agg_metrics["loss"], s.agg_metrics["loss"], rtol=1e-4
+        )
+        assert (
+            f.agg_metrics["participating_clients"]
+            == s.agg_metrics["participating_clients"]
+        )
+    # Per-round metrics JSON written for EVERY round, fused or not, and the fused
+    # cohort detail names the same clients the single-round run sampled.
+    for r in range(4):
+        pf = json.loads((tmp_path / "fused" / "metrics" / f"metrics_round_{r}.json").read_text())
+        ps = json.loads((tmp_path / "single" / "metrics" / f"metrics_round_{r}.json").read_text())
+        assert pf["status"] == ps["status"] == "completed"
+        assert pf["clients"]["client_ids"] == ps["clients"]["client_ids"]
+
+
+def test_fused_cohort_padded_to_population_width_matches_single(mlp, tmp_path, devices):
+    """Regression: a cohort whose padding EQUALS the population width (10 of 16
+    clients pads to 16 on 8 devices) still runs the slot-ordered gather path —
+    the block must take the coordinator's layout, not re-derive it from widths."""
+    cd = federate(_data(), num_clients=16, scheme="iid", batch_size=16)
+    kw = dict(num_rounds=2, participation_rate=0.6, seed=3)  # cohort 10 -> pad 16
+    fused = _make(mlp, cd, tmp_path, "fused", rounds_per_block=2, **kw)
+    assert fused._cohort_mode
+    assert fused._step_clients == fused._padded_clients  # the trap this pins
+    single = _make(mlp, cd, tmp_path, "single", **kw)
+    fr = fused.run()
+    sr = single.run()
+    for a, b in zip(jax.tree.leaves(fused.params), jax.tree.leaves(single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    for f, s in zip(fr, sr):
+        assert f.num_clients == s.num_clients == 10
+        np.testing.assert_allclose(
+            f.agg_metrics["loss"], s.agg_metrics["loss"], rtol=1e-4
+        )
+
+
+def test_eval_cadence_shorter_than_block_falls_back_with_reason(mlp, tmp_path, devices):
+    """eval_every < rounds_per_block can never emit a full block — that must be a
+    logged fallback, not a silently dead perf knob."""
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=4, rounds_per_block=4, eval_every=2, base_dir=tmp_path,
+        ),
+        training=TrainingConfig(batch_size=16),
+        eval_data=pack_eval(_data(n=128, seed=5), batch_size=64),
+    )
+    assert coord._round_block is None
+    assert "eval_every" in coord._fused_fallback_reason
+    rounds = coord.run()
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+    assert "accuracy" in rounds[1].eval_metrics and "accuracy" in rounds[3].eval_metrics
+
+
+def test_fused_dropout_failed_rounds_match_single(mlp, tmp_path, devices):
+    """Host-sampled dropout means fused and single-round runs fail the SAME rounds;
+    failed fused rounds ride the block as in-device identity rounds."""
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=64)
+    kw = dict(
+        num_rounds=6, participation_rate=0.5, dropout_rate=0.9,
+        min_completion_rate=0.75, seed=0,
+    )
+    fused = _make(mlp, cd, tmp_path, "fused", rounds_per_block=3, **kw)
+    single = _make(mlp, cd, tmp_path, "single", **kw)
+    fr = fused.run()
+    sr = single.run()
+    assert [m.status for m in fr] == [m.status for m in sr]
+    assert any(m.status == RoundStatus.FAILED for m in fr)
+    for a, b in zip(jax.tree.leaves(fused.params), jax.tree.leaves(single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_fused_fallback_for_unsupported_configs(mlp, tmp_path, devices):
+    """SCAFFOLD / robust aggregation transparently use the single-round path."""
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    scaffold = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=2, rounds_per_block=4, base_dir=tmp_path / "sc",
+        ),
+        training=TrainingConfig(batch_size=16),
+        scaffold=True,
+    )
+    assert scaffold._round_block is None
+    assert "SCAFFOLD" in scaffold._fused_fallback_reason
+    rounds = scaffold.run()
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+
+    from nanofed_tpu.aggregation import RobustAggregationConfig
+
+    robust = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=2, rounds_per_block=4, base_dir=tmp_path / "rb",
+        ),
+        training=TrainingConfig(batch_size=16),
+        robust=RobustAggregationConfig(trim_k=1),
+    )
+    assert robust._round_block is None
+    assert "robust" in robust._fused_fallback_reason
+    rounds = robust.run()
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+
+
+def test_fused_tail_and_eval_boundaries(mlp, tmp_path, devices):
+    """Blocks cut at eval boundaries; ragged tails run single-round; eval fires on
+    schedule either way."""
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=5, rounds_per_block=2, eval_every=4, base_dir=tmp_path,
+        ),
+        training=TrainingConfig(batch_size=16),
+        eval_data=pack_eval(_data(n=128, seed=5), batch_size=64),
+    )
+    rounds = coord.run()
+    assert [r.round_id for r in rounds] == [0, 1, 2, 3, 4]
+    assert all(r.status == RoundStatus.COMPLETED for r in rounds)
+    assert "accuracy" in rounds[3].eval_metrics  # (3+1) % 4 == 0
+    assert all(rounds[i].eval_metrics == {} for i in (0, 1, 2, 4))
+
+
+def test_client_metrics_every_samples_the_detail_dump(mlp, tmp_path, devices):
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=4, rounds_per_block=2, client_metrics_every=2,
+            base_dir=tmp_path,
+        ),
+        training=TrainingConfig(batch_size=16),
+    )
+    coord.run()
+    for r in range(4):
+        payload = json.loads(
+            (tmp_path / "metrics" / f"metrics_round_{r}.json").read_text()
+        )
+        if r % 2 == 0:
+            assert len(payload["clients"]["weights"]) == 8, f"round {r}"
+        else:
+            assert "clients" not in payload, f"round {r}"
+
+
+def test_client_metrics_never_in_single_round_path(mlp, tmp_path, devices):
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(
+            num_rounds=2, client_metrics_every=0, base_dir=tmp_path,
+        ),
+        training=TrainingConfig(batch_size=16),
+    )
+    coord.run()
+    for r in range(2):
+        payload = json.loads(
+            (tmp_path / "metrics" / f"metrics_round_{r}.json").read_text()
+        )
+        assert "clients" not in payload
+
+
+def test_dispatch_and_host_sync_spans_in_telemetry(mlp, tmp_path, devices):
+    """The fused path's phase split lands in telemetry.jsonl and the
+    metrics-summary digest separates dispatch from host_sync time."""
+    cd = federate(_data(n=512), num_clients=8, scheme="iid", batch_size=16)
+    coord = Coordinator(
+        model=mlp, train_data=cd,
+        config=CoordinatorConfig(num_rounds=4, rounds_per_block=2, base_dir=tmp_path),
+        training=TrainingConfig(batch_size=16),
+    )
+    coord.run()
+    from nanofed_tpu.observability import summarize_telemetry
+
+    summary = summarize_telemetry(tmp_path / "telemetry.jsonl")
+    assert summary["phases"]["dispatch"]["count"] == 2  # one per block
+    assert summary["phases"]["host_sync"]["count"] == 2
+    assert summary["rounds"].get("COMPLETED") == 4
+    # Round records carry the fused marker.
+    fused_rounds = [
+        json.loads(line)
+        for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()
+        if json.loads(line).get("type") == "round"
+    ]
+    assert all(rec.get("fused") and rec["rounds_per_block"] == 2
+               for rec in fused_rounds)

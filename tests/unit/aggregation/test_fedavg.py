@@ -108,8 +108,12 @@ def test_psum_weighted_mean_matches_host(devices):
     def body(t, w):
         return psum_weighted_mean(t, w, "clients")
 
+    # The compat shim, not jax.shard_map directly: the installed JAX may predate
+    # shard_map's graduation out of jax.experimental (the shim resolves either way).
+    from nanofed_tpu.parallel.mesh import shard_map
+
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=(P("clients"), P("clients")), out_specs=P()
         )
     )(tree, weights)

@@ -1,0 +1,262 @@
+"""Fused multi-round engine tests on the 8-device CPU mesh.
+
+The load-bearing property: a fused R-round block is the SAME math as R
+single-round ``round_step`` calls — same seeds, same cohorts, same schedule.
+Single-batch clients throughout (batch_size == per-client capacity) so the
+comparisons cross program structures (scan-of-shard_map vs shard_map) without
+tripping the jaxlib CPU backends whose fused-context epoch-shuffle draw is
+program-specific (see test_round_step.py for the diagnosis).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+from nanofed_tpu.data import federate, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.parallel import (
+    build_round_block,
+    build_round_step,
+    init_server_state,
+    make_mesh,
+    shard_client_data,
+    stack_round_keys,
+)
+from nanofed_tpu.trainer import TrainingConfig, stack_rngs
+from nanofed_tpu.trainer.schedules import lr_schedule_scales
+
+
+def _setup(num_clients=8, batch=64, n=512, classes=4, feat=8, seed=0):
+    m = get_model("mlp", in_features=feat, hidden=16, num_classes=classes)
+    ds = synthetic_classification(n, classes, (feat,), seed=seed)
+    cd = federate(ds, num_clients=num_clients, scheme="iid", batch_size=batch, seed=seed)
+    mesh = make_mesh()
+    return m, cd, mesh
+
+
+def _single_round_reference(m, cfg, mesh, strat, cd, seed, rounds, lr_scales, weights):
+    """R single-round calls, exactly as the coordinator drives them."""
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    sharded = shard_client_data(cd, mesh)
+    c = cd.x.shape[0]
+    per_round = []
+    for i, r in enumerate(rounds):
+        base = jax.random.fold_in(jax.random.key(seed), r)
+        res = step(params, sos, sharded, weights, stack_rngs(base, c),
+                   jnp.float32(lr_scales[i]))
+        params, sos = res.params, res.server_opt_state
+        per_round.append(res)
+    return params, sos, per_round
+
+
+def test_fused_block_equals_single_rounds_full_participation(devices):
+    """Block of R rounds == R round_step calls: params AND stacked metrics, with a
+    non-constant per-round lr schedule riding the traced [R] scale array."""
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    strat = fedavg_strategy()
+    seed, rounds = 3, [0, 1, 2]
+    lr_scales = lr_schedule_scales("step", 0, 3, 10, decay_every=1, gamma=0.5)
+    assert lr_scales == [1.0, 0.5, 0.25]
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    weights = compute_weights(ns)
+
+    ref_params, _, ref_rounds = _single_round_reference(
+        m, cfg, mesh, strat, cd, seed, rounds, lr_scales, weights
+    )
+
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=8, padded_clients=8,
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    sharded = shard_client_data(cd, mesh)
+    mask = np.ones((3, 8), dtype=np.float32)
+    res = block(
+        params, sos, sharded, ns, stack_round_keys(seed, rounds),
+        jnp.asarray(lr_scales), cohort_mask=jnp.asarray(mask),
+    )
+
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # Stacked per-round metrics match the single-round metrics round for round.
+    for i in range(3):
+        for key in ("loss", "accuracy", "participating_clients"):
+            np.testing.assert_allclose(
+                float(res.metrics[key][i]), float(ref_rounds[i].metrics[key]),
+                rtol=1e-5, err_msg=f"round {i} metric {key}",
+            )
+        np.testing.assert_allclose(
+            np.asarray(res.client_metrics.loss[i]),
+            np.asarray(ref_rounds[i].client_metrics.loss), rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.update_sq_norms[i]),
+            np.asarray(ref_rounds[i].update_sq_norms), rtol=1e-3, atol=1e-7,
+        )
+    assert np.asarray(res.survivors).tolist() == [8, 8, 8]
+
+
+def test_fused_block_cohort_mode_equals_single_rounds(devices):
+    """Cohort gathering INSIDE the scan: host-sampled cohorts reproduce the
+    single-round gathered path (client-stable keys, weights from gathered counts)."""
+    m, cd, mesh = _setup(num_clients=16, batch=16, n=256)
+    cfg = TrainingConfig(batch_size=16, local_epochs=1)
+    strat = fedavg_strategy()
+    seed, rounds, k, k_pad = 5, [0, 1, 2], 4, 8
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+
+    # Host cohort sampling, exactly like Coordinator._sample_cohort (no DP, no dropout).
+    idx_rows = np.zeros((3, k_pad), dtype=np.int32)
+    mask_rows = np.zeros((3, k_pad), dtype=np.float32)
+    for i, r in enumerate(rounds):
+        rng = np.random.default_rng(seed * 100_003 + r)
+        sampled = rng.choice(16, size=k, replace=False)
+        idx_rows[i, :k] = sampled
+        mask_rows[i, :k] = 1.0
+
+    # Reference: R single-round calls over the gathered cohort.
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    sharded = shard_client_data(cd, mesh)
+    ref_metrics = []
+    for i, r in enumerate(rounds):
+        idx = jnp.asarray(idx_rows[i])
+        data_r = jax.tree.map(lambda x: x[idx], sharded)
+        weights = compute_weights(ns[idx], jnp.asarray(mask_rows[i]))
+        base = jax.random.fold_in(jax.random.key(seed), r)
+        rngs = stack_rngs(base, 16)[idx]
+        res = step(params, sos, data_r, weights, rngs)
+        params, sos = res.params, res.server_opt_state
+        ref_metrics.append({k2: float(v) for k2, v in res.metrics.items()})
+    ref_params = params
+
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=16, padded_clients=16,
+        step_clients=k_pad, cohort_size=k,
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    res = block(
+        params, sos, sharded, ns, stack_round_keys(seed, rounds),
+        jnp.ones(3), jnp.asarray(idx_rows), jnp.asarray(mask_rows),
+    )
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    for i in range(3):
+        np.testing.assert_allclose(
+            float(res.metrics["loss"][i]), ref_metrics[i]["loss"], rtol=1e-5
+        )
+        assert int(res.metrics["participating_clients"][i]) == 4
+    assert np.asarray(res.survivors).tolist() == [4, 4, 4]
+
+
+def test_device_sampling_is_deterministic_and_valid(devices):
+    """On-device resampling: cohorts are valid without-replacement draws, the block
+    is deterministic, and params actually train."""
+    m, cd, mesh = _setup(num_clients=16, batch=16, n=256)
+    cfg = TrainingConfig(batch_size=16, local_epochs=1)
+    strat = fedavg_strategy()
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=16, padded_clients=16,
+        step_clients=8, cohort_size=4,
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    sharded = shard_client_data(cd, mesh)
+    keys = stack_round_keys(0, [0, 1, 2, 3])
+    res1 = block(params, sos, sharded, ns, keys, jnp.ones(4))
+    res2 = block(params, sos, sharded, ns, keys, jnp.ones(4))
+    assert np.asarray(res1.survivors).tolist() == [4, 4, 4, 4]
+    ids = np.asarray(res1.cohort_ids)
+    assert ids.shape == (4, 8)
+    for row in ids:
+        sampled = row[:4]
+        assert len(set(sampled.tolist())) == 4  # without replacement
+        assert (sampled < 16).all() and (sampled >= 0).all()
+    # Different rounds draw different cohorts (fold_in of the round index).
+    assert not np.array_equal(np.sort(ids[0][:4]), np.sort(ids[1][:4])) or \
+        not np.array_equal(np.sort(ids[1][:4]), np.sort(ids[2][:4]))
+    for a, b in zip(jax.tree.leaves(res1.params), jax.tree.leaves(res2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert all(np.isfinite(np.asarray(res1.metrics["loss"])))
+
+
+def test_device_sampling_respects_cohort_size_at_full_step_width(devices):
+    """Regression: cohort_size < num_clients with step_clients left at the padded
+    default must still SAMPLE (cohort mode is derived from the cohort being a
+    strict subset, not from the step width)."""
+    m, cd, mesh = _setup(num_clients=16, batch=16, n=256)
+    cfg = TrainingConfig(batch_size=16, local_epochs=1)
+    strat = fedavg_strategy()
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=16, padded_clients=16,
+        cohort_size=4,  # step_clients defaults to padded (16)
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    res = block(params, sos, shard_client_data(cd, mesh), ns,
+                stack_round_keys(0, [0, 1]), jnp.ones(2))
+    assert np.asarray(res.survivors).tolist() == [4, 4]
+    assert np.asarray(res.metrics["participating_clients"]).tolist() == [4, 4]
+
+
+def test_below_completion_round_is_identity(devices):
+    """A scanned round whose cohort mask falls below min_completion_rate leaves
+    params AND server state untouched (FAILED-round semantics, in-device)."""
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    strat = fedavg_strategy()
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=8, padded_clients=8,
+        min_completion_rate=0.5,
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    sharded = shard_client_data(cd, mesh)
+    # Round 0: 2/8 survivors (< the 4 required) -> identity; round 1: full cohort.
+    mask = np.zeros((2, 8), dtype=np.float32)
+    mask[0, :2] = 1.0
+    mask[1, :] = 1.0
+    res = block(
+        params, sos, sharded, ns, stack_round_keys(0, [0, 1]), jnp.ones(2),
+        cohort_mask=jnp.asarray(mask),
+    )
+    assert np.asarray(res.survivors).tolist() == [2, 8]
+    assert int(res.metrics["participating_clients"][0]) == 0
+
+    # The single-round reference SKIPS failed rounds host-side; round 1 alone from
+    # the same init must therefore match the block's final params.
+    step = build_round_step(m.apply, cfg, mesh, strat)
+    base = jax.random.fold_in(jax.random.key(0), 1)
+    ref = step(params, sos, sharded, compute_weights(ns), stack_rngs(base, 8))
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_collect_client_detail_off_returns_none(devices):
+    m, cd, mesh = _setup()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    strat = fedavg_strategy()
+    ns = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=8, padded_clients=8,
+        collect_client_detail=False,
+    )
+    params = m.init(jax.random.key(0))
+    sos = init_server_state(strat, params)
+    res = block(
+        params, sos, shard_client_data(cd, mesh), ns, stack_round_keys(0, [0, 1]),
+        jnp.ones(2), cohort_mask=jnp.ones((2, 8)),
+    )
+    assert res.client_metrics is None
+    assert res.update_sq_norms is None
+    assert res.weights is None
+    assert res.metrics["loss"].shape == (2,)

@@ -19,6 +19,8 @@ from bench import (  # noqa: E402
     METRIC_PARITY,
     compact_summary,
     finalize_measurements,
+    read_probe_cache,
+    write_probe_cache,
 )
 
 
@@ -211,3 +213,27 @@ def test_compact_summary_survives_total_failure():
     out_empty = compact_summary([])
     assert out_empty["value"] == -1.0
     assert out_empty["metric"] == METRIC_FLAGSHIP
+
+
+def test_probe_cache_roundtrip_and_ttl(tmp_path):
+    """The persisted backend-probe verdict honors its TTL: a fresh 'wedged'
+    verdict short-circuits the accel attempt, a stale one is ignored."""
+    path = str(tmp_path / "probe.json")
+    assert read_probe_cache(path=path) is None  # absent
+    write_probe_cache("wedged", {"source": "pre-probe"}, path=path, now=1000.0)
+    rec = read_probe_cache(path=path, ttl_s=1800.0, now=1500.0)
+    assert rec["verdict"] == "wedged" and rec["source"] == "pre-probe"
+    # Expired: 1800s TTL, written at t=1000, read at t=3000.
+    assert read_probe_cache(path=path, ttl_s=1800.0, now=3000.0) is None
+    write_probe_cache("ok", path=path, now=3000.0)
+    assert read_probe_cache(path=path, ttl_s=1800.0, now=3100.0)["verdict"] == "ok"
+
+
+def test_probe_cache_rejects_corrupt_records(tmp_path):
+    path = tmp_path / "probe.json"
+    path.write_text("{not json")
+    assert read_probe_cache(path=str(path)) is None
+    path.write_text('{"verdict": "maybe", "at_unix": 0}')
+    assert read_probe_cache(path=str(path), now=1.0, ttl_s=10.0) is None
+    path.write_text('{"verdict": "ok"}')  # missing timestamp
+    assert read_probe_cache(path=str(path)) is None
