@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint bench bench-smoke example dryrun api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke example dryrun api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -10,6 +10,13 @@ test-fast:
 
 lint:
 	python -m ruff check nanofed_tpu/ tests/ || true
+
+# fedlint (nanofed_tpu.analysis): JAX-aware static analysis — host syncs in
+# traced scope, traced-value branching, PRNG key reuse, missing donation,
+# unlocked shared-state mutation, blocking calls in async code.  MUST exit 0;
+# intentional sites carry `# fedlint: disable=FEDxxx (reason)` suppressions.
+lint-fed:
+	python -m nanofed_tpu.analysis nanofed_tpu/
 
 bench:
 	python bench.py

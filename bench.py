@@ -79,6 +79,12 @@ CNN_FWD_FLOPS_PER_SAMPLE = 2 * (26 * 26 * 32 * 9 * 1 + 24 * 24 * 64 * 9 * 32 + 9
 CNN_TRAIN_FLOPS_PER_SAMPLE = 3 * CNN_FWD_FLOPS_PER_SAMPLE
 V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e (v5 lite) peak bf16 throughput per chip
 
+# Strict execution mode (analysis subsystem): run every timed dispatch under
+# jax.transfer_guard("disallow") so an implicit host transfer in the measured
+# hot path fails the bench instead of silently inflating the headline.  Run
+# records carry "strict": true when enabled.
+BENCH_STRICT = os.environ.get("NANOFED_BENCH_STRICT", "") not in ("", "0")
+
 INIT_TIMEOUT_S = float(os.environ.get("NANOFED_BENCH_INIT_TIMEOUT", 120.0))
 PROBE_TIMEOUT_S = float(os.environ.get("NANOFED_BENCH_PROBE_TIMEOUT", 150.0))
 # Persisted backend-probe verdict (round-5 lesson: a wedged accelerator tunnel ate
@@ -156,6 +162,17 @@ def _error_json(stage: str, metric: str = METRIC_FLAGSHIP) -> dict:
     }
 
 
+def _strict_ctx():
+    """The strict-mode transfer guard for a measured dispatch, or a no-op context.
+    Inputs are device-resident before entry, so any implicit transfer the guard
+    trips on is a real hot-path regression."""
+    if not BENCH_STRICT:
+        return contextlib.nullcontext()
+    from nanofed_tpu.analysis.contracts import strict_mode
+
+    return strict_mode()
+
+
 def _timed_rounds(step, params, sos, data, weights, stack_rngs, padded, log_stage, t0,
                   reps: int = 3, tracer=None):
     """Time ``reps`` steady-state rounds (caller has already run the compile/warm-up
@@ -171,9 +188,13 @@ def _timed_rounds(step, params, sos, data, weights, stack_rngs, padded, log_stag
             tracer.span("round", rep=r) if tracer is not None
             else contextlib.nullcontext()
         )
+        # Key derivation is an explicit h2d and stays OUTSIDE the guarded
+        # dispatch (strict mode would rightly flag it inside).
+        rngs = stack_rngs(jax.random.key(r), padded)
         t = time.perf_counter()
         with span:
-            res = step(params, sos, data, weights, stack_rngs(jax.random.key(r), padded))
+            with _strict_ctx():
+                res = step(params, sos, data, weights, rngs)
             params, sos = res.params, res.server_opt_state
             jax.block_until_ready(params)
         times.append(time.perf_counter() - t)
@@ -287,6 +308,8 @@ def compact_summary(results: list) -> dict:
     }
     if "extrapolation_quality" in flagship:
         out["extrapolation_quality"] = flagship["extrapolation_quality"]
+    if flagship.get("strict"):
+        out["strict"] = True
     if "est_mfu_pct" in flagship:
         out["est_mfu_pct"] = flagship["est_mfu_pct"]
     if "error" in flagship:
@@ -467,11 +490,14 @@ def run_worker(platform: str, workloads: list[str]) -> None:
                 jax.block_until_ready(params)
         log_stage(f"{name}: warm-up done; timing one fused {r_block}-round block",
                   t0=t0)
+        keys = stack_round_keys(0, list(range(r_block, 2 * r_block)))
         t = time.perf_counter()
         with tracer.span("dispatch", rounds=r_block):
-            res = block(params, sos, data, num_samples,
-                        stack_round_keys(0, list(range(r_block, 2 * r_block))), lr,
-                        cohort_mask=mask_r)
+            # Strict mode proves the fused dispatch itself performs zero
+            # implicit transfers — every input above is already device-resident.
+            with _strict_ctx():
+                res = block(params, sos, data, num_samples, keys, lr,
+                            cohort_mask=mask_r)
             params, sos = res.params, res.server_opt_state
         with tracer.span("host_sync", rounds=r_block):
             jax.block_until_ready(params)
@@ -512,6 +538,8 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             "unit": "s",
             "platform": str(devices[0].platform),
         })
+        if BENCH_STRICT:
+            out["strict"] = True
         out["phases"] = tracer.phase_summary()
         print(json.dumps(out), flush=True)
 
@@ -575,6 +603,8 @@ def run_worker(platform: str, workloads: list[str]) -> None:
                 f"scaled to {FLAGSHIP_SAMPLE_PASSES} passes = {REFERENCE_FLAGSHIP_S:.2f}s CPU"
             ),
         }
+        if BENCH_STRICT:
+            out["strict"] = True
         out = finalize_measurements(measurements, REFERENCE_FLAGSHIP_S, out)
         # Fused blocks have no host-observable per-round boundaries: the headline
         # is block walltime / R, and the honest aggregation label says so.

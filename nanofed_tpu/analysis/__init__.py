@@ -1,0 +1,41 @@
+"""Static analysis + runtime contracts for round programs.
+
+Two halves, one goal — turn the execution contract of the fused SPMD round
+engine from tribal knowledge into enforced fact:
+
+* :mod:`nanofed_tpu.analysis.fedlint` — the AST-based static pass (rules
+  FED001–FED006, pure stdlib).  Run it with ``python -m nanofed_tpu.analysis``
+  or ``make lint-fed``; it gates CI.
+* :mod:`nanofed_tpu.analysis.contracts` — runtime strict mode:
+  :func:`check_round_step` / :func:`check_round_block` validate a round
+  program's output shapes/dtypes/structure via ``jax.eval_shape`` without
+  executing it, and :func:`strict_mode` wraps dispatch in
+  ``jax.transfer_guard("disallow")`` to prove the hot path performs zero
+  implicit transfers (``Coordinator(strict=True)`` / CLI ``--strict``).
+"""
+
+from nanofed_tpu.analysis.contracts import (
+    ContractViolation,
+    check_round_block,
+    check_round_step,
+    strict_mode,
+)
+from nanofed_tpu.analysis.fedlint import (
+    RULES,
+    Diagnostic,
+    lint_paths,
+    lint_source,
+    render_text,
+)
+
+__all__ = [
+    "RULES",
+    "ContractViolation",
+    "Diagnostic",
+    "check_round_block",
+    "check_round_step",
+    "lint_paths",
+    "lint_source",
+    "render_text",
+    "strict_mode",
+]

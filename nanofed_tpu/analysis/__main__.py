@@ -1,0 +1,69 @@
+"""``python -m nanofed_tpu.analysis`` — run fedlint from the command line.
+
+Exit code 0 when the tree is clean (or every finding is explicitly suppressed
+with a reason), 1 when findings remain, 2 on usage errors.  ``make lint-fed``
+and the CI ``lint-fed`` step both call this entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from nanofed_tpu.analysis.fedlint import RULES, lint_paths, render_text
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nanofed_tpu.analysis",
+        description="fedlint: JAX-aware static analysis for federated round programs",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["nanofed_tpu"],
+        help="files or directory trees to lint (default: nanofed_tpu)",
+    )
+    parser.add_argument(
+        "--select", default=None, metavar="FED001,FED002",
+        help="comma-separated rule codes to report (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="diagnostic output format",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, title in sorted(RULES.items()):
+            print(f"{code}  {title}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"error: unknown rule code(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    diagnostics = lint_paths(args.paths, select=select)
+    if args.format == "json":
+        print(json.dumps(
+            [
+                {"path": d.path, "line": d.line, "col": d.col, "code": d.code,
+                 "message": d.message}
+                for d in diagnostics
+            ],
+            indent=2,
+        ))
+    else:
+        print(render_text(diagnostics))
+    return 1 if diagnostics else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1034 @@
+"""fedlint — a JAX- and concurrency-aware static analysis pass for round programs.
+
+The performance story of this codebase (one jitted SPMD round, fused multi-round
+blocks) rests on invariants that ordinary linters cannot see: no implicit host
+transfer inside a traced region, no Python branching on traced array values, no
+PRNG key reuse, donated params-shaped buffers, and no unlocked mutation of the
+HTTP server's shared round state.  FedJAX (arXiv:2108.02117) showed that JAX FL
+simulators live or die by keeping the round program purely functional and
+device-resident; FL_PyTorch (arXiv:2202.03099) argued that simulators need
+built-in correctness tooling so research edits don't silently break the
+execution contract.  fedlint turns both lessons into CI-enforced rules.
+
+Pure stdlib (``ast`` + ``re``) — no third-party dependency, importable anywhere.
+
+Rules
+-----
+- **FED000** — malformed suppression: every ``# fedlint: disable=FEDxxx`` must
+  carry a parenthesized reason.  Suppressions are a contract ("this site is
+  intentional, here is why"), not an escape hatch.
+- **FED001** — host synchronization inside a traced round program (``.item()``,
+  ``float()/int()/bool()`` on a traced value, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``block_until_ready``), or a ``block_until_ready``/
+  ``device_get`` in the round-dispatch hot path (``orchestration``/``parallel``)
+  outside traced code.  Intentional block-boundary syncs need a documented
+  suppression.
+- **FED002** — Python ``if``/``while`` on a traced array value inside a traced
+  function: data-dependent Python control flow forces a concretization (a host
+  sync + per-value retrace) — use ``lax.cond``/``jnp.where`` instead.
+- **FED003** — PRNG key reuse: the same key variable consumed by two
+  ``jax.random.*`` draws without an intervening ``split``/``fold_in``/
+  reassignment produces correlated randomness silently.
+- **FED004** — ``jax.jit`` of a function taking params-shaped state (``params``,
+  ``opt_state``, ``stack``, ...) without ``donate_argnums``: the old buffer
+  stays live across the call, doubling HBM for the largest arrays in the
+  program.  Deliberately un-donated buffers (reused after the call) need a
+  documented suppression.
+- **FED005** — unlocked mutation of lock-guarded shared state: in a class that
+  owns an ``asyncio.Lock`` (``self._lock``), any attribute mutated somewhere
+  under ``async with self._lock`` must be mutated under it everywhere —
+  "the GIL makes it safe" is exactly the hand-wave this rule retires.
+- **FED006** — blocking call inside ``async def`` (``time.sleep``, synchronous
+  file IO, ``requests``, ``subprocess``): one blocked coroutine stalls every
+  handler on the event loop.
+
+Traced scope is resolved by following ``jit``/``shard_map``/``lax.scan``/
+``vmap`` wrapper applications and then propagating over call edges within the
+analyzed files (a helper called from a ``shard_map`` body is traced too).
+
+Suppressions: ``# fedlint: disable=FED001,FED003 (why this site is intentional)``
+on the flagged line or on a standalone comment line directly above it;
+``# fedlint: disable-file=FEDxxx (why the whole file is exempt)`` anywhere
+suppresses for the whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+__all__ = [
+    "RULES",
+    "Diagnostic",
+    "lint_paths",
+    "lint_source",
+    "render_text",
+]
+
+RULES: dict[str, str] = {
+    "FED000": "suppression comment without a parenthesized reason",
+    "FED001": "host synchronization inside a traced round program / hot dispatch path",
+    "FED002": "Python control flow on a traced array value",
+    "FED003": "PRNG key consumed more than once without split/fold_in",
+    "FED004": "jit of params-shaped state without donate_argnums",
+    "FED005": "unlocked mutation of lock-guarded shared state",
+    "FED006": "blocking call inside async code",
+}
+
+#: jit-like wrappers whose function argument (or decorated function) executes traced.
+_TRACED_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.map",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.eval_shape",
+}
+
+#: ``jax.random`` helpers that DERIVE keys rather than consuming them.
+_KEY_DERIVERS = {"split", "fold_in", "key", "PRNGKey", "wrap_key_data", "key_data", "clone"}
+
+#: Parameter names that signal a params-shaped persistent buffer (FED004).
+_PARAMS_LIKE = {
+    "params", "global_params", "server_opt_state", "opt_state", "sos",
+    "server_state", "stack", "c_stack", "state",
+}
+
+#: Attribute accesses that stay static (host ints) even on a traced array.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+#: Mutating container methods (FED005 mutation detection).
+_MUTATORS = {
+    "clear", "pop", "popitem", "update", "setdefault", "append", "extend",
+    "add", "remove", "discard", "insert",
+}
+
+#: Blocking calls inside ``async def`` (FED006).
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "urllib.request.urlopen",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+_SYNC_IO_METHODS = {"write_text", "read_text", "write_bytes", "read_bytes"}
+
+#: Modules whose NON-traced code is still held to the no-hidden-host-sync bar
+#: (the round-dispatch hot path): block_until_ready / device_get there must be
+#: either traced-scope-clean or carry a documented suppression.
+_HOT_PATH_PREFIXES = ("nanofed_tpu.orchestration", "nanofed_tpu.parallel")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedlint:\s*(disable|disable-file)\s*=\s*([A-Z0-9,\s]+?)\s*(?:\(([^)]*)\))?\s*$"
+)
+
+
+@dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding: ``path:line:col  CODE  message``."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclass
+class _Suppressions:
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    whole_file: set[str] = field(default_factory=set)
+    malformed: list[int] = field(default_factory=list)
+
+    def covers(self, line: int, code: str) -> bool:
+        return code in self.whole_file or code in self.by_line.get(line, set())
+
+
+def _parse_suppressions(source_lines: list[str]) -> _Suppressions:
+    sup = _Suppressions()
+    for i, raw in enumerate(source_lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        kind, codes_raw, reason = m.group(1), m.group(2), m.group(3)
+        codes = {c.strip() for c in codes_raw.split(",") if c.strip()}
+        if not reason or not reason.strip():
+            sup.malformed.append(i)
+            continue
+        if kind == "disable-file":
+            sup.whole_file |= codes
+            continue
+        sup.by_line.setdefault(i, set()).update(codes)
+        if raw.lstrip().startswith("#"):
+            # Standalone comment: the suppression targets the statement below it.
+            sup.by_line.setdefault(i + 1, set()).update(codes)
+    return sup
+
+
+# ---------------------------------------------------------------------------
+# Per-file model: imports, functions, call edges
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FunctionInfo:
+    module: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    scopes: tuple[str, ...]  # enclosing function qualnames, outermost first
+    calls: list[str] = field(default_factory=list)  # resolved dotted names
+    local_calls: list[str] = field(default_factory=list)  # bare called names
+    traced: bool = False
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]]
+
+
+class _FileModel:
+    """Everything fedlint knows about one source file."""
+
+    def __init__(self, path: str, module: str, source: str) -> None:
+        self.path = path
+        self.module = module
+        self.source_lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = _parse_suppressions(self.source_lines)
+        self.aliases: dict[str, str] = {}
+        self.functions: dict[str, _FunctionInfo] = {}
+        self._collect_imports()
+        self._collect_functions()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted name of an expression (``jnp.sum`` -> ``jax.numpy.sum``)."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def _collect_functions(self) -> None:
+        model = self
+
+        class Collector(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.scopes: list[str] = []
+
+            def _register(self, node: ast.AST, name: str) -> None:
+                qual = ".".join([*self.scopes, name])
+                model.functions[qual] = _FunctionInfo(
+                    model.module, qual, node, tuple(self.scopes)
+                )
+                self.scopes.append(name)
+                self.generic_visit(node)
+                self.scopes.pop()
+
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                self._register(node, node.name)
+
+            def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+                self._register(node, node.name)
+
+            def visit_Lambda(self, node: ast.Lambda) -> None:
+                self._register(node, f"<lambda:{node.lineno}>")
+
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                self.scopes.append(node.name)
+                self.generic_visit(node)
+                self.scopes.pop()
+
+        Collector().visit(self.tree)
+        for info in self.functions.values():
+            self._collect_calls(info)
+
+    def _collect_calls(self, info: _FunctionInfo) -> None:
+        """Record the calls made DIRECTLY by ``info`` (not by nested functions)."""
+        nested = {
+            f.node for q, f in self.functions.items()
+            if q != info.qualname and q.startswith(info.qualname + ".")
+        }
+
+        def walk(node: ast.AST) -> Iterable[ast.AST]:
+            for child in ast.iter_child_nodes(node):
+                if child in nested:
+                    continue
+                yield child
+                yield from walk(child)
+
+        for node in walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.resolve(node.func)
+            if name:
+                info.calls.append(name)
+            if isinstance(node.func, ast.Name):
+                info.local_calls.append(node.func.id)
+
+    def lookup_local(self, scopes: tuple[str, ...], name: str) -> _FunctionInfo | None:
+        """Resolve a bare function name from innermost enclosing scope outward."""
+        for depth in range(len(scopes), -1, -1):
+            qual = ".".join([*scopes[:depth], name])
+            if qual in self.functions:
+                return self.functions[qual]
+        return None
+
+
+def info_last(info: _FunctionInfo) -> str:
+    return info.qualname.rsplit(".", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# Traced-scope resolution across the analyzed file set
+# ---------------------------------------------------------------------------
+
+
+def _function_refs(model: _FileModel, expr: ast.AST, scopes: tuple[str, ...]):
+    """Functions referenced by ``expr`` where a traced wrapper expects a callable:
+    bare names, lambdas, and ``partial(f, ...)`` wrappers."""
+    if isinstance(expr, ast.Name):
+        target = model.lookup_local(scopes, expr.id)
+        if target is not None:
+            yield target
+    elif isinstance(expr, ast.Lambda):
+        for info in model.functions.values():
+            if info.node is expr:
+                yield info
+    elif isinstance(expr, ast.Call):
+        name = model.resolve(expr.func)
+        if name and name.rsplit(".", 1)[-1] == "partial" and expr.args:
+            yield from _function_refs(model, expr.args[0], scopes)
+
+
+def _is_traced_wrapper(name: str | None) -> bool:
+    if name is None:
+        return False
+    return name in _TRACED_WRAPPERS or name.rsplit(".", 1)[-1] == "shard_map"
+
+
+def _seed_traced(models: dict[str, _FileModel]) -> None:
+    """Mark traced roots: decorated defs and functions passed to jit-like wrappers."""
+    for model in models.values():
+        # Decorators.
+        for info in model.functions.values():
+            node = info.node
+            if isinstance(node, ast.Lambda):
+                continue
+            for dec in node.decorator_list:
+                name = model.resolve(dec)
+                if _is_traced_wrapper(name):
+                    info.traced = True
+                if isinstance(dec, ast.Call):
+                    dec_name = model.resolve(dec.func)
+                    if _is_traced_wrapper(dec_name):
+                        info.traced = True
+                    elif dec_name and dec_name.rsplit(".", 1)[-1] == "partial":
+                        if dec.args and _is_traced_wrapper(model.resolve(dec.args[0])):
+                            info.traced = True
+        # Wrapper call sites anywhere in the module.
+        scope_of: dict[ast.AST, tuple[str, ...]] = {}
+
+        def assign_scopes(node: ast.AST, scopes: tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_scopes = scopes
+                for info in model.functions.values():
+                    if info.node is child:
+                        child_scopes = (*scopes, info_last(info))
+                if isinstance(child, ast.ClassDef):
+                    child_scopes = (*scopes, child.name)
+                scope_of[child] = child_scopes
+                assign_scopes(child, child_scopes)
+
+        assign_scopes(model.tree, ())
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_traced_wrapper(model.resolve(node.func)):
+                continue
+            scopes = scope_of.get(node, ())
+            for arg in node.args[:2]:  # fn is the first arg (scan: fn, init)
+                for target in _function_refs(model, arg, scopes):
+                    target.traced = True
+
+
+def _propagate_traced(models: dict[str, _FileModel]) -> None:
+    """BFS traced-ness over call edges (local names + cross-module imports)."""
+    by_module_func: dict[tuple[str, str], _FunctionInfo] = {}
+    for model in models.values():
+        for qual, info in model.functions.items():
+            by_module_func[(model.module, qual)] = info
+
+    changed = True
+    while changed:
+        changed = False
+        for model in models.values():
+            for info in model.functions.values():
+                if not info.traced:
+                    continue
+                # Bare-name calls resolve through enclosing scopes.
+                for name in info.local_calls:
+                    target = model.lookup_local(
+                        (*info.scopes, info_last(info)), name
+                    )
+                    if target is None:
+                        # Imported from a sibling analyzed module?
+                        dotted = model.aliases.get(name)
+                        if dotted and "." in dotted:
+                            mod, fname = dotted.rsplit(".", 1)
+                            target = by_module_func.get((mod, fname))
+                    if target is not None and not target.traced:
+                        target.traced = True
+                        changed = True
+                # Dotted calls (``module.func``) into analyzed modules.
+                for dotted in info.calls:
+                    if "." not in dotted:
+                        continue
+                    mod, fname = dotted.rsplit(".", 1)
+                    target = by_module_func.get((mod, fname))
+                    if target is not None and not target.traced:
+                        target.traced = True
+                        changed = True
+
+
+# ---------------------------------------------------------------------------
+# Traced-value expression analysis (shared by FED001 cast checks and FED002)
+# ---------------------------------------------------------------------------
+
+_ARRAY_ROOTS = ("jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.", "jax.tree.")
+_ARRAY_EXACT = {"jax.tree_util.tree_map"}
+
+
+def _is_array_producer(name: str | None) -> bool:
+    if name is None:
+        return False
+    return name.startswith(_ARRAY_ROOTS) or name in _ARRAY_EXACT
+
+
+def _collect_traced_vars(model: _FileModel, fn_node: ast.AST) -> set[str]:
+    """Names assigned (anywhere in the function) from array-producing
+    expressions, to a fixed point."""
+    traced: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _expr_is_traced(model, node.value, traced):
+                continue
+            for target in node.targets:
+                for name_node in ast.walk(target):
+                    if isinstance(name_node, ast.Name) and name_node.id not in traced:
+                        traced.add(name_node.id)
+                        changed = True
+    return traced
+
+
+def _expr_is_traced(model: _FileModel, expr: ast.AST, traced_vars: set[str]) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in traced_vars
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _expr_is_traced(model, expr.value, traced_vars)
+    if isinstance(expr, ast.Subscript):
+        return _expr_is_traced(model, expr.value, traced_vars)
+    if isinstance(expr, ast.Call):
+        if _is_array_producer(model.resolve(expr.func)):
+            return True
+        # Method call on a traced value (x.sum(), x.astype(...)).
+        if isinstance(expr.func, ast.Attribute) and _expr_is_traced(
+            model, expr.func.value, traced_vars
+        ):
+            return True
+        # A call fed traced operands generally yields traced values.
+        return any(
+            _expr_is_traced(model, a, traced_vars) for a in expr.args
+        ) or any(
+            kw.arg is not None and _expr_is_traced(model, kw.value, traced_vars)
+            for kw in expr.keywords
+        )
+    if isinstance(expr, ast.BinOp):
+        return _expr_is_traced(model, expr.left, traced_vars) or _expr_is_traced(
+            model, expr.right, traced_vars
+        )
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_is_traced(model, expr.operand, traced_vars)
+    if isinstance(expr, ast.BoolOp):
+        return any(_expr_is_traced(model, v, traced_vars) for v in expr.values)
+    if isinstance(expr, ast.Compare):
+        # ``x is None`` stays a static Python check even on a traced name.
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False
+        return _expr_is_traced(model, expr.left, traced_vars) or any(
+            _expr_is_traced(model, c, traced_vars) for c in expr.comparators
+        )
+    if isinstance(expr, ast.IfExp):
+        return any(
+            _expr_is_traced(model, e, traced_vars)
+            for e in (expr.test, expr.body, expr.orelse)
+        )
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Rule implementations
+# ---------------------------------------------------------------------------
+
+
+def _check_traced_function(
+    model: _FileModel, info: _FunctionInfo, out: list[Diagnostic]
+) -> None:
+    """FED001 + FED002 on one traced function (full body, nested code included —
+    anything lexically inside a traced program executes traced)."""
+    traced_vars = _collect_traced_vars(model, info.node)
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call):
+            name = model.resolve(node.func)
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED001",
+                    f"{name} inside traced function {info.qualname!r}: forces a "
+                    "device->host sync in the middle of the round program",
+                ))
+            elif name in ("numpy.asarray", "numpy.array"):
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED001",
+                    f"{name} inside traced function {info.qualname!r}: silently "
+                    "materializes the traced value on the host",
+                ))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "block_until_ready")
+                and not node.args
+            ):
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED001",
+                    f".{node.func.attr}() inside traced function "
+                    f"{info.qualname!r}: concretizes the traced value on the host",
+                ))
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.func.id not in model.aliases
+                and len(node.args) == 1
+                and _expr_is_traced(model, node.args[0], traced_vars)
+            ):
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED001",
+                    f"{node.func.id}() on a traced value inside "
+                    f"{info.qualname!r}: concretization forces a host sync — keep "
+                    "it an array (jnp.float32/astype) or compute it on the host",
+                ))
+        elif isinstance(node, (ast.If, ast.While)) and _expr_is_traced(
+            model, node.test, traced_vars
+        ):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED002",
+                f"Python `{kind}` on a traced array value inside "
+                f"{info.qualname!r}: data-dependent control flow concretizes the "
+                "value (host sync + retrace) — use lax.cond/lax.select/jnp.where",
+            ))
+
+
+def _check_hot_path_sync(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED001 (hot-path form): block_until_ready/device_get in round-dispatch
+    modules outside traced code must be explicit, documented block-boundary
+    syncs."""
+    if not model.module.startswith(_HOT_PATH_PREFIXES):
+        return
+    traced_nodes = {
+        n for info in model.functions.values() if info.traced
+        for n in ast.walk(info.node)
+    }
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call) or node in traced_nodes:
+            continue
+        name = model.resolve(node.func)
+        is_method_sync = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+            and not node.args
+        )
+        if name in ("jax.block_until_ready", "jax.device_get") or is_method_sync:
+            what = name or f".{node.func.attr}()"
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED001",
+                f"{what} in round-dispatch hot path ({model.module}): host syncs "
+                "here serialize dispatch — if this is a deliberate block-boundary "
+                "sync, suppress with the reason",
+            ))
+
+
+class _KeyState:
+    """Per-branch FED003 state: key name -> line of first consumption."""
+
+    def __init__(self) -> None:
+        self.consumed: dict[str, int] = {}
+
+    def copy(self) -> "_KeyState":
+        s = _KeyState()
+        s.consumed = dict(self.consumed)
+        return s
+
+
+def _check_key_reuse(
+    model: _FileModel, info: _FunctionInfo, out: list[Diagnostic]
+) -> None:
+    """FED003 on one function body (nested functions have their own key scope)."""
+    nested = {
+        f.node for q, f in model.functions.items()
+        if q != info.qualname and q.startswith(info.qualname + ".")
+    }
+    flagged: set[int] = set()
+
+    def expr_events(expr: ast.AST, state: _KeyState) -> None:
+        for node in ast.walk(expr):
+            if node in nested or not isinstance(node, ast.Call):
+                continue
+            name = model.resolve(node.func)
+            if not name or not name.startswith("jax.random."):
+                continue
+            fn = name.rsplit(".", 1)[-1]
+            if fn in _KEY_DERIVERS or not node.args:
+                continue
+            key = node.args[0]
+            if not isinstance(key, ast.Name):
+                continue
+            prior = state.consumed.get(key.id)
+            if prior is not None and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED003",
+                    f"PRNG key {key.id!r} consumed again by jax.random.{fn} "
+                    f"(first consumed at line {prior}) without split/fold_in: "
+                    "the two draws are perfectly correlated",
+                ))
+            else:
+                state.consumed.setdefault(key.id, node.lineno)
+
+    def reset_targets(target: ast.AST, state: _KeyState) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                state.consumed.pop(node.id, None)
+
+    def run(stmts: list[ast.stmt], state: _KeyState) -> _KeyState:
+        for stmt in stmts:
+            if stmt in nested:
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                expr_events(stmt.value, state)
+                for t in stmt.targets:
+                    reset_targets(t, state)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    expr_events(stmt.value, state)
+                reset_targets(stmt.target, state)
+            elif isinstance(stmt, ast.If):
+                expr_events(stmt.test, state)
+                s_then = run(stmt.body, state.copy())
+                s_else = run(stmt.orelse, state.copy())
+                # A key counts as consumed after the If only when BOTH paths
+                # consumed it (no false positives on exclusive branches).
+                state.consumed = {
+                    k: min(s_then.consumed[k], s_else.consumed[k])
+                    for k in s_then.consumed.keys() & s_else.consumed.keys()
+                }
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, ast.While):
+                    expr_events(stmt.test, state)
+                body_state = state.copy()
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    reset_targets(stmt.target, body_state)
+                body_state = run(stmt.body, body_state)
+                # Second pass models the next iteration: a key consumed in pass 1
+                # and consumed again in pass 2 is cross-iteration reuse.
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    reset_targets(stmt.target, body_state)
+                run(stmt.body, body_state)
+                run(stmt.orelse, state.copy())
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    expr_events(item.context_expr, state)
+                state = run(stmt.body, state)
+            elif isinstance(stmt, ast.Try):
+                state = run(stmt.body, state)
+                for handler in stmt.handlers:
+                    run(handler.body, state.copy())
+                state = run(stmt.orelse, state)
+                state = run(stmt.finalbody, state)
+            else:
+                for expr in ast.iter_child_nodes(stmt):
+                    expr_events(expr, state)
+        return state
+
+    body = info.node.body
+    if isinstance(info.node, ast.Lambda):
+        expr_events(info.node.body, _KeyState())
+        return
+    run(body, _KeyState())
+
+
+def _jit_call_kwargs(call: ast.Call) -> set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _check_jit_donation(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED004: jit over params-shaped arguments without donate_argnums."""
+
+    def flag(line: int, col: int, fn_desc: str, suspects: list[str]) -> None:
+        out.append(Diagnostic(
+            model.path, line, col, "FED004",
+            f"jax.jit of {fn_desc} takes params-shaped state "
+            f"({', '.join(sorted(suspects))}) without donate_argnums: the input "
+            "buffer stays live across the call, doubling HBM for the largest "
+            "arrays — donate it, or suppress with the reason the buffer must "
+            "survive",
+        ))
+
+    def suspects_of(params: list[str]) -> list[str]:
+        return [p for p in params if p in _PARAMS_LIKE]
+
+    # Direct jit(...) call sites: jax.jit(fn_or_lambda, ...).
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Call) and model.resolve(node.func) == "jax.jit":
+            if {"donate_argnums", "donate_argnames"} & _jit_call_kwargs(node):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            params: list[str] = []
+            desc = "<function>"
+            if isinstance(target, ast.Lambda):
+                params = [a.arg for a in target.args.args]
+                desc = "a lambda"
+            elif isinstance(target, ast.Name):
+                fn = model.lookup_local((), target.id)
+                if fn is None:
+                    continue
+                params = fn.params
+                desc = repr(target.id)
+            else:
+                continue
+            sus = suspects_of(params)
+            if sus:
+                flag(node.lineno, node.col_offset, desc, sus)
+
+    # Decorated defs: @jax.jit / @partial(jax.jit, ...).
+    for info in model.functions.values():
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            continue
+        for dec in node.decorator_list:
+            donated = False
+            is_jit = False
+            if model.resolve(dec) == "jax.jit":
+                is_jit = True
+            elif isinstance(dec, ast.Call):
+                name = model.resolve(dec.func)
+                if name == "jax.jit":
+                    is_jit = True
+                    donated = bool(
+                        {"donate_argnums", "donate_argnames"} & _jit_call_kwargs(dec)
+                    )
+                elif (
+                    name and name.rsplit(".", 1)[-1] == "partial"
+                    and dec.args and model.resolve(dec.args[0]) == "jax.jit"
+                ):
+                    is_jit = True
+                    donated = bool(
+                        {"donate_argnums", "donate_argnames"} & _jit_call_kwargs(dec)
+                    )
+            if not is_jit or donated:
+                continue
+            sus = suspects_of(info.params)
+            if sus:
+                # Anchor at the decorator — that is the line to fix or suppress.
+                flag(dec.lineno, dec.col_offset, repr(info.qualname), sus)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _mutations_in(stmt: ast.stmt) -> list[tuple[int, int, str]]:
+    """(line, col, attr) for every ``self._x`` mutation in one statement."""
+    found: list[tuple[int, int, str]] = []
+    for node in ast.walk(stmt):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr:
+                found.append((t.lineno, t.col_offset, attr))
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    found.append((node.lineno, node.col_offset, attr))
+    return found
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    return _self_attr(item.context_expr) == "_lock"
+
+
+def _check_lock_discipline(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED005 on every class that owns ``self._lock = asyncio.Lock()``."""
+    for cls in ast.walk(model.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        owns_lock = any(
+            isinstance(n, ast.Assign)
+            and any(_self_attr(t) == "_lock" for t in n.targets)
+            and isinstance(n.value, ast.Call)
+            and model.resolve(n.value.func) in ("asyncio.Lock", "threading.Lock")
+            for n in ast.walk(cls)
+        )
+        if not owns_lock:
+            continue
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        guarded: set[str] = set()
+        unguarded: list[tuple[int, int, str, str]] = []
+
+        def scan(stmts: list[ast.stmt], in_lock: bool, method: str) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    locked = in_lock or any(_is_lock_ctx(i) for i in stmt.items)
+                    scan(stmt.body, locked, method)
+                    continue
+                own = _mutations_in_shallow(stmt)
+                for line, col, attr in own:
+                    if not attr.startswith("_") or attr == "_lock":
+                        continue
+                    if in_lock:
+                        guarded.add(attr)
+                    else:
+                        unguarded.append((line, col, attr, method))
+                for sub in _sub_blocks(stmt):
+                    scan(sub, in_lock, method)
+
+        for m in methods:
+            if m.name in ("__init__", "__post_init__"):
+                continue
+            scan(m.body, False, m.name)
+        for line, col, attr, method in unguarded:
+            if attr in guarded:
+                out.append(Diagnostic(
+                    model.path, line, col, "FED005",
+                    f"self.{attr} is mutated under `async with self._lock` "
+                    f"elsewhere in {cls.name} but {method}() mutates it without "
+                    "the lock: handlers interleave at every await — lock it, or "
+                    "suppress with the invariant that makes it safe",
+                ))
+
+
+def _mutations_in_shallow(stmt: ast.stmt) -> list[tuple[int, int, str]]:
+    """Mutations attributable to THIS statement (not its nested blocks)."""
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr)):
+        return _mutations_in(stmt)
+    # Compound statements: only their header expressions, bodies are scanned
+    # recursively by the caller with the right lock context.
+    return []
+
+
+def _sub_blocks(stmt: ast.stmt) -> list[list[ast.stmt]]:
+    blocks = []
+    for name in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, name, None)
+        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+            blocks.append(sub)
+    for handler in getattr(stmt, "handlers", []):
+        blocks.append(handler.body)
+    return blocks
+
+
+def _check_async_blocking(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED006: blocking calls lexically inside ``async def``."""
+    for info in model.functions.values():
+        if not isinstance(info.node, ast.AsyncFunctionDef):
+            continue
+        nested_async = {
+            f.node for q, f in model.functions.items()
+            if q != info.qualname and q.startswith(info.qualname + ".")
+            and isinstance(f.node, ast.AsyncFunctionDef)
+        }
+        for node in ast.walk(info.node):
+            if node in nested_async or not isinstance(node, ast.Call):
+                continue
+            name = model.resolve(node.func)
+            blocking = None
+            if name in _BLOCKING_CALLS:
+                blocking = name
+            elif name and name.startswith(_BLOCKING_PREFIXES):
+                blocking = name
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "open"
+                and "open" not in model.aliases
+            ):
+                blocking = "open()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_IO_METHODS
+            ):
+                blocking = f".{node.func.attr}()"
+            if blocking:
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED006",
+                    f"blocking call {blocking} inside async function "
+                    f"{info.qualname!r}: stalls the whole event loop — use "
+                    "asyncio.sleep/aiohttp/asyncio.to_thread",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def _module_name(path: Path, root_hint: Path | None = None) -> str:
+    parts = list(path.with_suffix("").parts)
+    if "nanofed_tpu" in parts:
+        parts = parts[parts.index("nanofed_tpu"):]
+    elif root_hint is not None:
+        try:
+            parts = list(path.relative_to(root_hint).with_suffix("").parts)
+        except ValueError:
+            parts = [path.stem]
+    else:
+        parts = [path.stem]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or path.stem
+
+
+def _lint_models(
+    models: dict[str, _FileModel], select: set[str] | None = None
+) -> list[Diagnostic]:
+    _seed_traced(models)
+    _propagate_traced(models)
+    raw: list[Diagnostic] = []
+    for model in models.values():
+        for line in model.suppressions.malformed:
+            raw.append(Diagnostic(
+                model.path, line, 0, "FED000",
+                "fedlint suppression without a parenthesized reason: write "
+                "`# fedlint: disable=FEDxxx (why this site is intentional)`",
+            ))
+        for info in model.functions.values():
+            if info.traced:
+                _check_traced_function(model, info, raw)
+            _check_key_reuse(model, info, raw)
+        _check_hot_path_sync(model, raw)
+        _check_jit_donation(model, raw)
+        _check_lock_discipline(model, raw)
+        _check_async_blocking(model, raw)
+
+    by_path = {m.path: m for m in models.values()}
+    final: list[Diagnostic] = []
+    seen: set[tuple[str, int, int, str]] = set()
+    for d in sorted(raw):
+        key = (d.path, d.line, d.col, d.code)
+        if key in seen:
+            continue
+        seen.add(key)
+        sup = by_path[d.path].suppressions
+        if d.code != "FED000" and sup.covers(d.line, d.code):
+            continue
+        if select is not None and d.code not in select:
+            continue
+        final.append(d)
+    return final
+
+
+def lint_paths(
+    paths: Iterable[str | Path], select: Iterable[str] | None = None
+) -> list[Diagnostic]:
+    """Lint files and/or directory trees; returns sorted diagnostics."""
+    files: list[Path] = []
+    roots: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            roots.append(p)
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    models: dict[str, _FileModel] = {}
+    root_hint = roots[0] if roots else None
+    for f in files:
+        source = f.read_text(encoding="utf-8")
+        module = _module_name(f, root_hint)
+        models[str(f)] = _FileModel(str(f), module, source)
+    return _lint_models(models, set(select) if select is not None else None)
+
+
+def lint_source(
+    source: str,
+    path: str = "<fixture>",
+    module: str = "fixture",
+    select: Iterable[str] | None = None,
+) -> list[Diagnostic]:
+    """Lint one in-memory source string (the unit-test fixture entry point)."""
+    models = {path: _FileModel(path, module, source)}
+    return _lint_models(models, set(select) if select is not None else None)
+
+
+def render_text(diagnostics: list[Diagnostic]) -> str:
+    lines = [d.render() for d in diagnostics]
+    if diagnostics:
+        by_code: dict[str, int] = {}
+        for d in diagnostics:
+            by_code[d.code] = by_code.get(d.code, 0) + 1
+        summary = ", ".join(f"{c}: {n}" for c, n in sorted(by_code.items()))
+        lines.append(f"fedlint: {len(diagnostics)} finding(s) ({summary})")
+    else:
+        lines.append("fedlint: clean")
+    return "\n".join(lines)

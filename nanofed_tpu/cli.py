@@ -121,6 +121,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         telemetry_dir=args.telemetry_dir,
         rounds_per_block=args.rounds_per_block,
         client_metrics_every=args.client_metrics_every,
+        strict=args.strict,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -401,6 +402,13 @@ def main(argv: list[str] | None = None) -> int:
         help="write the run's telemetry.jsonl (phase spans + round records + final "
         "metrics snapshot) here instead of the default <out-dir>; read it back "
         "with `nanofed-tpu metrics-summary`",
+    )
+    run.add_argument(
+        "--strict", action="store_true",
+        help="strict execution mode (analysis subsystem): contract-check the "
+        "round program via jax.eval_shape at build time and run every device "
+        "dispatch under jax.transfer_guard('disallow') — an implicit host "
+        "transfer in the hot path raises instead of silently serializing it",
     )
 
     serve = sub.add_parser(

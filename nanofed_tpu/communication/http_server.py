@@ -210,8 +210,10 @@ class HTTPServer:
             self._unmask_reveals.clear()
 
     def num_updates(self) -> int:
-        # Lock-free read is safe: len() is atomic under the GIL and all mutation happens
-        # on this event loop; the round engine re-checks via drain_updates() anyway.
+        # Lock-free read: len() is a single atomic operation and every MUTATION of
+        # _updates is under self._lock — an invariant fedlint FED005 enforces on this
+        # class, not a GIL hand-wave.  The round engine treats this as a hint and
+        # re-checks under the lock via drain_updates()/take_updates().
         return len(self._updates)
 
     async def drain_updates(self) -> list[ModelUpdate]:
@@ -246,7 +248,7 @@ class HTTPServer:
     # Secure-aggregation round-engine API
     # ------------------------------------------------------------------
 
-    def open_secagg(
+    async def open_secagg(
         self,
         expected_clients: int,
         *,
@@ -289,31 +291,40 @@ class HTTPServer:
                 f"max_clients ({max_clients}) must be >= the enrollment minimum "
                 f"({expected_clients})"
             )
-        self._secagg_expected = int(expected_clients)
-        self._secagg_window = bool(window)
-        self._secagg_max = int(max_clients) if max_clients is not None else None
-        self._secagg_threshold_for = threshold_for
-        self._secagg_threshold: int | None = None
-        self._secagg_closed = False
-        self._secagg_session = secrets.token_hex(16)
-        self._secagg_backend = None
-        self._secagg_roster.clear()
-        self._masked_updates.clear()
-        self._secagg_evicted.clear()
-        self._round_share_epks.clear()
-        self._round_share_bhs.clear()
-        self._round_share_blobs.clear()
-        self._round_share_senders.clear()
-        self._unmask_request = None
-        self._unmask_reveals.clear()
+        async with self._lock:
+            self._secagg_expected = int(expected_clients)
+            self._secagg_window = bool(window)
+            self._secagg_max = int(max_clients) if max_clients is not None else None
+            self._secagg_threshold_for = threshold_for
+            self._secagg_threshold: int | None = None
+            self._secagg_closed = False
+            self._secagg_session = secrets.token_hex(16)
+            self._secagg_backend = None
+            self._secagg_roster.clear()
+            self._masked_updates.clear()
+            self._secagg_evicted.clear()
+            self._round_share_epks.clear()
+            self._round_share_bhs.clear()
+            self._round_share_blobs.clear()
+            self._round_share_senders.clear()
+            self._unmask_request = None
+            self._unmask_reveals.clear()
 
-    def close_secagg(self) -> int:
+    async def close_secagg(self) -> int:
         """Freeze a window-mode roster (idempotent): no further registrations, and the
         cohort-derived Shamir threshold becomes available.  Returns the frozen cohort
         size."""
+        async with self._lock:
+            return self._close_secagg_locked()
+
+    def _close_secagg_locked(self) -> int:
+        """Freeze the roster; the CALLER must hold ``self._lock`` (``close_secagg``
+        and the register handler's implicit cap-reached freeze both do)."""
         if not self._secagg_closed:
+            # fedlint: disable=FED005 (caller holds self._lock: close_secagg and the register handler's locked freeze both enter locked)
             self._secagg_closed = True
             if self._secagg_threshold_for is not None:
+                # fedlint: disable=FED005 (caller holds self._lock: close_secagg and the register handler's locked freeze both enter locked)
                 self._secagg_threshold = int(
                     self._secagg_threshold_for(len(self._secagg_roster))
                 )
@@ -376,7 +387,7 @@ class HTTPServer:
         """This round's active cohort: enrolled minus evicted, canonical order."""
         return sorted(set(self._secagg_roster) - self._secagg_evicted)
 
-    def evict_secagg_clients(self, client_ids: Iterable[str]) -> None:
+    async def evict_secagg_clients(self, client_ids: Iterable[str]) -> None:
         """Remove dropped clients from the active cohort (their round secrets were
         revealed to recover the round; later rounds must not wait for them — a client
         can only rejoin by enrolling in a fresh cohort).
@@ -385,14 +396,15 @@ class HTTPServer:
         active set would otherwise flip ``secagg_shares_complete()`` true for the
         ROUND IN PROGRESS, serving surviving pollers an epk/inbox view inconsistent
         with the participants list they deposited against."""
-        newly = set(client_ids) - self._secagg_evicted
-        if newly:
-            self._m_evictions.inc(len(newly))
-        self._secagg_evicted.update(client_ids)
-        self._round_share_epks.clear()
-        self._round_share_bhs.clear()
-        self._round_share_blobs.clear()
-        self._round_share_senders.clear()
+        async with self._lock:
+            newly = set(client_ids) - self._secagg_evicted
+            if newly:
+                self._m_evictions.inc(len(newly))
+            self._secagg_evicted.update(client_ids)
+            self._round_share_epks.clear()
+            self._round_share_bhs.clear()
+            self._round_share_blobs.clear()
+            self._round_share_senders.clear()
 
     def secagg_shares_complete(self) -> bool:
         """True once every ACTIVE cohort member has deposited this round's ephemeral
@@ -410,15 +422,16 @@ class HTTPServer:
         corrupting the model)."""
         return dict(self._round_share_bhs)
 
-    def open_unmask(self, round_number: int, dropped: list[str],
-                    survivors: list[str]) -> None:
+    async def open_unmask(self, round_number: int, dropped: list[str],
+                          survivors: list[str]) -> None:
         """Publish the unmask request survivors poll for (dropout-tolerant mode)."""
-        self._unmask_request = {
-            "round": int(round_number),
-            "dropped": sorted(dropped),
-            "survivors": sorted(survivors),
-        }
-        self._unmask_reveals.clear()
+        async with self._lock:
+            self._unmask_request = {
+                "round": int(round_number),
+                "dropped": sorted(dropped),
+                "survivors": sorted(survivors),
+            }
+            self._unmask_reveals.clear()
 
     def num_unmask_reveals(self) -> int:
         return len(self._unmask_reveals)
@@ -807,7 +820,7 @@ class HTTPServer:
                 and self._secagg_max is not None
                 and len(self._secagg_roster) >= self._secagg_max
             ):
-                self.close_secagg()  # cap reached — freeze implicitly
+                self._close_secagg_locked()  # cap reached — freeze implicitly
         self._log.info("secagg enrollment: %s (%d/%d, backend=%s)", client_id,
                        len(self._secagg_roster), self._secagg_expected, backend)
         return web.json_response({"status": "success", "message": "enrolled"})

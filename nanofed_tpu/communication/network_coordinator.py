@@ -430,7 +430,7 @@ class NetworkCoordinator:
             else:
                 gone = dropped
             if gone and len(gone) < len(cohort):
-                self.server.evict_secagg_clients(gone)
+                await self.server.evict_secagg_clients(gone)
                 reason += f"; evicted unresponsive clients {gone}"
             return fail(reason)
         # This round's ephemeral mask keys (pairwise seeds derive from these; a
@@ -446,7 +446,7 @@ class NetworkCoordinator:
         dropped_after_shares = [c for c in dropped if c in epks]
         # Unmask round: even with zero dropouts the survivors' SELF masks must be
         # removed, so this phase always runs in tolerant mode.
-        self.server.open_unmask(round_number, dropped_after_shares, survivors)
+        await self.server.open_unmask(round_number, dropped_after_shares, survivors)
         deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
         while (
             self.server.num_unmask_reveals() < len(survivors)
@@ -459,7 +459,7 @@ class NetworkCoordinator:
             # round's barrier stops waiting (non-REVEALING survivors stay — they are
             # provably alive, their reveal may just be late).
             if dropped and len(dropped) < len(cohort):
-                self.server.evict_secagg_clients(dropped)
+                await self.server.evict_secagg_clients(dropped)
             return fail(
                 f"only {len(reveals)}/{len(survivors)} unmask reveals "
                 f"(threshold {threshold})"
@@ -490,7 +490,7 @@ class NetworkCoordinator:
             # Their round secrets were revealed; evict so later rounds neither wait
             # for them nor accept a compromised-mask submission.  Rejoining requires
             # a fresh cohort.
-            self.server.evict_secagg_clients(dropped)
+            await self.server.evict_secagg_clients(dropped)
         record = {
             "round": round_number,
             "status": "COMPLETED",
@@ -761,14 +761,14 @@ class NetworkCoordinator:
                 # enrollment_grace_s (or max_clients is reached), the roster freezes
                 # and the threshold is derived from its real size — never below an
                 # operator-configured one.
-                self.server.open_secagg(
+                await self.server.open_secagg(
                     self.config.min_clients,
                     window=True,
                     max_clients=self.config.max_clients,
                     threshold_for=lambda n: max(self.secure.threshold, n // 2 + 1),
                 )
             else:
-                self.server.open_secagg(self.config.min_clients)
+                await self.server.open_secagg(self.config.min_clients)
             deadline = loop.time() + self.config.round_timeout_s
             while (
                 self.server.secagg_enrolled() < self.config.min_clients
@@ -796,7 +796,7 @@ class NetworkCoordinator:
                         await asyncio.sleep(self.config.poll_interval_s)
                 # Idempotent: a no-op when max_clients already froze the roster —
                 # the validation below must run on BOTH freeze paths.
-                n = self.server.close_secagg()
+                n = await self.server.close_secagg()
                 frozen_t = self.server.secagg_threshold()
                 if frozen_t is not None and frozen_t > n:
                     # A configured threshold above the cohort size can never be

@@ -73,6 +73,7 @@ def run_experiment(
     telemetry_dir: str | Path | None = None,
     rounds_per_block: int = 1,
     client_metrics_every: int = 1,
+    strict: bool = False,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -90,6 +91,12 @@ def run_experiment(
     instead of one giant vmap — the production configuration at 1000-client scale.
     ``compute_dtype="bfloat16"`` runs local forward/backward in bf16 on the MXU (mixed
     precision; params/updates stay float32).
+
+    ``strict=True`` (CLI ``--strict``) enables the analysis-subsystem runtime
+    guards: round programs are contract-checked at build time via
+    ``jax.eval_shape`` and every device dispatch runs under
+    ``jax.transfer_guard("disallow")`` — an implicit host transfer in the hot
+    path raises instead of silently serializing dispatch.
     """
     log = Logger()
     robust = None
@@ -137,6 +144,7 @@ def run_experiment(
         robust=robust,
         scaffold=scaffold,
         telemetry_dir=telemetry_dir,
+        strict=strict,
     )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()
@@ -157,4 +165,5 @@ def run_experiment(
         "final_eval_metrics": final_eval,
         "round_durations_s": [r.duration_s for r in rounds],
         "devices": [str(d) for d in jax.devices()],
+        **({"strict": True} if strict else {}),
     }

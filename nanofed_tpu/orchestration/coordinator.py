@@ -23,6 +23,7 @@ Observable parity notes:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import time
@@ -151,6 +152,7 @@ class Coordinator:
         scaffold: bool = False,
         on_round_end: Callable[[RoundMetrics], None] | None = None,
         telemetry_dir: str | Path | None = None,
+        strict: bool = False,
     ) -> None:
         self.model = model
         self.config = config
@@ -161,6 +163,11 @@ class Coordinator:
         self.state_store = state_store
         self.on_round_end = on_round_end
         self._log = Logger()
+        # Strict mode (analysis.contracts): round programs are contract-checked at
+        # construction via jax.eval_shape, and every device dispatch runs under
+        # jax.transfer_guard("disallow") — an implicit host<->device transfer in
+        # the hot path raises instead of silently serializing it.
+        self.strict = bool(strict)
 
         # Central DP is applied inside the round step; the coordinator owns the matching
         # accountant so the configured (ε, δ) budget is actually tracked and reported
@@ -382,12 +389,22 @@ class Coordinator:
                     donate_argnums=(0,),
                     out_shardings=stack_shardings,
                 )
+                # fedlint: disable=FED004 (gather must NOT donate: c_stack is re-consumed by the scatter-add write-back after the round step)
                 self._gather_controls = jax.jit(
                     lambda stack, idx: jax.tree.map(lambda x: x[idx], stack),
                     out_shardings=stack_shardings,
                 )
         self.current_round = 0
         self.history: list[RoundMetrics] = []
+
+        if self.strict:
+            if self.scaffold:
+                self._log.info(
+                    "strict=True: contract check skipped for the SCAFFOLD round "
+                    "program (different signature); transfer guard still applies"
+                )
+            else:
+                self._check_contracts()
 
         self.base_dir = Path(config.base_dir)
         if config.save_metrics:
@@ -486,6 +503,69 @@ class Coordinator:
                 self._log.info(
                     "resumed from round %d checkpoint", restored.round_number
                 )
+
+    # ------------------------------------------------------------------
+    # Strict mode (analysis.contracts)
+    # ------------------------------------------------------------------
+
+    def _check_contracts(self) -> None:
+        """Validate the built round programs against the round-engine contract
+        via ``jax.eval_shape`` — nothing executes, nothing compiles; a drifted
+        program fails HERE with a named leaf instead of deep inside the jit."""
+        from nanofed_tpu.analysis.contracts import (
+            check_input_shardings,
+            check_round_block,
+            check_round_step,
+        )
+        from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+
+        def lead(tree: Any, n: int) -> Any:
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct((n, *x.shape[1:]), x.dtype), tree
+            )
+
+        n = self._step_clients
+        rngs_sds = jax.eval_shape(lambda: stack_rngs(jax.random.key(0), n))
+        report = check_round_step(
+            self._round_step,
+            self.params,
+            self.server_state,
+            lead(self._data, n),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            rngs_sds,
+        )
+        self._log.info("strict: round_step contract ok (%s)", report)
+        if self._round_block is not None:
+            rpb = self.config.rounds_per_block
+            keys_sds = jax.eval_shape(
+                lambda: stack_round_keys(0, list(range(rpb)))
+            )
+            report = check_round_block(
+                self._round_block,
+                self.params,
+                self.server_state,
+                self._data,
+                self._num_samples,
+                keys_sds,
+                jax.ShapeDtypeStruct((rpb,), jnp.float32),
+                cohort_idx=(
+                    jax.ShapeDtypeStruct((rpb, n), jnp.int32)
+                    if self._cohort_mode else None
+                ),
+                cohort_mask=jax.ShapeDtypeStruct((rpb, n), jnp.float32),
+            )
+            self._log.info("strict: round_block contract ok (%s)", report)
+        check_input_shardings(self._data, self.params, axis_name=CLIENT_AXIS)
+
+    def _dispatch_guard(self):
+        """The strict-mode transfer guard around device dispatch: every input is
+        device-resident by then, so an implicit transfer inside the dispatch is a
+        hot-path bug and raises.  A no-op context when ``strict=False``."""
+        if not self.strict:
+            return contextlib.nullcontext()
+        from nanofed_tpu.analysis.contracts import strict_mode
+
+        return strict_mode()
 
     # ------------------------------------------------------------------
     # Round loop
@@ -667,17 +747,22 @@ class Coordinator:
                 min_factor=cfg.lr_min_factor, decay_every=cfg.lr_decay_every,
                 gamma=cfg.lr_decay_gamma,
             )
-            result = self._round_block(
-                self.params, self.server_state, self._data, self._num_samples,
-                stack_round_keys(cfg.seed, rounds),
-                jnp.asarray(lr_scales, jnp.float32),
-                jnp.asarray(idx_rows) if self._cohort_mode else None,
-                jnp.asarray(mask_rows),
-            )
+            # Device-ready inputs BEFORE the guarded dispatch: under strict mode
+            # the jit call itself must perform zero implicit h2d transfers.
+            base_keys = stack_round_keys(cfg.seed, rounds)
+            lr_dev = jnp.asarray(lr_scales, jnp.float32)
+            idx_dev = jnp.asarray(idx_rows) if self._cohort_mode else None
+            mask_dev = jnp.asarray(mask_rows)
+            with self._dispatch_guard():
+                result = self._round_block(
+                    self.params, self.server_state, self._data,
+                    self._num_samples, base_keys, lr_dev, idx_dev, mask_dev,
+                )
             self.params = result.params
             self.server_state = result.server_opt_state
 
         with self._tracer.span("host_sync", round=first, rounds=n):
+            # fedlint: disable=FED001 (the ONE deliberate host sync per fused block — the host_sync span exists to measure exactly this barrier)
             jax.block_until_ready(self.params)
             stacked = {k: np.asarray(v) for k, v in result.metrics.items()}
             detail = None
@@ -880,6 +965,7 @@ class Coordinator:
         # program, so "local-train" covers both (attr says so); "aggregate" below is
         # the host-side post-aggregation work.  block_until_ready inside the span
         # makes its duration the real device time, not dispatch time.
+        lr_dev = jnp.float32(lr_scale)  # h2d BEFORE the guarded dispatch
         with self._tracer.span("local-train", round=round_id,
                                fused="train+aggregate"):
             if self.scaffold:
@@ -888,10 +974,11 @@ class Coordinator:
                     if self._cohort_mode
                     else self.c_stack
                 )
-                result = self._round_step(
-                    self.params, self.server_state, self.c_global, c_rows,
-                    data, weights, rngs, jnp.float32(lr_scale),
-                )
+                with self._dispatch_guard():
+                    result = self._round_step(
+                        self.params, self.server_state, self.c_global, c_rows,
+                        data, weights, rngs, lr_dev,
+                    )
                 self.c_global = result.c_global
                 if self._cohort_mode:
                     # Participants' control rows move by their delta; padding/dropped
@@ -905,12 +992,14 @@ class Coordinator:
                     # traffic).
                     self.c_stack = self._add_controls(self.c_stack, result.delta_c)
             else:
-                result = self._round_step(
-                    self.params, self.server_state, data, weights, rngs,
-                    jnp.float32(lr_scale),
-                )
+                with self._dispatch_guard():
+                    result = self._round_step(
+                        self.params, self.server_state, data, weights, rngs,
+                        lr_dev,
+                    )
             self.params = result.params
             self.server_state = result.server_opt_state
+            # fedlint: disable=FED001 (deliberate: blocks INSIDE the local-train span so its duration is device time, not dispatch time)
             jax.block_until_ready(self.params)
 
         with self._tracer.span("aggregate", round=round_id):
@@ -971,6 +1060,7 @@ class Coordinator:
                 # slot hosted (weight-0 slots host a placeholder row).
                 self._last_client_detail["client_ids"] = idx.tolist()
 
+        # fedlint: disable=FED001 (deliberate end-of-round barrier: duration_s must measure the round, not the async dispatch queue)
         jax.block_until_ready(self.params)
         duration = time.perf_counter() - t0
         self._log.info(

@@ -120,6 +120,7 @@ def make_personalized_evaluator(
         p_acc, _ = eval_on(tuned, test_i)
         return g_acc, p_acc, count
 
+    # fedlint: disable=FED004 (eval must NOT donate: the global params are reused by the caller after personalization scoring)
     @jax.jit
     def evaluate(
         global_params: Params, train: ClientData, test: ClientData, rng: jax.Array

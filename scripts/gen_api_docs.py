@@ -55,6 +55,8 @@ MODULES = [
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry"]),
+    ("analysis", ["nanofed_tpu.analysis.fedlint",
+                  "nanofed_tpu.analysis.contracts"]),
     ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",
              "nanofed_tpu.ops.quantize"]),
     ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.profiling",

@@ -306,7 +306,7 @@ def test_mixed_backend_cohort_refused_at_registration():
 
     async def scenario():
         server = HTTPServer(port=PORT + 3)
-        server.open_secagg(3)
+        await server.open_secagg(3)
         await server.start()
         try:
             k1, k2 = ClientKeyPair.generate(), ClientKeyPair.generate()
@@ -342,7 +342,7 @@ def test_evicted_client_cannot_submit_or_deposit():
 
     async def scenario():
         server = HTTPServer(port=0)
-        server.open_secagg(3)
+        await server.open_secagg(3)
         model = get_model("linear", in_features=3, num_classes=2)
         await server.publish_model(_client_params(model, 0), 0)
         client = TestClient(TestServer(server._app))
@@ -357,7 +357,7 @@ def test_evicted_client_cannot_submit_or_deposit():
                     headers={"X-NanoFed-Client": cid},
                 )
                 assert r.status == 200
-            server.evict_secagg_clients(["c2"])
+            await server.evict_secagg_clients(["c2"])
             assert server.secagg_active_order() == ["c1", "c3"]
             # Masked update from the evicted client: refused.
             r = await client.post(
@@ -506,7 +506,7 @@ def test_enrollment_window_refuses_late_joiners_after_freeze():
 
     async def scenario():
         server = HTTPServer(port=PORT + 9)
-        server.open_secagg(2, window=True, max_clients=3,
+        await server.open_secagg(2, window=True, max_clients=3,
                            threshold_for=lambda n: n // 2 + 1)
         await server.start()
         try:
@@ -528,7 +528,7 @@ def test_enrollment_window_refuses_late_joiners_after_freeze():
             # The round threshold tracks the ACTIVE cohort: after an eviction the
             # derivation re-runs over the survivors (a threshold frozen at the
             # enrollment size would brick every round once m < t).
-            server.evict_secagg_clients(["c3"])
+            await server.evict_secagg_clients(["c3"])
             assert server.secagg_active_order() == ["c1", "c2"]
             assert server.secagg_threshold() == 2  # 2//2+1
         finally:
@@ -545,8 +545,8 @@ def test_window_cap_below_minimum_is_refused_at_open():
 
     server = HTTPServer(port=0)
     with pytest.raises(ValueError, match="max_clients"):
-        server.open_secagg(5, window=True, max_clients=3,
-                           threshold_for=lambda n: n // 2 + 1)
+        asyncio.run(server.open_secagg(5, window=True, max_clients=3,
+                                       threshold_for=lambda n: n // 2 + 1))
 
 
 def test_unsatisfiable_threshold_fails_fast_on_implicit_freeze_too():
@@ -646,7 +646,7 @@ def test_wire_epk_substitution_aborts_client_side_before_masking():
 
     async def scenario():
         server = HTTPServer(port=PORT + 10)
-        server.open_secagg(3)
+        await server.open_secagg(3)
         model = get_model("linear", in_features=3, num_classes=2)
         await server.publish_model(_client_params(model, 0), 0)
         await server.start()

@@ -359,7 +359,7 @@ def test_unsigned_enrollment_rejected_under_signatures():
         client = TestClient(TestServer(server._app))
         await client.start_server()
         try:
-            server.open_secagg(1)
+            await server.open_secagg(1)
             session = (await (await client.get("/secagg/roster")).json())["session"]
             pk = bytes(32)
             body = {"public_key": base64.b64encode(pk).decode(), "num_samples": 10.0}
@@ -386,14 +386,14 @@ def test_unsigned_enrollment_rejected_under_signatures():
             assert r.status == 200
             # REPLAY into a fresh cohort: the old signature no longer verifies
             # (bound to the previous session nonce).
-            server.open_secagg(1)
+            await server.open_secagg(1)
             r = await client.post("/secagg/register", json=body,
                                   headers={"X-NanoFed-Client": "c1",
                                            "X-NanoFed-Signature": sig})
             assert r.status == 403
             # A DIFFERENT key for an enrolled id is refused even when validly signed
             # (mid-session key swap would break mask cancellation).
-            server.open_secagg(1)
+            await server.open_secagg(1)
             session3 = (await (await client.get("/secagg/roster")).json())["session"]
             sig3 = base64.b64encode(
                 manager.sign_enrollment("c1", pk, 10.0, session3)).decode()

@@ -1,0 +1,177 @@
+"""Strict-mode integration: the fused round block runs under
+``jax.transfer_guard("disallow")`` without tripping — the runtime proof that the
+hot path performs zero implicit transfers — and ``Coordinator(strict=True)``
+changes nothing about the math."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.aggregation import compute_weights, fedavg_strategy
+from nanofed_tpu.data import pack_clients, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.orchestration.coordinator import Coordinator, CoordinatorConfig
+from nanofed_tpu.orchestration.types import RoundStatus
+from nanofed_tpu.parallel import (
+    build_round_block,
+    build_round_step,
+    init_server_state,
+    make_mesh,
+    pad_client_count,
+    pad_clients,
+    replicated_sharding,
+    shard_client_data,
+    stack_round_keys,
+)
+from nanofed_tpu.trainer import TrainingConfig, stack_rngs
+
+N_CLIENTS = 4
+SAMPLES = 8
+
+
+def _client_data(mesh):
+    ds = synthetic_classification(N_CLIENTS * SAMPLES, 3, (6,), seed=0)
+    parts = [np.arange(i * SAMPLES, (i + 1) * SAMPLES) for i in range(N_CLIENTS)]
+    data = pack_clients(ds, parts, batch_size=SAMPLES)
+    padded = pad_client_count(N_CLIENTS, len(mesh.devices.flat))
+    return shard_client_data(pad_clients(data, padded), mesh), padded
+
+
+def test_fused_round_block_under_transfer_guard():
+    """The acceptance-criteria test: a fused R-round block dispatched with
+    device-resident inputs completes under ``jax.transfer_guard("disallow")`` —
+    any implicit host transfer inside dispatch/execution would raise."""
+    model = get_model("linear", in_features=6, num_classes=3)
+    mesh = make_mesh()
+    repl = replicated_sharding(mesh)
+    strategy = fedavg_strategy()
+    data, padded = _client_data(mesh)
+    num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1), jnp.float32)
+    block = build_round_block(
+        model.apply, TrainingConfig(batch_size=SAMPLES, local_epochs=1), mesh,
+        strategy, num_clients=N_CLIENTS, padded_clients=padded,
+    )
+    params = jax.device_put(model.init(jax.random.key(0)), repl)
+    sos = jax.device_put(init_server_state(strategy, params), repl)
+    rpb = 3
+    # Every input COMMITTED to its mesh placement BEFORE the guard — the
+    # contract the Coordinator's strict dispatch follows.  The warm-up call
+    # then compiles for exactly these shardings, so the guarded dispatch has
+    # nothing left to move in ANY direction.
+    num_samples = jax.device_put(num_samples, repl)
+    keys = jax.device_put(stack_round_keys(0, list(range(rpb))), repl)
+    lr = jax.device_put(jnp.ones((rpb,), jnp.float32), repl)
+    mask = jax.device_put(
+        jnp.asarray(np.tile(np.asarray(num_samples > 0, np.float32), (rpb, 1))),
+        repl,
+    )
+    # Warm-up compiles outside the guard (compilation may transfer constants).
+    res = block(params, sos, data, num_samples, keys, lr, cohort_mask=mask)
+    jax.block_until_ready(res.params)
+    with jax.transfer_guard("disallow"):
+        res = block(res.params, res.server_opt_state, data, num_samples,
+                    keys, lr, cohort_mask=mask)
+    jax.block_until_ready(res.params)
+    assert res.metrics["loss"].shape == (rpb,)
+    assert int(res.survivors[0]) == N_CLIENTS
+
+
+def test_single_round_step_under_transfer_guard():
+    model = get_model("linear", in_features=6, num_classes=3)
+    mesh = make_mesh()
+    repl = replicated_sharding(mesh)
+    strategy = fedavg_strategy()
+    data, padded = _client_data(mesh)
+    num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1), jnp.float32)
+    step = build_round_step(
+        model.apply, TrainingConfig(batch_size=SAMPLES, local_epochs=1), mesh,
+        strategy,
+    )
+    params = jax.device_put(model.init(jax.random.key(0)), repl)
+    sos = jax.device_put(init_server_state(strategy, params), repl)
+    weights = jax.device_put(compute_weights(num_samples) * (num_samples > 0), repl)
+    rngs = jax.device_put(stack_rngs(jax.random.key(1), padded), repl)
+    lr = jax.device_put(jnp.float32(1.0), repl)
+    res = step(params, sos, data, weights, rngs, lr)
+    jax.block_until_ready(res.params)
+    with jax.transfer_guard("disallow"):
+        res = step(res.params, res.server_opt_state, data, weights, rngs, lr)
+    jax.block_until_ready(res.params)
+    assert float(res.metrics["participating_clients"]) == N_CLIENTS
+
+
+class TestStrictCoordinator:
+    def _run(self, tmp_path, strict, rounds_per_block=2, **cfg_kwargs):
+        model = get_model("linear", in_features=6, num_classes=3)
+        ds = synthetic_classification(N_CLIENTS * SAMPLES, 3, (6,), seed=0)
+        parts = [np.arange(i * SAMPLES, (i + 1) * SAMPLES) for i in range(N_CLIENTS)]
+        data = pack_clients(ds, parts, batch_size=SAMPLES)
+        coord = Coordinator(
+            model, data,
+            CoordinatorConfig(
+                num_rounds=4, rounds_per_block=rounds_per_block, seed=7,
+                base_dir=tmp_path, save_metrics=False, **cfg_kwargs,
+            ),
+            training=TrainingConfig(batch_size=SAMPLES, local_epochs=1),
+            strict=strict,
+        )
+        return coord, coord.run()
+
+    def test_strict_fused_run_completes_and_matches_default(self, tmp_path):
+        strict_c, strict_hist = self._run(tmp_path / "strict", strict=True)
+        plain_c, plain_hist = self._run(tmp_path / "plain", strict=False)
+        assert [m.status for m in strict_hist] == [RoundStatus.COMPLETED] * 4
+        for a, b in zip(jax.tree.leaves(strict_c.params),
+                        jax.tree.leaves(plain_c.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert [m.agg_metrics.get("loss") for m in strict_hist] == [
+            m.agg_metrics.get("loss") for m in plain_hist
+        ]
+
+    def test_strict_single_round_cohort_path(self, tmp_path):
+        _, hist = self._run(
+            tmp_path, strict=True, rounds_per_block=1, participation_rate=0.5,
+        )
+        assert [m.status for m in hist] == [RoundStatus.COMPLETED] * 4
+
+    def test_strict_validates_contracts_at_construction(self, tmp_path):
+        # The construction-time eval_shape check is active: it has already run
+        # for the fused configuration above; here we assert it raises on a
+        # round program that violates the contract.
+        from nanofed_tpu.analysis import ContractViolation, check_round_step
+
+        model = get_model("linear", in_features=6, num_classes=3)
+        mesh = make_mesh()
+        strategy = fedavg_strategy()
+        data, padded = _client_data(mesh)
+        step = build_round_step(
+            model.apply, TrainingConfig(batch_size=SAMPLES, local_epochs=1),
+            mesh, strategy,
+        )
+        params = model.init(jax.random.key(0))
+        sos = init_server_state(strategy, params)
+
+        def drifted(p, s, d, w, r, lr_scale=1.0):
+            res = step(p, s, d, w, r, lr_scale)
+            return res._replace(
+                params=jax.tree.map(lambda x: x.astype(jnp.bfloat16), res.params)
+            )
+
+        with pytest.raises(ContractViolation, match="params"):
+            check_round_step(
+                drifted, params, sos, data,
+                jax.ShapeDtypeStruct((padded,), jnp.float32),
+                jax.eval_shape(lambda: stack_rngs(jax.random.key(0), padded)),
+            )
+
+    def test_experiment_summary_records_strict(self, tmp_path):
+        from nanofed_tpu.experiments import run_experiment
+
+        summary = run_experiment(
+            model="mlp", num_clients=4, num_rounds=1, local_epochs=1,
+            batch_size=32, train_size=256, out_dir=tmp_path, strict=True,
+        )
+        assert summary["strict"] is True

@@ -1,0 +1,173 @@
+"""Runtime-contract unit tests: eval_shape validation of round programs and the
+strict-mode transfer guard."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.analysis import (
+    ContractViolation,
+    check_round_block,
+    check_round_step,
+    strict_mode,
+)
+from nanofed_tpu.parallel.round_step import RoundStepResult
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _contract_args(n_clients=4, dim=3):
+    params = {"w": _sds((dim,)), "b": _sds(())}
+    sos = {"momentum": _sds((dim,))}
+    data = {"x": _sds((n_clients, 8, dim)), "y": _sds((n_clients, 8), jnp.int32)}
+    weights = _sds((n_clients,))
+    rngs = jax.eval_shape(lambda: jax.random.split(jax.random.key(0), n_clients))
+    return params, sos, data, weights, rngs
+
+
+def _good_step(params, sos, data, weights, rngs, lr_scale=1.0):
+    return RoundStepResult(
+        params=params,
+        server_opt_state=sos,
+        metrics={"loss": jnp.zeros(()), "accuracy": jnp.zeros(())},
+        client_metrics={"loss": jnp.zeros(weights.shape[0])},
+        update_sq_norms=jnp.zeros(weights.shape[0]),
+    )
+
+
+class TestCheckRoundStep:
+    def test_conforming_step_reports_ok(self):
+        params, sos, data, weights, rngs = _contract_args()
+        report = check_round_step(_good_step, params, sos, data, weights, rngs)
+        assert report["program"] == "round_step"
+        assert report["clients"] == 4
+        assert report["metrics"] == ["accuracy", "loss"]
+
+    def test_param_shape_drift_is_named(self):
+        params, sos, data, weights, rngs = _contract_args()
+
+        def drifting(params, sos, data, weights, rngs, lr_scale=1.0):
+            res = _good_step(params, sos, data, weights, rngs)
+            return res._replace(params={"w": params["w"][None], "b": params["b"]})
+
+        with pytest.raises(ContractViolation, match=r"params\['w'\]"):
+            check_round_step(drifting, params, sos, data, weights, rngs)
+
+    def test_structure_drift_is_refused(self):
+        params, sos, data, weights, rngs = _contract_args()
+
+        def restructuring(params, sos, data, weights, rngs, lr_scale=1.0):
+            res = _good_step(params, sos, data, weights, rngs)
+            return res._replace(params={"w": params["w"]})  # dropped a leaf
+
+        with pytest.raises(ContractViolation, match="tree structure"):
+            check_round_step(restructuring, params, sos, data, weights, rngs)
+
+    def test_nonscalar_metric_is_refused(self):
+        params, sos, data, weights, rngs = _contract_args()
+
+        def leaky(params, sos, data, weights, rngs, lr_scale=1.0):
+            res = _good_step(params, sos, data, weights, rngs)
+            return res._replace(metrics={"loss": jnp.zeros(weights.shape[0])})
+
+        with pytest.raises(ContractViolation, match="weighted scalars"):
+            check_round_step(leaky, params, sos, data, weights, rngs)
+
+    def test_wrong_client_width_is_refused(self):
+        params, sos, data, weights, rngs = _contract_args()
+
+        def truncating(params, sos, data, weights, rngs, lr_scale=1.0):
+            res = _good_step(params, sos, data, weights, rngs)
+            return res._replace(update_sq_norms=jnp.zeros(2))
+
+        with pytest.raises(ContractViolation, match="update_sq_norms"):
+            check_round_step(truncating, params, sos, data, weights, rngs)
+
+    def test_nothing_executes(self):
+        # eval_shape only traces: a step that would crash at runtime but traces
+        # fine passes shape validation without ever running.
+        params, sos, data, weights, rngs = _contract_args()
+        ran = []
+
+        def effectful(params, sos, data, weights, rngs, lr_scale=1.0):
+            ran.append(True)  # traced once — but no array math executes
+            return _good_step(params, sos, data, weights, rngs)
+
+        check_round_step(effectful, params, sos, data, weights, rngs)
+        assert ran  # traced
+        # The output leaves were abstract the whole way — nothing concrete.
+
+
+class TestCheckRoundBlock:
+    def test_real_round_block_conforms(self):
+        from nanofed_tpu.data import pack_clients, synthetic_classification
+        from nanofed_tpu.models import get_model
+        from nanofed_tpu.parallel import (
+            build_round_block,
+            init_server_state,
+            make_mesh,
+            pad_client_count,
+            pad_clients,
+            shard_client_data,
+            stack_round_keys,
+        )
+        from nanofed_tpu.aggregation import fedavg_strategy
+        from nanofed_tpu.trainer import TrainingConfig
+
+        model = get_model("linear", in_features=6, num_classes=3)
+        mesh = make_mesh()
+        n_dev = len(mesh.devices.flat)
+        ds = synthetic_classification(32, 3, (6,), seed=0)
+        data = pack_clients(ds, [np.arange(i * 8, (i + 1) * 8) for i in range(4)],
+                            batch_size=8)
+        padded = pad_client_count(4, n_dev)
+        data = shard_client_data(pad_clients(data, padded), mesh)
+        num_samples = jnp.asarray(np.asarray(data.mask).sum(axis=1), jnp.float32)
+        strategy = fedavg_strategy()
+        block = build_round_block(
+            model.apply, TrainingConfig(batch_size=8, local_epochs=1), mesh,
+            strategy, num_clients=4, padded_clients=padded,
+        )
+        params = model.init(jax.random.key(0))
+        sos = init_server_state(strategy, params)
+        rpb = 3
+        report = check_round_block(
+            block, params, sos, data, num_samples,
+            jax.eval_shape(lambda: stack_round_keys(0, list(range(rpb)))),
+            jax.ShapeDtypeStruct((rpb,), jnp.float32),
+            cohort_mask=jax.ShapeDtypeStruct((rpb, padded), jnp.float32),
+        )
+        assert report["program"] == "round_block"
+        assert report["rounds"] == rpb
+        assert report["client_detail"] is True
+
+
+class TestStrictMode:
+    def test_device_resident_dispatch_passes(self):
+        f = jax.jit(lambda x: x * 2)
+        x = jnp.ones((8,))
+        _ = f(x)  # compile outside the guard
+        with strict_mode():
+            y = f(x)
+        assert float(y[0]) == 2.0
+
+    def test_implicit_h2d_into_jit_raises(self):
+        f = jax.jit(lambda x: x * 2)
+        _ = f(jnp.ones((8,)))
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with strict_mode():
+                f(np.ones((8,), np.float32))
+
+    def test_guard_scopes_to_the_context(self):
+        f = jax.jit(lambda x: x * 2)
+        _ = f(jnp.ones((8,)))
+        with strict_mode():
+            pass
+        # Outside the context implicit transfers are allowed again.
+        y = f(np.ones((8,), np.float32))
+        assert float(y[0]) == 2.0

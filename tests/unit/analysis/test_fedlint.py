@@ -1,0 +1,607 @@
+"""fedlint rule fixtures: each rule flags its bad snippet at the right line,
+leaves the good twin clean, and honors a reasoned suppression."""
+
+from __future__ import annotations
+
+import textwrap
+
+from nanofed_tpu.analysis import lint_source
+
+
+def _lint(src: str, module: str = "fixture"):
+    return lint_source(textwrap.dedent(src), module=module)
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# FED000 — suppressions must carry a reason
+# ---------------------------------------------------------------------------
+
+
+class TestFed000:
+    def test_reasonless_suppression_is_flagged(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))  # fedlint: disable=FED003
+                return a + b
+            """
+        )
+        # The malformed suppression is flagged AND does not suppress: the
+        # underlying FED003 finding survives.
+        assert _codes(diags) == ["FED000", "FED003"]
+        assert diags[0].line == 6
+
+    def test_reasoned_suppression_is_honored(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))  # fedlint: disable=FED003 (correlated on purpose: antithetic pair)
+                return a + b
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED001 — host sync in traced scope / hot path
+# ---------------------------------------------------------------------------
+
+
+class TestFed001:
+    def test_float_cast_of_traced_value_flagged(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                y = jnp.sum(x)
+                return float(y)
+            """
+        )
+        assert _codes(diags) == ["FED001"]
+        assert diags[0].line == 8
+
+    def test_item_and_device_get_flagged_in_shard_map_body(self):
+        diags = _lint(
+            """
+            import jax
+            from nanofed_tpu.parallel.mesh import shard_map
+
+            def body(x):
+                host = jax.device_get(x)
+                return x.sum().item()
+
+            program = shard_map(body, mesh=None, in_specs=(), out_specs=())
+            """
+        )
+        assert _codes(diags) == ["FED001", "FED001"]
+        assert [d.line for d in diags] == [6, 7]
+
+    def test_np_asarray_flagged_via_call_edge_propagation(self):
+        # helper is traced because the scan BODY calls it — the call-edge
+        # propagation the rule catalogue promises.
+        diags = _lint(
+            """
+            import jax
+            import numpy as np
+            from jax import lax
+
+            def helper(x):
+                return np.asarray(x)
+
+            def scanned(carry, x):
+                return carry, helper(x)
+
+            def run(xs):
+                return lax.scan(scanned, 0.0, xs)
+            """
+        )
+        assert _codes(diags) == ["FED001"]
+        assert diags[0].line == 7
+
+    def test_float_on_static_config_is_clean(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def make(step_size):
+                @jax.jit
+                def step(x):
+                    lr = float(step_size)
+                    return x * lr
+                return step
+            """
+        )
+        assert diags == []
+
+    def test_host_sync_outside_traced_scope_is_clean(self):
+        diags = _lint(
+            """
+            import jax
+            import numpy as np
+
+            def fetch(x):
+                return np.asarray(jax.device_get(x))
+            """
+        )
+        assert diags == []
+
+    def test_hot_path_block_until_ready_needs_suppression(self):
+        src = """
+        import jax
+
+        def dispatch(params):
+            jax.block_until_ready(params)
+        """
+        diags = _lint(src, module="nanofed_tpu.orchestration.fake")
+        assert _codes(diags) == ["FED001"]
+        assert diags[0].line == 5
+        # The same module with a documented suppression is clean.
+        sup = src.replace(
+            "jax.block_until_ready(params)",
+            "jax.block_until_ready(params)  "
+            "# fedlint: disable=FED001 (block-boundary sync)",
+        )
+        assert _lint(sup, module="nanofed_tpu.orchestration.fake") == []
+        # Outside the hot-path modules the non-traced call is clean.
+        assert _lint(src, module="somewhere.else") == []
+
+
+# ---------------------------------------------------------------------------
+# FED002 — Python control flow on traced values
+# ---------------------------------------------------------------------------
+
+
+class TestFed002:
+    def test_if_on_traced_value_flagged(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                s = jnp.sum(x)
+                if s > 0:
+                    return s
+                return -s
+            """
+        )
+        assert _codes(diags) == ["FED002"]
+        assert diags[0].line == 8
+
+    def test_while_on_traced_value_flagged(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                while jnp.max(x) > 1.0:
+                    x = x * 0.5
+                return x
+            """
+        )
+        assert _codes(diags) == ["FED002"]
+        assert diags[0].line == 7
+
+    def test_static_branching_is_clean(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def make(use_momentum, chunk):
+                @jax.jit
+                def step(x, mask):
+                    n = x.shape[0]
+                    if use_momentum:
+                        x = x * 2
+                    if chunk is not None and n % chunk != 0:
+                        raise ValueError("bad chunk")
+                    if mask is None:
+                        mask = jnp.ones(n)
+                    return x * mask
+                return step
+            """
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                s = jnp.sum(x)
+                if s > 0:  # fedlint: disable=FED002 (concretization accepted: debug-only path)
+                    return s
+                return -s
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED003 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+class TestFed003:
+    def test_reuse_flagged_at_second_consumption(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+            """
+        )
+        assert _codes(diags) == ["FED003"]
+        assert diags[0].line == 6
+        assert "'key'" in diags[0].message
+
+    def test_split_between_draws_is_clean(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.uniform(k1, (3,))
+                b = jax.random.normal(k2, (3,))
+                return a + b
+            """
+        )
+        assert diags == []
+
+    def test_fold_in_derivation_is_clean(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key, rounds):
+                outs = []
+                for r in range(rounds):
+                    k = jax.random.fold_in(key, r)
+                    outs.append(jax.random.uniform(k, (3,)))
+                return outs
+            """
+        )
+        assert diags == []
+
+    def test_cross_iteration_reuse_flagged(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key, rounds):
+                outs = []
+                for r in range(rounds):
+                    outs.append(jax.random.uniform(key, (3,)))
+                return outs
+            """
+        )
+        assert _codes(diags) == ["FED003"]
+        assert diags[0].line == 7
+
+    def test_exclusive_branches_are_clean(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key, coin):
+                if coin:
+                    return jax.random.uniform(key, (3,))
+                else:
+                    return jax.random.normal(key, (3,))
+            """
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))  # fedlint: disable=FED003 (paired draw reuses the key by design)
+                return a + b
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED004 — params-shaped jit without donation
+# ---------------------------------------------------------------------------
+
+
+class TestFed004:
+    def test_lambda_jit_without_donation_flagged(self):
+        diags = _lint(
+            """
+            import jax
+
+            apply_update = jax.jit(lambda params, delta: params)
+            """
+        )
+        assert _codes(diags) == ["FED004"]
+        assert diags[0].line == 4
+
+    def test_decorated_def_without_donation_flagged(self):
+        diags = _lint(
+            """
+            import jax
+            from functools import partial
+
+            @jax.jit
+            def apply_update(params, delta):
+                return params
+            """
+        )
+        assert _codes(diags) == ["FED004"]
+        assert diags[0].line == 5
+
+    def test_donated_variants_are_clean(self):
+        diags = _lint(
+            """
+            import jax
+            from functools import partial
+
+            update_a = jax.jit(lambda params, d: params, donate_argnums=(0,))
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def update_b(params, d):
+                return params
+
+            gather = jax.jit(lambda data, idx: data)
+            """
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import jax
+
+            # fedlint: disable=FED004 (params reused by the caller after eval)
+            evaluate = jax.jit(lambda params, data: params)
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED005 — unlocked mutation of lock-guarded state
+# ---------------------------------------------------------------------------
+
+_SERVER_TEMPLATE = """
+import asyncio
+
+
+class Server:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._updates = {}
+        self._round = 0
+
+    async def submit(self, cid, update):
+        async with self._lock:
+            self._updates[cid] = update
+
+__EXTRA__
+"""
+
+
+def _server_src(extra: str) -> str:
+    return _SERVER_TEMPLATE.replace("__EXTRA__", extra)
+
+
+class TestFed005:
+    def test_unlocked_mutation_of_guarded_attr_flagged(self):
+        diags = _lint(_server_src("""
+    def reset(self):
+        self._updates.clear()
+"""
+        ))
+        assert _codes(diags) == ["FED005"]
+        assert "_updates" in diags[0].message and "reset" in diags[0].message
+
+    def test_locked_everywhere_is_clean(self):
+        diags = _lint(_server_src("""
+    async def reset(self):
+        async with self._lock:
+            self._updates.clear()
+"""
+        ))
+        assert diags == []
+
+    def test_unguarded_attr_is_not_flagged(self):
+        # _round is never mutated under the lock anywhere -> not shared-locked
+        # state; mutating it unlocked is out of this rule's scope.
+        diags = _lint(_server_src("""
+    def advance(self):
+        self._round += 1
+"""
+        ))
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(_server_src("""
+    def reset(self):
+        # fedlint: disable=FED005 (sync method on the event loop: no await point, handlers cannot interleave)
+        self._updates.clear()
+"""
+        ))
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED006 — blocking calls in async code
+# ---------------------------------------------------------------------------
+
+
+class TestFed006:
+    def test_time_sleep_flagged(self):
+        diags = _lint(
+            """
+            import time
+
+            async def poll(server):
+                time.sleep(1.0)
+                return server.done
+            """
+        )
+        assert _codes(diags) == ["FED006"]
+        assert diags[0].line == 5
+
+    def test_sync_file_io_flagged(self):
+        diags = _lint(
+            """
+            async def dump(path, payload):
+                with open(path, "w") as f:
+                    f.write(payload)
+                path.write_text(payload)
+            """
+        )
+        assert _codes(diags) == ["FED006", "FED006"]
+        assert [d.line for d in diags] == [3, 5]
+
+    def test_asyncio_sleep_and_to_thread_are_clean(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def poll(server):
+                await asyncio.sleep(1.0)
+                return await asyncio.to_thread(server.read)
+            """
+        )
+        assert diags == []
+
+    def test_sync_function_is_out_of_scope(self):
+        diags = _lint(
+            """
+            import time
+
+            def poll(server):
+                time.sleep(1.0)
+                return open("/tmp/x").read()
+            """
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import time
+
+            async def poll(server):
+                time.sleep(0.001)  # fedlint: disable=FED006 (sub-ms backoff, measured harmless)
+                return server.done
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# Engine plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_file_level_suppression(self):
+        diags = _lint(
+            """
+            # fedlint: disable-file=FED003 (fixture exercising correlated draws)
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+            """
+        )
+        assert diags == []
+
+    def test_select_filters_rules(self):
+        from nanofed_tpu.analysis.fedlint import lint_source as ls
+
+        src = textwrap.dedent(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+
+            update = jax.jit(lambda params, d: params)
+            """
+        )
+        assert _codes(ls(src, select={"FED004"})) == ["FED004"]
+        assert _codes(ls(src)) == ["FED003", "FED004"]
+
+    def test_render_text_summarizes(self):
+        from nanofed_tpu.analysis import render_text
+
+        diags = _lint(
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+            """
+        )
+        text = render_text(diags)
+        assert "FED003" in text and "1 finding" in text
+        assert render_text([]) == "fedlint: clean"
+
+    def test_cli_entry_point(self, tmp_path):
+        import subprocess
+        import sys
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n"
+            "def sample(key):\n"
+            "    a = jax.random.uniform(key, (3,))\n"
+            "    b = jax.random.normal(key, (3,))\n"
+            "    return a + b\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "nanofed_tpu.analysis", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "FED003" in proc.stdout
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "nanofed_tpu.analysis", str(good)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0
+        assert "clean" in proc.stdout

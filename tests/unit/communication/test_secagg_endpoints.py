@@ -46,7 +46,7 @@ def test_register_requires_open_enrollment():
             headers={HEADER_CLIENT: "c1"},
         )
         assert resp.status == 403  # not open
-        server.open_secagg(2)
+        await server.open_secagg(2)
         resp = await client.post(
             "/secagg/register",
             json={"public_key": PK, "num_samples": 10.0},
@@ -59,7 +59,7 @@ def test_register_requires_open_enrollment():
 
 def test_cohort_full_and_reregistration():
     async def scenario(server, client):
-        server.open_secagg(1)
+        await server.open_secagg(1)
         for cid, want in [("c1", 200), ("c2", 403), ("c1", 200)]:  # re-register ok
             resp = await client.post(
                 "/secagg/register",
@@ -73,7 +73,7 @@ def test_cohort_full_and_reregistration():
 
 def test_bad_registrations_rejected():
     async def scenario(server, client):
-        server.open_secagg(3)
+        await server.open_secagg(3)
         bad = [
             {"public_key": base64.b64encode(b"short").decode(), "num_samples": 5.0},
             {"public_key": PK, "num_samples": 0.0},
@@ -92,7 +92,7 @@ def test_bad_registrations_rejected():
 
 def test_roster_completion_and_weights():
     async def scenario(server, client):
-        server.open_secagg(2)
+        await server.open_secagg(2)
         resp = await client.get("/secagg/roster")
         payload = await resp.json()
         assert payload["complete"] is False and payload["enrolled"] == 0
@@ -115,7 +115,7 @@ def test_masked_payload_structural_validation():
     params = {"w": jnp.zeros((3, 2)), "b": jnp.zeros((2,))}
 
     async def scenario(server, client):
-        server.open_secagg(1)
+        await server.open_secagg(1)
         await client.post(
             "/secagg/register",
             json={"public_key": PK, "num_samples": 5.0},
@@ -153,7 +153,7 @@ def test_publish_model_clears_stale_masked_updates():
     params = {"w": jnp.zeros((4,))}
 
     async def scenario(server, client):
-        server.open_secagg(1)
+        await server.open_secagg(1)
         await client.post(
             "/secagg/register",
             json={"public_key": PK, "num_samples": 5.0},
