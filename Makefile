@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke example dryrun api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -31,6 +31,12 @@ example:
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# 1-D vs 2-D (clients x model) mesh round-step parity on the virtual 8-device
+# CPU mesh: asserts loss parity + model-sharded output layout and prints the
+# walltime / model-state-memory comparison (FSDP parameter sharding).
+dryrun-multichip-2d:
+	python -c "from __graft_entry__ import dryrun_multichip_2d; dryrun_multichip_2d(8)"
 
 api-docs:
 	python scripts/gen_api_docs.py

@@ -38,6 +38,11 @@ baseline is also CPU) so the driver always records a parseable number.  The acce
 failure is never silent: each attempt's rc + stderr tail is appended to
 ``runs/bench_accel_failure.log`` AND embedded as ``accel_failure`` in the fallback
 JSON records, so the recorded artifact itself says why the chip number is missing.
+Every worker budget is carved out of ONE ``NANOFED_BENCH_TOTAL_BUDGET`` (round-5
+lesson: a fixed 3600 s CPU budget on top of a spent accel path overran the
+driver's outer timeout — rc=124 mid-fallback): a fresh persisted "wedged" probe
+verdict skips the accelerator entirely (``plan_accel_attempt``) and the CPU
+worker inherits the full remaining budget.
 
 The CPU fallback measures each workload at TWO reduced scales (parity 1/50 + 1/25
 sample scale, flagship 1/100 + 1/50 client scale — full-scale rounds exceed any
@@ -97,6 +102,19 @@ PROBE_CACHE_PATH = os.environ.get(
     "NANOFED_BENCH_PROBE_CACHE", ".jax_cache/backend_probe.json"
 )
 PROBE_CACHE_TTL_S = float(os.environ.get("NANOFED_BENCH_PROBE_TTL", 1800.0))
+# Whole-run budget accounting (round-5 lesson, second act: the orchestrator gave
+# the CPU fallback a FIXED 3600 s after the accel path had already burned ~5 min,
+# and the driver's outer timeout killed the run mid-fallback — rc=124, nothing
+# authoritative recorded).  Every worker budget is now carved out of ONE total:
+# whatever the accel path does not spend (skipped entirely on a persisted
+# "wedged" verdict) is handed to the CPU worker, and the CPU budget is always
+# "remaining total minus orchestrator slack" rather than a constant that ignores
+# history.
+TOTAL_BUDGET_S = float(os.environ.get("NANOFED_BENCH_TOTAL_BUDGET", 3300.0))
+# Below this floor the CPU fallback cannot finish even the reduced-scale
+# workloads — don't start a doomed worker, emit the error records instead.
+CPU_MIN_BUDGET_S = 300.0
+ORCHESTRATOR_SLACK_S = 60.0
 COMPILE_TIMEOUT_S = float(os.environ.get("NANOFED_BENCH_COMPILE_TIMEOUT", 420.0))
 # The outer subprocess budget must exceed the worker's internal watchdogs (init +
 # 2x compile + measurement slack) or the structured error JSON could never be emitted.
@@ -128,6 +146,41 @@ def read_probe_cache(
     if now - record["at_unix"] > ttl_s:
         return None
     return record
+
+
+def read_probe_record(path: str = None) -> dict | None:
+    """The persisted probe verdict REGARDLESS of TTL (or None when absent /
+    corrupt).  A stale record is still evidence: see ``plan_accel_attempt``."""
+    return read_probe_cache(path=path, ttl_s=float("inf"))
+
+
+def plan_accel_attempt(
+    record: dict | None, now: float = None, ttl_s: float = None
+) -> str:
+    """Decide the accelerator strategy from the persisted probe verdict.
+
+    Returns one of:
+
+    * ``"skip"``    — fresh "wedged" verdict: do NOT touch the accelerator at
+      all (no probe, no measurement); its entire budget goes to the CPU worker
+      so the authoritative record lands inside the driver budget.
+    * ``"probe"``   — no verdict, a corrupt one, or ANY stale verdict: spend one
+      short probe first; only a passing probe opens the full measurement.  In
+      particular a STALE "wedged" verdict never goes straight to the full accel
+      budget — that path cost ~22 min of watchdog timeouts in round 5.
+    * ``"attempt"`` — fresh "ok" verdict: go straight to the measurement.
+
+    Pure and parameterized (record/now/ttl) so the policy is unit-testable."""
+    now = time.time() if now is None else now
+    ttl_s = PROBE_CACHE_TTL_S if ttl_s is None else ttl_s
+    if record is None or record.get("verdict") not in ("ok", "wedged"):
+        return "probe"
+    if not isinstance(record.get("at_unix"), (int, float)):
+        return "probe"
+    fresh = now - record["at_unix"] <= ttl_s
+    if record["verdict"] == "wedged":
+        return "skip" if fresh else "probe"
+    return "attempt" if fresh else "probe"
 
 
 def write_probe_cache(verdict: str, detail: dict | None = None,
@@ -386,6 +439,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         build_round_step,
         init_server_state,
         make_mesh,
+        mesh_shape,
         pad_client_count,
         pad_clients,
         replicated_sharding,
@@ -426,7 +480,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
     # IDENTICAL rounds when anything else briefly touches the core (observed r05:
     # 67.6 s vs 97.4 s at 1/200), and with 2 + 1 rounds a single contended round
     # swings the linearity ratio from 1.29 to 0.75 across runs — medians over 3/2
-    # absorb one outlier. Still well inside the orchestrator's 3600 s CPU budget.
+    # absorb one outlier. Still inside the CPU worker's share of TOTAL_BUDGET_S.
     reps = 3
     secondary_reps = 2 if on_cpu else 1
 
@@ -537,6 +591,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             "metric": METRIC_PARITY,
             "unit": "s",
             "platform": str(devices[0].platform),
+            "mesh_shape": list(mesh_shape(mesh)),
         })
         if BENCH_STRICT:
             out["strict"] = True
@@ -567,7 +622,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             chunk = 125 if scale == 1 else 1  # keep the streaming path
             # R=3 on accelerators (the old steady-state rep count, now one block);
             # R=2 on the CPU fallback so warm-up + timed blocks stay within the
-            # orchestrator's 3600s budget at the measured ~139s/round pace.
+            # CPU worker's budget share at the measured ~139s/round pace.
             r_block = int(rpb_env) if rpb_env else (2 if on_cpu else reps)
             rpb_by_scale[f"1/{scale}"] = r_block
             with tracer.span("prepare", scale=scale):
@@ -597,6 +652,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             "client_chunk": 125 if not on_cpu else 1,
             "compute_dtype": "bfloat16",
             "devices": n_dev,
+            "mesh_shape": list(mesh_shape(mesh)),
             "rounds_per_block": headline_rpb,
             "baseline_basis": (
                 f"reference tutorial 53.48s / {PARITY_SAMPLE_PASSES} sample-passes "
@@ -712,22 +768,37 @@ def main() -> None:
         return [w for w, m in (("parity", METRIC_PARITY), ("flagship", METRIC_FLAGSHIP))
                 if m not in have]
 
-    # Consult the persisted probe verdict BEFORE committing the full accel budget:
-    # a fresh "wedged" verdict (or a failed short probe when no verdict is cached)
-    # sends the run straight to the CPU fallback, so a dead tunnel costs one probe
-    # (~2-3 min) instead of ~22 min of watchdog timeouts (round-5 post-mortem).
+    # Consult the persisted probe verdict BEFORE committing ANY accel budget
+    # (plan_accel_attempt): a fresh "wedged" verdict skips the accelerator
+    # entirely — not even a probe — and a stale one costs one short probe, never
+    # the full measurement budget.  Every worker budget below is carved out of
+    # TOTAL_BUDGET_S, so whatever the accel path skips or leaves unspent is
+    # handed to the CPU fallback and the authoritative record lands inside the
+    # driver budget (round-5 post-mortem: rc=124 mid-fallback).
+    t_start = time.time()
+
+    def remaining_budget() -> float:
+        return TOTAL_BUDGET_S - (time.time() - t_start) - ORCHESTRATOR_SLACK_S
+
+    def accel_budget() -> float:
+        # Never let an accel attempt strand the CPU fallback below its floor.
+        return min(TPU_WORKER_BUDGET_S,
+                   max(0.0, remaining_budget() - CPU_MIN_BUDGET_S))
+
     results = []
     accel_failures = []
-    attempt_accel = True
-    cached = read_probe_cache()
-    if cached is not None:
-        print(f"[bench] cached backend-probe verdict: {cached['verdict']} "
-              f"(age {time.time() - cached['at_unix']:.0f}s)",
+    record = read_probe_record()
+    plan = plan_accel_attempt(record)
+    if record is not None:
+        print(f"[bench] persisted backend-probe verdict: {record['verdict']} "
+              f"(age {time.time() - record['at_unix']:.0f}s) -> plan: {plan}",
               file=sys.stderr, flush=True)
-        if cached["verdict"] == "wedged":
-            attempt_accel = False
-            accel_failures.append({"attempt": "probe-cache", **cached})
-    else:
+    attempt_accel = plan == "attempt"
+    if plan == "skip":
+        print("[bench] fresh 'wedged' verdict: skipping the accelerator entirely; "
+              "its full budget goes to the CPU worker", file=sys.stderr, flush=True)
+        accel_failures.append({"attempt": "probe-cache", **record})
+    elif plan == "probe":
         probe_results, probe_diag = _spawn(
             "accel", PROBE_TIMEOUT_S + 30.0, ["probe"], mode="--probe"
         )
@@ -735,14 +806,29 @@ def main() -> None:
         write_probe_cache("ok" if probe_ok else "wedged", {"source": "pre-probe"})
         print(f"[bench] backend pre-probe: {'ok' if probe_ok else 'failed'}",
               file=sys.stderr, flush=True)
+        attempt_accel = probe_ok
         if not probe_ok:
-            attempt_accel = False
             _log_accel_failure("probe-upfront", probe_diag)
             accel_failures.append({"attempt": "probe-upfront", **probe_diag})
 
+    def _record_budget_skip(attempt: str) -> None:
+        # "failure is never silent" covers budget-gated skips too: the fallback
+        # records must say the accel attempt was skipped for lack of budget,
+        # not embed an empty failure list.
+        skip = {
+            "skipped": "insufficient budget",
+            "accel_budget_s": round(accel_budget(), 1),
+            "total_budget_s": TOTAL_BUDGET_S,
+        }
+        _log_accel_failure(attempt, skip)
+        accel_failures.append({"attempt": attempt, **skip})
+
     missing = ["parity", "flagship"]
+    if attempt_accel and accel_budget() <= PROBE_TIMEOUT_S:
+        _record_budget_skip("accel-1-budget")
+        attempt_accel = False
     if attempt_accel:
-        results, diag = _spawn("accel", TPU_WORKER_BUDGET_S, ["parity", "flagship"])
+        results, diag = _spawn("accel", accel_budget(), ["parity", "flagship"])
         missing = run_missing(results)
         if not missing:
             write_probe_cache("ok", {"source": "accel-run"})
@@ -759,8 +845,10 @@ def main() -> None:
             write_probe_cache("ok" if probe_ok else "wedged", {"source": "re-probe"})
             print(f"[bench] backend re-probe: {'ok' if probe_ok else 'failed'}",
                   file=sys.stderr, flush=True)
-            if probe_ok:
-                retry, diag2 = _spawn("accel", TPU_WORKER_BUDGET_S, missing)
+            if probe_ok and accel_budget() <= PROBE_TIMEOUT_S:
+                _record_budget_skip("accel-2-budget")
+            elif probe_ok:
+                retry, diag2 = _spawn("accel", accel_budget(), missing)
                 results += retry
                 missing = run_missing(results)
                 if missing:
@@ -770,14 +858,25 @@ def main() -> None:
                 _log_accel_failure("probe", probe_diag)
                 accel_failures.append({"attempt": "probe", **probe_diag})
     if missing:
-        print(f"[bench] accelerator attempt incomplete (missing: {missing}) — falling back "
-              "to honest CPU measurement (reference baseline is CPU too; labeled "
-              "platform=cpu)", file=sys.stderr, flush=True)
-        # Budget sized for the measured 1-core pace at the two-scale fallback
-        # (parity ~140s compile + 3x125s + 2x250s secondary; flagship ~130s compile
-        # + 3x139s + 2x274s secondary); the persistent cache makes repeat
-        # invocations skip the compiles.
-        fallback, _ = _spawn("cpu", 3600.0, missing)
+        # The CPU worker inherits EVERYTHING the accel path did not spend —
+        # the full total on a skipped accelerator.  Workload pace notes: parity
+        # ~140s compile + 3x125s + 2x250s secondary; flagship ~130s compile +
+        # 3x139s + 2x274s secondary; the persistent compilation cache makes
+        # repeat invocations skip the compiles.
+        cpu_budget = remaining_budget()
+        if cpu_budget < CPU_MIN_BUDGET_S:
+            print(f"[bench] only {cpu_budget:.0f}s left of the "
+                  f"{TOTAL_BUDGET_S:.0f}s total — below the {CPU_MIN_BUDGET_S:.0f}s "
+                  "CPU floor; emitting error records instead of starting a doomed "
+                  "worker", file=sys.stderr, flush=True)
+            fallback = []
+        else:
+            print(f"[bench] accelerator attempt incomplete (missing: {missing}) — "
+                  f"falling back to honest CPU measurement with the remaining "
+                  f"{cpu_budget:.0f}s of the {TOTAL_BUDGET_S:.0f}s total "
+                  "(reference baseline is CPU too; labeled platform=cpu)",
+                  file=sys.stderr, flush=True)
+            fallback, _ = _spawn("cpu", cpu_budget, missing)
         for r in fallback:
             # The recorded artifact itself says why the chip number is missing.
             r["accel_failure"] = accel_failures

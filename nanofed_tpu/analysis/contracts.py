@@ -16,8 +16,9 @@ it on data:
   fused hot path syncs only where the Coordinator says it does
   (``Coordinator(strict=True)`` / CLI ``--strict`` / bench
   ``NANOFED_BENCH_STRICT=1``).
-* :func:`check_input_shardings` spot-checks the data-parallel layout: client
-  data sharded over the client axis, params replicated.
+* :func:`check_input_shardings` spot-checks the parallel layout: client data
+  sharded over the client axis (and nothing else), params replicated — or, on
+  a 2-D ``clients x model`` mesh, model-sharded per the FSDP layout.
 
 Zero execution, zero compilation: ``eval_shape`` only traces, so strict
 construction costs milliseconds even at the 1000-client flagship shape.
@@ -188,12 +189,37 @@ def check_round_block(
     }
 
 
-def check_input_shardings(data: Any, params: Any, axis_name: str = "clients") -> None:
-    """Spot-check the data-parallel layout on CONCRETE inputs: every client-data
-    leaf sharded over ``axis_name`` in its leading dimension, every params leaf
-    replicated.  Leaves that carry no ``NamedSharding`` (host arrays, abstract
-    values, single-device placements) are skipped — this is a layout audit, not
-    a placement requirement."""
+def _spec_axes(entry: Any) -> tuple:
+    """Mesh axes a single PartitionSpec entry shards over (an entry is None, an
+    axis name, or a tuple of axis names)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def check_input_shardings(
+    data: Any,
+    params: Any,
+    axis_name: str = "clients",
+    model_axis: str = "model",
+) -> None:
+    """Spot-check the parallel layout on CONCRETE inputs.
+
+    Client data: every leaf sharded over ``axis_name`` in its leading dimension
+    and over nothing else in the trailing ones (on a 2-D mesh that means
+    replicated over ``model`` — every model column holds its clients whole).
+
+    Params (and any params-shaped state): every leaf either fully replicated
+    (the 1-D layout) or sharded ONLY over ``model_axis`` (the FSDP layout of a
+    2-D ``clients x model`` mesh — at most one sharded dimension, never the
+    client axis: a client-sharded param leaf would make every client train a
+    different slice of the model).
+
+    Leaves that carry no ``NamedSharding`` (host arrays, abstract values,
+    single-device placements) are skipped — this is a layout audit, not a
+    placement requirement."""
     from jax.sharding import NamedSharding
 
     for path, leaf in _leaves_with_paths(data):
@@ -206,14 +232,26 @@ def check_input_shardings(data: Any, params: Any, axis_name: str = "clients") ->
                 f"data{path}: expected leading-axis sharding over {axis_name!r}, "
                 f"got spec {spec} — the round program shards clients over the mesh"
             )
+        for entry in tuple(spec)[1:]:
+            if _spec_axes(entry):
+                raise ContractViolation(
+                    f"data{path}: trailing dimensions must be replicated (got "
+                    f"spec {spec}) — a client's batch rides each model column "
+                    "whole"
+                )
     for path, leaf in _leaves_with_paths(params):
         sharding = getattr(leaf, "sharding", None)
         if not isinstance(sharding, NamedSharding):
             continue
-        if not sharding.is_fully_replicated:
+        if sharding.is_fully_replicated:
+            continue
+        sharded_axes = [a for entry in sharding.spec for a in _spec_axes(entry)]
+        if any(a != model_axis for a in sharded_axes) or len(sharded_axes) > 1:
             raise ContractViolation(
-                f"params{path}: expected replicated placement, got spec "
-                f"{sharding.spec} — global params ride every device whole"
+                f"params{path}: expected replicated placement or a single "
+                f"dimension sharded over {model_axis!r}, got spec "
+                f"{sharding.spec} — params ride every device whole (1-D) or "
+                "split over the model axis only (2-D FSDP layout)"
             )
 
 

@@ -95,6 +95,21 @@ def _cmd_run(args: argparse.Namespace) -> int:
               f"(eps={args.dp_epsilon}, delta={args.dp_delta}) over {args.rounds} "
               "rounds (tight RDP accounting)", file=sys.stderr)
 
+    if args.model_shards != 1:
+        # Same up-front courtesy as the other invalid combinations: validate
+        # against the device count HERE (the one place that forces backend
+        # init) so the error is a CLI message, not a traceback —
+        # run_experiment re-runs the identical shared validator.
+        import jax
+
+        from nanofed_tpu.parallel import mesh_shape_for_model_shards
+
+        try:
+            mesh_shape_for_model_shards(args.model_shards, len(jax.devices()))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
     metrics = run_experiment(
         model=args.model,
         num_clients=args.clients,
@@ -121,6 +136,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         telemetry_dir=args.telemetry_dir,
         rounds_per_block=args.rounds_per_block,
         client_metrics_every=args.client_metrics_every,
+        model_shards=args.model_shards,
         strict=args.strict,
     )
     print(json.dumps(metrics, indent=2, default=str))
@@ -342,6 +358,14 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument(
         "--dtype", default=None, choices=["bfloat16", "float32"],
         help="local-training compute dtype (mixed precision when bfloat16)",
+    )
+    run.add_argument(
+        "--model-shards", type=int, default=1, metavar="N",
+        help="split params + server optimizer state N ways over a second "
+        "'model' mesh axis (FSDP-style; devices arrange as a (devices/N, N) "
+        "clients x model mesh). Each leaf's largest divisible dimension is "
+        "sharded; the model never materializes replicated between rounds. "
+        "N must divide the device count; 1 = classic replicated layout",
     )
     run.add_argument(
         "--rounds-per-block", type=int, default=1,

@@ -28,7 +28,14 @@ from nanofed_tpu.persistence.serialization import (
 
 
 def encode_params(params: Params) -> bytes:
-    """Params pytree -> compressed npz bytes."""
+    """Params pytree -> compressed npz bytes.
+
+    Committed device-sharded leaves (e.g. model-sharded params off a 2-D
+    ``clients x model`` mesh) are gathered to host arrays FIRST: ``np.asarray``
+    on a sharded ``jax.Array`` either raises or silently assembles per-shard
+    copies depending on layout, while ``jax.device_get`` performs the one
+    well-defined gather for every leaf of the tree."""
+    params = jax.device_get(params)
     try:
         arrays = flatten_to_arrays(params)
     except CheckpointError as e:

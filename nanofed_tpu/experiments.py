@@ -73,6 +73,7 @@ def run_experiment(
     telemetry_dir: str | Path | None = None,
     rounds_per_block: int = 1,
     client_metrics_every: int = 1,
+    model_shards: int = 1,
     strict: bool = False,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
@@ -92,6 +93,12 @@ def run_experiment(
     ``compute_dtype="bfloat16"`` runs local forward/backward in bf16 on the MXU (mixed
     precision; params/updates stay float32).
 
+    ``model_shards > 1`` (CLI ``--model-shards``) arranges the devices as a
+    2-D ``(devices/model_shards, model_shards)`` clients x model mesh and
+    FSDP-shards params + server optimizer state over the model axis (see
+    ``parallel.mesh.param_sharding``) — the model never materializes
+    replicated between rounds; must divide the device count.
+
     ``strict=True`` (CLI ``--strict``) enables the analysis-subsystem runtime
     guards: round programs are contract-checked at build time via
     ``jax.eval_shape`` and every device dispatch runs under
@@ -107,6 +114,10 @@ def run_experiment(
             trim_k=robust_trim_k if robust_trim_k is not None else 1,
             method=robust_method or "trimmed_mean",
         )
+    from nanofed_tpu.parallel import mesh_shape_for_model_shards
+
+    mesh_shape = mesh_shape_for_model_shards(model_shards, len(jax.devices()))
+
     mdl = get_model(model)
     train, test = load_datasets_for(mdl, data_dir, train_size, seed)
     log.info("dataset %s: %d train / %d test samples", train.name, len(train), len(test))
@@ -144,6 +155,7 @@ def run_experiment(
         robust=robust,
         scaffold=scaffold,
         telemetry_dir=telemetry_dir,
+        mesh_shape=mesh_shape,
         strict=strict,
     )
     rounds = coordinator.run()
@@ -165,5 +177,6 @@ def run_experiment(
         "final_eval_metrics": final_eval,
         "round_durations_s": [r.duration_s for r in rounds],
         "devices": [str(d) for d in jax.devices()],
+        **({"mesh_shape": list(mesh_shape)} if mesh_shape is not None else {}),
         **({"strict": True} if strict else {}),
     }

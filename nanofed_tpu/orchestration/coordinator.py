@@ -45,9 +45,13 @@ from nanofed_tpu.observability.spans import SpanTracer
 from nanofed_tpu.observability.telemetry import RunTelemetry, install_jax_event_bridge
 from nanofed_tpu.orchestration.types import RoundMetrics, RoundStatus, TrainingProgress
 from nanofed_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    client_axis_size,
     make_mesh,
+    model_axis_size,
     pad_client_count,
     pad_clients,
+    param_sharding,
     replicated_sharding,
     shard_client_data,
 )
@@ -139,6 +143,7 @@ class Coordinator:
         training: TrainingConfig | None = None,
         strategy: Strategy | None = None,
         mesh=None,
+        mesh_shape: tuple[int, int] | None = None,
         eval_data: ClientData | None = None,
         model_manager=None,
         state_store=None,
@@ -158,7 +163,18 @@ class Coordinator:
         self.config = config
         self.training = training or TrainingConfig()
         self.strategy = strategy or fedavg_strategy()
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # mesh_shape=(n_client_shards, n_model_shards) builds the 2-D clients x
+        # model mesh (FSDP-style parameter sharding — see parallel.mesh); an
+        # explicit mesh= wins and must not be combined with it.
+        if mesh is not None and mesh_shape is not None:
+            raise ValueError(
+                "pass either mesh= (a prebuilt Mesh) or mesh_shape= "
+                "(n_client_shards, n_model_shards), not both"
+            )
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            self.mesh = make_mesh(shape=mesh_shape)
         self.model_manager = model_manager
         self.state_store = state_store
         self.on_round_end = on_round_end
@@ -192,13 +208,33 @@ class Coordinator:
         self._secret_sampling_rng = np.random.default_rng()
 
         self.num_clients = int(train_data.x.shape[0])
-        n_dev = len(self.mesh.devices.flat)
+        # Clients pad to the number of CLIENT shards (== device count on a 1-D
+        # mesh; the first mesh dim on a 2-D clients x model mesh — the model
+        # axis holds parameter shards, not clients).
+        n_dev = client_axis_size(self.mesh)
         padded = pad_client_count(self.num_clients, n_dev)
         self._data = shard_client_data(pad_clients(train_data, padded), self.mesh)
         self._num_samples = jnp.asarray(
             np.asarray(self._data.mask).sum(axis=1), dtype=jnp.float32
         )
         self._padded_clients = padded
+
+        # Model-state placement: params and server opt state ride the mesh in
+        # the param_sharding layout — replicated on a 1-D mesh, FSDP
+        # model-sharded on a 2-D one.  The round programs preserve the layout
+        # end to end (and round outputs are mesh-placed either way), so this is
+        # the only placement these trees ever get and no round triggers a
+        # sharding-signature recompile.  Built BEFORE the round programs: on a
+        # 2-D mesh the per-leaf layout becomes the programs' shard_map specs.
+        self._model_shards = model_axis_size(self.mesh)
+        params_host = model.init(jax.random.key(config.seed))
+        self.params: Params = jax.device_put(
+            params_host, param_sharding(self.mesh, params_host)
+        )
+        sos_host = init_server_state(self.strategy, params_host)
+        self.server_state = jax.device_put(
+            sos_host, param_sharding(self.mesh, sos_host)
+        )
 
         # Cohort gathering (participation < 1): running the round step over ALL N
         # clients and zero-weighting non-participants burns (1-q) of every round's
@@ -283,14 +319,14 @@ class Coordinator:
             self._round_step = build_scaffold_round_step(
                 model.apply, self.training, self.mesh, self.num_clients,
                 strategy=self.strategy, grad_fn=grad_fn, client_chunk=client_chunk,
-                donate=True,
+                params_like=self.params, donate=True,
             )
         else:
             self._round_step = build_round_step(
                 model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
                 local_fit=local_fit, central_privacy=central_privacy,
                 validation=validation, robust=robust, client_chunk=client_chunk,
-                donate=True,
+                params_like=self.params, donate=True,
             )
         # Fused multi-round execution: R rounds as one scanned device program,
         # host sync only at block boundaries.  Falls back to the single-round path
@@ -329,7 +365,7 @@ class Coordinator:
                     dropout_rate=config.dropout_rate,
                     min_completion_rate=config.min_completion_rate,
                     grad_fn=grad_fn, local_fit=local_fit, validation=validation,
-                    client_chunk=client_chunk,
+                    client_chunk=client_chunk, params_like=self.params,
                     collect_client_detail=(
                         config.save_metrics and config.client_metrics_every > 0
                     ),
@@ -344,26 +380,29 @@ class Coordinator:
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None
         )
-        self._eval_data = (
-            jax.tree.map(jnp.asarray, eval_data) if eval_data is not None else None
-        )
+        # On a 2-D mesh the eval batch rides the mesh replicated so the eval jit
+        # sees (model-sharded params, mesh-placed data) — XLA gathers the param
+        # shards inside the compiled eval; the 1-D placement is untouched.
+        if eval_data is None:
+            self._eval_data = None
+        elif self._model_shards > 1:
+            self._eval_data = jax.device_put(eval_data, replicated_sharding(self.mesh))
+        else:
+            self._eval_data = jax.tree.map(jnp.asarray, eval_data)
 
-        # Place params/opt-state replicated on the mesh up front: round-step outputs are
-        # mesh-replicated, so a single-device initial placement would change the input
-        # sharding signature between round 0 and round 1 and force a recompile.
-        repl = replicated_sharding(self.mesh)
-        self.params: Params = jax.device_put(model.init(jax.random.key(config.seed)), repl)
-        self.server_state = jax.device_put(
-            init_server_state(self.strategy, self.params), repl
-        )
         if scaffold:
             from nanofed_tpu.parallel.mesh import client_sharding
             from nanofed_tpu.trainer.scaffold import stack_zero_controls, zero_controls
 
             csh = client_sharding(self.mesh)
-            self.c_global: Params = jax.device_put(zero_controls(self.params), repl)
+            # The server control is params-shaped round state: same layout rule
+            # as params (model-sharded on a 2-D mesh); the per-client stack
+            # stays client-sharded like data.
+            self.c_global: Params = jax.device_put(
+                zero_controls(params_host), param_sharding(self.mesh, params_host)
+            )
             self.c_stack: Params = jax.device_put(
-                stack_zero_controls(self.params, self._padded_clients), csh
+                stack_zero_controls(params_host, self._padded_clients), csh
             )
             stack_shardings = jax.tree.map(lambda _: csh, self.c_stack)
             # Full-participation write-back: rows align with the stack, so the update
@@ -449,9 +488,14 @@ class Coordinator:
             restored = self.state_store.restore_latest()
             if restored is not None:
                 self.current_round = restored.round_number + 1
-                # Same replicated placement as the fresh-init path: restored arrays come
-                # from the host and would otherwise change the round-step input sharding.
-                self.params = jax.device_put(restored.params, repl)
+                # Same placement as the fresh-init path (param_sharding:
+                # replicated on 1-D, model-sharded on 2-D): restored arrays come
+                # from the host and would otherwise change the round-step input
+                # sharding.  Checkpoints hold gathered host arrays, so a run may
+                # resume on a DIFFERENT mesh shape than it trained on.
+                self.params = jax.device_put(
+                    restored.params, param_sharding(self.mesh, restored.params)
+                )
                 restored_ss = restored.server_state
                 has_controls = (
                     isinstance(restored_ss, dict) and "scaffold_c_stack" in restored_ss
@@ -490,13 +534,16 @@ class Coordinator:
                         )
                     csh = client_sharding(self.mesh)
                     self.c_global = jax.device_put(
-                        restored_ss["scaffold_c_global"], repl
+                        restored_ss["scaffold_c_global"],
+                        param_sharding(self.mesh, restored_ss["scaffold_c_global"]),
                     )
                     self.c_stack = jax.device_put(
                         restored_ss["scaffold_c_stack"], csh
                     )
                     restored_ss = restored_ss["opt"]
-                self.server_state = jax.device_put(restored_ss, repl)
+                self.server_state = jax.device_put(
+                    restored_ss, param_sharding(self.mesh, restored_ss)
+                )
                 acct_state = restored.metadata.metrics.get("privacy_accountant")
                 if self.privacy_accountant is not None and acct_state is not None:
                     self.privacy_accountant.load_state_dict(acct_state)
@@ -555,7 +602,9 @@ class Coordinator:
                 cohort_mask=jax.ShapeDtypeStruct((rpb, n), jnp.float32),
             )
             self._log.info("strict: round_block contract ok (%s)", report)
-        check_input_shardings(self._data, self.params, axis_name=CLIENT_AXIS)
+        check_input_shardings(
+            self._data, self.params, axis_name=CLIENT_AXIS, model_axis=MODEL_AXIS
+        )
 
     def _dispatch_guard(self):
         """The strict-mode transfer guard around device dispatch: every input is
@@ -625,7 +674,20 @@ class Coordinator:
         ``persist_state=False`` (mid-block rounds of a fused block) skips the
         checkpoint and versioned model: ``self.params`` already holds the
         block-END state, which must only ever be persisted under the block's
-        final round id."""
+        final round id.
+
+        On a 2-D mesh the device copy of params/opt state stays model-sharded;
+        persistence needs whole host arrays, so the shards are gathered ONCE
+        here (block boundaries only) and both the checkpoint and the versioned
+        model consume that single gather."""
+        persist_params = self.params
+        if (
+            persist_state
+            and self._model_shards > 1
+            and (self.state_store is not None or self.model_manager is not None)
+        ):
+            # fedlint: disable=FED001 (the ONE deliberate model-shard gather per block boundary — checkpoint + versioned model both consume this single device_get)
+            persist_params = jax.device_get(self.params)
         if self.state_store is not None and persist_state:
             ckpt_metrics = metrics.to_dict()
             if self.privacy_accountant is not None:
@@ -641,9 +703,14 @@ class Coordinator:
                     "scaffold_c_global": self.c_global,
                     "scaffold_c_stack": self.c_stack,
                 }
+            if self._model_shards > 1:
+                # Checkpoints hold whole host arrays regardless of the training
+                # mesh, so resume works across mesh shapes.
+                # fedlint: disable=FED001 (deliberate block-boundary gather of the opt-state shards for the checkpoint artifact)
+                ckpt_server_state = jax.device_get(ckpt_server_state)
             self.state_store.checkpoint(
                 round_number=metrics.round_id,
-                params=self.params,
+                params=persist_params,
                 server_state=ckpt_server_state,
                 metrics=ckpt_metrics,
                 status=(
@@ -660,7 +727,7 @@ class Coordinator:
             and metrics.status == RoundStatus.COMPLETED
         ):
             self.model_manager.save_model(
-                self.params,
+                persist_params,
                 metadata={
                     "round": metrics.round_id,
                     "metrics": metrics.agg_metrics,

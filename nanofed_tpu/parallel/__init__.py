@@ -7,13 +7,22 @@ and ICI collectives are the transport (SURVEY.md §2, bottom rows).
 
 from nanofed_tpu.parallel.mesh import (
     CLIENT_AXIS,
+    MODEL_AXIS,
+    ModelAxisLayout,
+    client_axis_size,
     client_sharding,
     initialize_distributed,
     make_mesh,
+    mesh_shape,
+    mesh_shape_for_model_shards,
+    model_axis_size,
     pad_client_count,
     pad_clients,
+    param_partition_spec,
+    param_sharding,
     replicated_sharding,
     shard_client_data,
+    shard_params,
 )
 from nanofed_tpu.parallel.multi_round import (
     RoundBlockResult,
@@ -33,6 +42,8 @@ from nanofed_tpu.parallel.scaffold_step import (
 
 __all__ = [
     "CLIENT_AXIS",
+    "MODEL_AXIS",
+    "ModelAxisLayout",
     "RoundBlockResult",
     "RoundStepResult",
     "ScaffoldStepResult",
@@ -40,13 +51,20 @@ __all__ = [
     "build_round_step",
     "build_scaffold_round_step",
     "build_sharded_round",
+    "client_axis_size",
     "client_sharding",
     "init_server_state",
     "stack_round_keys",
     "initialize_distributed",
     "make_mesh",
+    "mesh_shape",
+    "mesh_shape_for_model_shards",
+    "model_axis_size",
     "pad_client_count",
     "pad_clients",
+    "param_partition_spec",
+    "param_sharding",
     "replicated_sharding",
     "shard_client_data",
+    "shard_params",
 ]

@@ -62,8 +62,8 @@ class RoundBlockResult(NamedTuple):
     """Stacked outcome of one fused R-round block.  Leading axis of every stacked
     field is the round-within-block index."""
 
-    params: Params  # end-of-block global params (replicated)
-    server_opt_state: Any  # end-of-block server optimizer state (replicated)
+    params: Params  # end-of-block global params (model-sharded on a 2-D mesh)
+    server_opt_state: Any  # end-of-block server optimizer state (same layout)
     metrics: dict[str, jax.Array]  # weighted scalar metrics per round, each [R]
     survivors: jax.Array  # [R] int32 — surviving sampled clients per round
     client_metrics: ClientMetrics | None  # [R, K] (None unless collect_client_detail)
@@ -100,6 +100,7 @@ def build_round_block(
     local_fit: Callable | None = None,
     validation: ValidationConfig | None = None,
     client_chunk: int | None = None,
+    params_like: Params | None = None,
     collect_client_detail: bool = True,
     cohort_mode: bool | None = None,
     axis_name: str = CLIENT_AXIS,
@@ -139,6 +140,12 @@ def build_round_block(
     supported here (the coordinator falls back to the single-round path for
     those); ``validation`` and ``client_chunk`` are.
 
+    On a 2-D ``clients x model`` mesh the scanned round program keeps params and
+    opt state in the FSDP layout (see :func:`build_sharded_round`; pass
+    ``params_like=`` exactly like the single-round builder): the scan carry
+    stays model-sharded round to round, so a fused block never materializes a
+    replicated copy of the model between its rounds either.
+
     ``donate=True`` donates the params/opt-state buffers to the block call — the
     caller must keep only the returned arrays, as the coordinator does.
     """
@@ -167,7 +174,7 @@ def build_round_block(
     sharded = build_sharded_round(
         apply_fn, training, mesh, strategy,
         grad_fn=grad_fn, local_fit=local_fit, validation=validation,
-        client_chunk=client_chunk, axis_name=axis_name,
+        client_chunk=client_chunk, params_like=params_like, axis_name=axis_name,
     )
     csh = NamedSharding(mesh, P(axis_name))
 

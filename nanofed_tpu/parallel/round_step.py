@@ -29,7 +29,13 @@ from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_met
 from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
 from nanofed_tpu.aggregation.robust import RobustAggregationConfig, robust_aggregate
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
-from nanofed_tpu.parallel.mesh import CLIENT_AXIS, pcast_varying, shard_map
+from nanofed_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    ModelAxisLayout,
+    multi_axis_shard_map_kwargs,
+    pcast_varying,
+    shard_map,
+)
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
 from nanofed_tpu.security.validation import (
     ValidationConfig,
@@ -42,8 +48,8 @@ from nanofed_tpu.utils.trees import tree_clip_by_global_norm, tree_sq_norm, tree
 
 
 class RoundStepResult(NamedTuple):
-    params: Params  # new global params (replicated)
-    server_opt_state: Any  # server optimizer state (replicated)
+    params: Params  # new global params (replicated over clients; model-sharded on a 2-D mesh)
+    server_opt_state: Any  # server optimizer state (same layout as params)
     metrics: dict[str, jax.Array]  # weighted scalar metrics for the round
     client_metrics: ClientMetrics  # per-client arrays [C] (for round metrics JSON parity)
     update_sq_norms: jax.Array  # [C] squared L2 norm of each client's delta
@@ -63,6 +69,7 @@ def build_sharded_round(
     validation: ValidationConfig | None = None,
     robust: RobustAggregationConfig | None = None,
     client_chunk: int | None = None,
+    params_like: Params | None = None,
     axis_name: str = CLIENT_AXIS,
 ) -> Callable:
     """Build the UN-jitted ``shard_map`` round program.
@@ -110,6 +117,20 @@ def build_sharded_round(
     with ``validation`` the deltas must materialize, because cohort z-score rejection
     re-weights clients only after every client's statistics are known.
 
+    On a 2-D ``clients x model`` mesh (``make_mesh(shape=(c, m))``), the round
+    program is FSDP-shaped: params and server opt state cross the shard_map
+    boundary in the :func:`nanofed_tpu.parallel.mesh.param_sharding` layout
+    (each leaf's largest divisible dim split over ``model`` — ``params_like``
+    is REQUIRED then, so the per-leaf layout can become the shard_map specs),
+    the body all-gathers the param shards over the model axis once to feed the
+    per-client compute, the FedAvg reduce remains a ``psum`` over ``clients``
+    only, and each model shard slices its piece of the full aggregate before
+    the server-optimizer update — so params and opt state never materialize
+    replicated between rounds, on-device or in the scan carry of a fused block.
+    Client data is sharded over ``clients`` and replicated over ``model``
+    exactly as on the 1-D mesh (model columns recompute the same clients; the
+    model axis buys parameter/optimizer-state capacity, not client throughput).
+
     ``robust`` replaces the weighted-mean reduce with the coordinate-wise TRIMMED mean
     (Yin et al. 2018; see ``aggregation.robust``): per-client deltas are
     ``all_gather``ed over the client axis (order statistics need every value — a
@@ -122,6 +143,17 @@ def build_sharded_round(
     mean's — combining them silently would void the stated (ε, δ)).
     """
     strategy = strategy or fedavg_strategy()
+    # 2-D clients x model mesh (FSDP): params/opt state cross the shard_map
+    # boundary split over the model axis (ModelAxisLayout — the boundary rule
+    # shared verbatim with the SCAFFOLD builder); the body gathers the param
+    # shards once for the per-client compute and slices the aggregated delta
+    # back to its shard before the server update.  On any 1-D mesh every layout
+    # method is the identity and the specs stay P()/P(clients) — the classic
+    # program, byte for byte.
+    layout = ModelAxisLayout(mesh)
+    layout.require_params_like(params_like)
+    raw_keys_at_boundary = layout.raw_keys_at_boundary
+
     if robust is not None and central_privacy is not None:
         raise ValueError(
             "robust= cannot be combined with central_privacy=: the DP guarantee is "
@@ -140,6 +172,12 @@ def build_sharded_round(
     # rather than silently ignoring it.
     fit_takes_lr_scale = getattr(local_fit, "supports_lr_scale", False)
     server_tx = strategy.server_tx
+    # The optimizer-state layout follows the same per-leaf rule as params —
+    # abstract init only (eval_shape), nothing materializes here.
+    params_specs = layout.boundary_specs(params_like)
+    sos_specs = layout.boundary_specs(
+        jax.eval_shape(server_tx.init, params_like) if layout.multi_axis else None
+    )
 
     def clip_deltas(delta):
         """Per-client clip to the central-DP sensitivity bound C (local, cohort-free)."""
@@ -193,6 +231,10 @@ def build_sharded_round(
         # +delta (exact FedAvg).  A round with zero total weight (no participants /
         # all failed — the reference marks these FAILED, coordinator.py:295-304) must
         # leave params AND server state untouched, even for stateful server optimizers.
+        # ``gp``/``sos`` are this device's MODEL SHARDS on a 2-D mesh (full leaves on
+        # 1-D); ``agg_delta`` arrives full and is sliced down, so the server optimizer
+        # only ever touches shard-sized state.
+        agg_delta = layout.slice_shard(agg_delta)
         neg_delta = jax.tree.map(jnp.negative, agg_delta)
         updates, new_sos = server_tx.update(neg_delta, sos, gp)
         ok = total_w > 0
@@ -233,9 +275,16 @@ def build_sharded_round(
         return new_gp, new_sos, metrics, client_metrics, sq_norms
 
     def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale):
+        if raw_keys_at_boundary:
+            rngs = jax.random.wrap_key_data(rngs)
+            noise_rng = jax.random.wrap_key_data(noise_rng)
+        # ``gp`` is this device's model shard (full on 1-D); the per-client compute
+        # needs full params, so gather over the model axis ONCE per round.  gp stays
+        # the shard for the server update at the end.
+        gp_full = layout.gather_full(gp, params_specs)
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
-        gp_v = pcast_varying(gp, axis_name)
+        gp_v = pcast_varying(gp_full, axis_name)
         # The schedule scale is replicated data closed over by the per-client fit (the
         # same scalar for every client in the round).
         fit = (
@@ -366,12 +415,30 @@ def build_sharded_round(
         sq_norms = jax.vmap(tree_sq_norm)(delta)
         return new_gp, new_sos, metrics, result.metrics, sq_norms
 
-    return shard_map(
+    # On a 2-D mesh the params/opt-state specs are per-leaf trees carrying the
+    # model-axis layout (so those leaves enter and leave as shards), client
+    # stacks stay P(clients) (replicated over model), and metrics stay P()
+    # (identical on every model column by construction — see
+    # multi_axis_shard_map_kwargs for why the checker is off there).
+    inner = shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name), P(), P()),
-        out_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
+        in_specs=(params_specs, sos_specs, P(axis_name), P(axis_name),
+                  P(axis_name), P(), P()),
+        out_specs=(params_specs, sos_specs, P(), P(axis_name), P(axis_name)),
+        **multi_axis_shard_map_kwargs(mesh),
     )
+    if not raw_keys_at_boundary:
+        return inner
+
+    def sharded(gp, sos, data, weights, rngs, noise_rng, lr_scale):
+        if jnp.issubdtype(jnp.asarray(rngs).dtype, jax.dtypes.prng_key):
+            rngs = jax.random.key_data(rngs)
+        if jnp.issubdtype(jnp.asarray(noise_rng).dtype, jax.dtypes.prng_key):
+            noise_rng = jax.random.key_data(noise_rng)
+        return inner(gp, sos, data, weights, rngs, noise_rng, lr_scale)
+
+    return sharded
 
 
 def build_round_step(
@@ -385,6 +452,7 @@ def build_round_step(
     validation: ValidationConfig | None = None,
     robust: RobustAggregationConfig | None = None,
     client_chunk: int | None = None,
+    params_like: Params | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
 ) -> RoundStepFn:
@@ -396,7 +464,10 @@ def build_round_step(
     ``client_chunk``, ``local_fit``/``grad_fn``, the traced ``lr_scale``) are
     documented on :func:`build_sharded_round`, which builds the SPMD program this
     wraps — the fused R-round engine (``parallel.multi_round``) scans the SAME
-    program, so the two paths cannot drift.
+    program, so the two paths cannot drift.  On a 2-D ``clients x model`` mesh
+    pass ``params_like=`` (abstract is fine) and call the step with params/opt
+    state committed in the ``param_sharding`` layout — outputs stay in that
+    layout.
 
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
@@ -406,7 +477,7 @@ def build_round_step(
         apply_fn, training, mesh, strategy,
         grad_fn=grad_fn, local_fit=local_fit, central_privacy=central_privacy,
         validation=validation, robust=robust, client_chunk=client_chunk,
-        axis_name=axis_name,
+        params_like=params_like, axis_name=axis_name,
     )
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())

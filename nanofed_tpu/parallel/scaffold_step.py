@@ -38,7 +38,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nanofed_tpu.aggregation.base import Strategy, fedavg_strategy
 from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_metrics
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
-from nanofed_tpu.parallel.mesh import CLIENT_AXIS, pcast_varying, shard_map
+from nanofed_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    ModelAxisLayout,
+    multi_axis_shard_map_kwargs,
+    pcast_varying,
+    shard_map,
+)
 from nanofed_tpu.trainer.config import TrainingConfig
 from nanofed_tpu.trainer.local import GradFn
 from nanofed_tpu.trainer.scaffold import make_scaffold_local_fit
@@ -63,6 +69,7 @@ def build_scaffold_round_step(
     strategy: Strategy | None = None,
     grad_fn: GradFn | None = None,
     client_chunk: int | None = None,
+    params_like: Params | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
 ) -> Callable[..., ScaffoldStepResult]:
@@ -87,14 +94,40 @@ def build_scaffold_round_step(
     chunk-wide ``vmap``.  There is no streaming variant: SCAFFOLD's per-client OUTPUT
     (``delta_c``) is itself params-sized per client, so the ``[C, |params|]`` output
     stack exists regardless — streaming the reduce would save nothing.
+
+    On a 2-D ``clients x model`` mesh pass ``params_like=`` and commit params,
+    opt state, and ``c_global`` in the ``param_sharding`` layout (``c_stack``
+    stays client-sharded) — all three stay model-sharded end to end, exactly as
+    documented on :func:`nanofed_tpu.parallel.round_step.build_sharded_round`.
     """
     strategy = strategy or fedavg_strategy()
     server_tx = strategy.server_tx
     local_fit = make_scaffold_local_fit(apply_fn, training, grad_fn=grad_fn)
+    # 2-D clients x model mesh (FSDP, the exact boundary rule build_sharded_round
+    # uses — ModelAxisLayout is the single shared implementation): params, opt
+    # state, AND the server control are params-shaped round state — they cross
+    # the shard_map boundary split over the model axis, are gathered once to
+    # feed the per-client compute, and each model shard slices its piece of the
+    # full aggregates before updating.  The per-client control stack stays
+    # client-sharded like data.  No-op on any 1-D mesh.
+    layout = ModelAxisLayout(mesh)
+    layout.require_params_like(params_like)
+    raw_keys_at_boundary = layout.raw_keys_at_boundary
+    params_specs = layout.boundary_specs(params_like)
+    sos_specs = layout.boundary_specs(
+        jax.eval_shape(server_tx.init, params_like) if layout.multi_axis else None
+    )
 
     def shard_body(gp, sos, c_global, c_stack, data: ClientData, weights, rngs, lr_scale):
-        gp_v = pcast_varying(gp, axis_name)
-        cg_v = pcast_varying(c_global, axis_name)
+        if raw_keys_at_boundary:
+            rngs = jax.random.wrap_key_data(rngs)
+        # gp / c_global are this device's model shards on a 2-D mesh (full leaves
+        # on 1-D): gather once for the per-client compute; the boundary values stay
+        # shards for the update at the end.
+        gp_full = layout.gather_full(gp, params_specs)
+        cg_full = layout.gather_full(c_global, params_specs)
+        gp_v = pcast_varying(gp_full, axis_name)
+        cg_v = pcast_varying(cg_full, axis_name)
         fit = lambda g, d, r, ci: local_fit(g, d, r, cg_v, ci, lr_scale=lr_scale)
         c_local = rngs.shape[0]
         chunking = client_chunk is not None and client_chunk < c_local
@@ -121,8 +154,12 @@ def build_scaffold_round_step(
         participating = (weights > 0).astype(jnp.float32)
         total_w = lax.psum(weights.sum(), axis_name)
 
-        # Model update: server_tx over the UNIFORM participant mean of delta y.
-        agg_delta = psum_weighted_mean(delta_y, participating, axis_name)
+        # Model update: server_tx over the UNIFORM participant mean of delta y —
+        # full aggregate sliced down to this device's model shard first, so the
+        # server optimizer only ever touches shard-sized state.
+        agg_delta = layout.slice_shard(
+            psum_weighted_mean(delta_y, participating, axis_name)
+        )
         neg_delta = jax.tree.map(jnp.negative, agg_delta)
         updates, new_sos = server_tx.update(neg_delta, sos, gp)
         ok = total_w > 0
@@ -138,7 +175,9 @@ def build_scaffold_round_step(
             ).astype(d.dtype),
             result.delta_c,
         )
-        c_sum = jax.tree.map(lambda d: lax.psum(d.sum(axis=0), axis_name), delta_c)
+        c_sum = layout.slice_shard(
+            jax.tree.map(lambda d: lax.psum(d.sum(axis=0), axis_name), delta_c)
+        )
         new_c_global = jax.tree.map(
             lambda c, s: jnp.where(ok, c + s / float(num_clients_total), c).astype(
                 c.dtype
@@ -151,13 +190,23 @@ def build_scaffold_round_step(
         sq_norms = jax.vmap(tree_sq_norm)(delta_y)
         return new_gp, new_sos, new_c_global, delta_c, metrics, result.metrics, sq_norms
 
-    sharded = shard_map(
+    inner = shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(axis_name), P(axis_name), P(axis_name),
-                  P(axis_name), P()),
-        out_specs=(P(), P(), P(), P(axis_name), P(), P(axis_name), P(axis_name)),
+        in_specs=(params_specs, sos_specs, params_specs, P(axis_name),
+                  P(axis_name), P(axis_name), P(axis_name), P()),
+        out_specs=(params_specs, sos_specs, params_specs, P(axis_name), P(),
+                   P(axis_name), P(axis_name)),
+        **multi_axis_shard_map_kwargs(mesh),
     )
+    if raw_keys_at_boundary:
+        def sharded(gp, sos, c_global, c_stack, data, weights, rngs, lr_scale):
+            # fedlint: disable=FED002 (dtype is STATIC metadata, not a traced value — the branch selects the key-data conversion at trace time, no concretization)
+            if jnp.issubdtype(jnp.asarray(rngs).dtype, jax.dtypes.prng_key):
+                rngs = jax.random.key_data(rngs)
+            return inner(gp, sos, c_global, c_stack, data, weights, rngs, lr_scale)
+    else:
+        sharded = inner
 
     # c_stack (argnum 3) is deliberately NOT donated: in full-participation mode the
     # caller passes its population stack directly and must still scatter-add the

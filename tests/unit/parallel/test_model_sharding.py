@@ -1,0 +1,297 @@
+"""FSDP-style model sharding on the 2-D ``clients x model`` mesh.
+
+Every comparison here crosses program structures (1-D vs 2-D round programs),
+so clients are SINGLE-BATCH (batch_size == per-client capacity) and the model
+is dropout-free — the documented jaxlib-CPU caveat from
+``test_round_step.py``: only the epoch-shuffle/dropout PRNG lowering differs
+across program structures, never the mesh math this file pins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nanofed_tpu.aggregation import compute_weights, fedadam_strategy, fedavg_strategy
+from nanofed_tpu.data import federate, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.parallel import (
+    MODEL_AXIS,
+    build_round_block,
+    build_round_step,
+    build_scaffold_round_step,
+    init_server_state,
+    make_mesh,
+    shard_client_data,
+    shard_params,
+    stack_round_keys,
+)
+from nanofed_tpu.trainer import TrainingConfig, stack_rngs, stack_zero_controls, zero_controls
+from nanofed_tpu.parallel.mesh import client_sharding
+
+
+def _setup(num_clients=8, batch=64, classes=4, feat=8, seed=0):
+    m = get_model("mlp", in_features=feat, hidden=16, num_classes=classes)
+    ds = synthetic_classification(num_clients * batch, classes, (feat,), seed=seed)
+    cd = federate(ds, num_clients=num_clients, scheme="iid", batch_size=batch, seed=seed)
+    return m, cd
+
+
+def _run_round(mesh_shape, strategy, m, cd, rounds=2):
+    mesh = make_mesh(shape=mesh_shape)
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    step = build_round_step(m.apply, cfg, mesh, strategy, params_like=params)
+    p = shard_params(params, mesh)
+    sos = shard_params(init_server_state(strategy, params), mesh)
+    data = shard_client_data(cd, mesh)
+    weights = compute_weights(jnp.asarray(cd.num_samples))
+    res = None
+    for r in range(rounds):
+        res = step(p, sos, data, weights, stack_rngs(jax.random.key(r), 8))
+        p, sos = res.params, res.server_opt_state
+    return res
+
+
+def test_2d_round_step_matches_1d(devices):
+    """The acceptance property: a (4, 2) clients x model round step produces
+    params within numerical tolerance of the 1-D run, and the params are
+    VERIFIABLY model-sharded between rounds (asserted via .sharding, not
+    shape)."""
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    res_1d = _run_round(None, strat, m, cd)
+    res_2d = _run_round((4, 2), strat, m, cd)
+    for got, want in zip(jax.tree.leaves(res_2d.params), jax.tree.leaves(res_1d.params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    specs = {
+        jax.tree_util.keystr(path): leaf.sharding.spec
+        for path, leaf in jax.tree_util.tree_flatten_with_path(res_2d.params)[0]
+    }
+    # Every MLP leaf has an even dim -> every leaf is genuinely sharded.
+    assert specs["['fc1']['kernel']"] == P(None, MODEL_AXIS)
+    assert specs["['fc1']['bias']"] == P(MODEL_AXIS)
+    assert specs["['fc2']['kernel']"] == P(MODEL_AXIS)
+    assert all(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree.leaves(res_2d.params)
+    )
+    np.testing.assert_allclose(
+        float(res_2d.metrics["loss"]), float(res_1d.metrics["loss"]), rtol=1e-5
+    )
+
+
+def test_2d_opt_state_is_model_sharded(devices):
+    """A stateful server optimizer (FedAdam): its params-shaped slots live
+    model-sharded too — the memory the model axis buys is params AND opt
+    state."""
+    m, cd = _setup()
+    strat = fedadam_strategy()
+    res_1d = _run_round(None, strat, m, cd)
+    res_2d = _run_round((4, 2), strat, m, cd)
+    for got, want in zip(jax.tree.leaves(res_2d.params), jax.tree.leaves(res_1d.params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    sharded = [
+        leaf for leaf in jax.tree.leaves(res_2d.server_opt_state)
+        if hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded, "no FedAdam slot came back model-sharded"
+
+
+def test_2d_round_block_matches_single_rounds(devices):
+    """The fused R-round block on a (4, 2) mesh: same params as R single 2-D
+    rounds, carry model-sharded at the block boundary."""
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    mesh = make_mesh(shape=(4, 2))
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    step = build_round_step(m.apply, cfg, mesh, strat, params_like=params)
+    block = build_round_block(
+        m.apply, cfg, mesh, strat, num_clients=8, padded_clients=8,
+        params_like=params,
+    )
+    p0 = shard_params(params, mesh)
+    sos0 = shard_params(init_server_state(strat, params), mesh)
+    data = shard_client_data(cd, mesh)
+    num_samples = jnp.asarray(cd.num_samples, dtype=jnp.float32)
+    weights = compute_weights(num_samples)
+    seed = 3
+
+    p, sos = p0, sos0
+    for r in range(3):
+        base = jax.random.fold_in(jax.random.key(seed), r)
+        res = step(p, sos, data, weights, stack_rngs(base, 8))
+        p, sos = res.params, res.server_opt_state
+
+    mask = jnp.ones((3, 8), jnp.float32)
+    bres = block(
+        p0, sos0, data, num_samples, stack_round_keys(seed, [0, 1, 2]),
+        jnp.ones(3, jnp.float32), cohort_mask=mask,
+    )
+    for got, want in zip(jax.tree.leaves(bres.params), jax.tree.leaves(p)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert all(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree.leaves(bres.params)
+    )
+
+
+def test_2d_scaffold_step_matches_1d(devices):
+    """SCAFFOLD on the 2-D mesh: params, opt state, and the server control all
+    model-sharded; math matches the 1-D control-variate round."""
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    results = {}
+    for shape in (None, (4, 2)):
+        mesh = make_mesh(shape=shape)
+        step = build_scaffold_round_step(
+            m.apply, cfg, mesh, 8, strategy=strat, params_like=params
+        )
+        p = shard_params(params, mesh)
+        sos = shard_params(init_server_state(strat, params), mesh)
+        cg = shard_params(zero_controls(params), mesh)
+        cs = jax.device_put(stack_zero_controls(params, 8), client_sharding(mesh))
+        data = shard_client_data(cd, mesh)
+        weights = compute_weights(jnp.asarray(cd.num_samples))
+        results[shape] = step(
+            p, sos, cg, cs, data, weights, stack_rngs(jax.random.key(5), 8)
+        )
+    for got, want in zip(
+        jax.tree.leaves(results[(4, 2)].params), jax.tree.leaves(results[None].params)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    for got, want in zip(
+        jax.tree.leaves(results[(4, 2)].c_global),
+        jax.tree.leaves(results[None].c_global),
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert all(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree.leaves(results[(4, 2)].c_global)
+    )
+
+
+def test_2d_validated_round_matches_1d(devices):
+    """In-mesh update validation on the 2-D mesh: cohort stats ride the
+    clients-psum on full deltas, so rejection decisions (and the numbers) match
+    the 1-D program exactly."""
+    from nanofed_tpu.security.validation import ValidationConfig
+
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    val = ValidationConfig(max_norm=100.0, z_score_threshold=1e9)
+    results = {}
+    for shape in (None, (4, 2)):
+        mesh = make_mesh(shape=shape)
+        step = build_round_step(
+            m.apply, cfg, mesh, strat, validation=val, params_like=params
+        )
+        res = step(
+            shard_params(params, mesh),
+            shard_params(init_server_state(strat, params), mesh),
+            shard_client_data(cd, mesh),
+            compute_weights(jnp.asarray(cd.num_samples)),
+            stack_rngs(jax.random.key(2), 8),
+        )
+        results[shape] = res
+    assert int(results[(4, 2)].metrics["valid_clients"]) == 8
+    for got, want in zip(
+        jax.tree.leaves(results[(4, 2)].params), jax.tree.leaves(results[None].params)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_2d_central_dp_round_matches_1d(devices):
+    """Central DP on the 2-D mesh: every model column derives the IDENTICAL
+    full-shaped noise from the shared noise key before slicing its shard, so
+    the noised aggregate equals the 1-D program's draw exactly."""
+    from nanofed_tpu.aggregation.privacy import PrivacyAwareAggregationConfig
+    from nanofed_tpu.privacy import PrivacyConfig
+
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    dp = PrivacyAwareAggregationConfig(
+        privacy=PrivacyConfig(
+            epsilon=1.0, delta=1e-5, max_gradient_norm=1.0, noise_multiplier=0.5
+        )
+    )
+    results = {}
+    for shape in (None, (4, 2)):
+        mesh = make_mesh(shape=shape)
+        step = build_round_step(
+            m.apply, cfg, mesh, strat, central_privacy=dp, params_like=params
+        )
+        results[shape] = step(
+            shard_params(params, mesh),
+            shard_params(init_server_state(strat, params), mesh),
+            shard_client_data(cd, mesh),
+            compute_weights(jnp.asarray(cd.num_samples)),
+            stack_rngs(jax.random.key(4), 8),
+        )
+    for got, want in zip(
+        jax.tree.leaves(results[(4, 2)].params), jax.tree.leaves(results[None].params)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert all(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree.leaves(results[(4, 2)].params)
+    )
+
+
+def test_2d_robust_round_matches_1d(devices):
+    """Robust (trimmed-mean) aggregation on the 2-D mesh: the client-axis
+    all_gather + trim runs on full deltas; each shard slices the identical
+    trimmed aggregate."""
+    from nanofed_tpu.aggregation.robust import RobustAggregationConfig
+
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    cfg = TrainingConfig(batch_size=64, local_epochs=1)
+    params = m.init(jax.random.key(0))
+    robust = RobustAggregationConfig(trim_k=1, method="trimmed_mean")
+    results = {}
+    for shape in (None, (4, 2)):
+        mesh = make_mesh(shape=shape)
+        step = build_round_step(
+            m.apply, cfg, mesh, strat, robust=robust, params_like=params
+        )
+        results[shape] = step(
+            shard_params(params, mesh),
+            shard_params(init_server_state(strat, params), mesh),
+            shard_client_data(cd, mesh),
+            compute_weights(jnp.asarray(cd.num_samples)),
+            stack_rngs(jax.random.key(6), 8),
+        )
+    for got, want in zip(
+        jax.tree.leaves(results[(4, 2)].params), jax.tree.leaves(results[None].params)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_2d_build_requires_params_like(devices):
+    m, _ = _setup()
+    mesh = make_mesh(shape=(4, 2))
+    with pytest.raises(ValueError, match="params_like"):
+        build_round_step(m.apply, TrainingConfig(batch_size=64), mesh)
+
+
+def test_model_axis_of_one_degenerates_to_replication(devices):
+    """An (8, 1) mesh is a valid 2-D mesh whose FSDP layout is replication —
+    same numbers as the 1-D mesh, every leaf fully replicated."""
+    m, cd = _setup()
+    strat = fedavg_strategy()
+    res_1d = _run_round(None, strat, m, cd)
+    res_81 = _run_round((8, 1), strat, m, cd)
+    for got, want in zip(jax.tree.leaves(res_81.params), jax.tree.leaves(res_1d.params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert all(
+        leaf.sharding.is_fully_replicated for leaf in jax.tree.leaves(res_81.params)
+    )

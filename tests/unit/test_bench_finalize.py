@@ -19,7 +19,9 @@ from bench import (  # noqa: E402
     METRIC_PARITY,
     compact_summary,
     finalize_measurements,
+    plan_accel_attempt,
     read_probe_cache,
+    read_probe_record,
     write_probe_cache,
 )
 
@@ -237,3 +239,43 @@ def test_probe_cache_rejects_corrupt_records(tmp_path):
     assert read_probe_cache(path=str(path), now=1.0, ttl_s=10.0) is None
     path.write_text('{"verdict": "ok"}')  # missing timestamp
     assert read_probe_cache(path=str(path)) is None
+
+
+def test_read_probe_record_ignores_ttl(tmp_path):
+    """A stale verdict is still evidence for the attempt plan — read_probe_record
+    returns it long after read_probe_cache has expired it."""
+    path = str(tmp_path / "probe.json")
+    write_probe_cache("wedged", path=path, now=1000.0)
+    assert read_probe_cache(path=path, ttl_s=10.0, now=5000.0) is None
+    rec = read_probe_record(path=path)
+    assert rec is not None and rec["verdict"] == "wedged"
+
+
+def test_plan_fresh_wedged_skips_accel_entirely():
+    """BENCH_r05 fix: a fresh 'wedged' verdict must not spend ANY accel budget —
+    no probe, no measurement; the CPU worker inherits the whole total."""
+    rec = {"verdict": "wedged", "at_unix": 1000.0}
+    assert plan_accel_attempt(rec, now=1500.0, ttl_s=1800.0) == "skip"
+
+
+def test_plan_stale_wedged_costs_one_probe_not_the_full_budget():
+    """A stale 'wedged' verdict re-opens the accelerator ONLY through a short
+    probe — never straight into the full measurement budget."""
+    rec = {"verdict": "wedged", "at_unix": 1000.0}
+    assert plan_accel_attempt(rec, now=10_000.0, ttl_s=1800.0) == "probe"
+
+
+def test_plan_fresh_ok_attempts_directly():
+    rec = {"verdict": "ok", "at_unix": 1000.0}
+    assert plan_accel_attempt(rec, now=1500.0, ttl_s=1800.0) == "attempt"
+
+
+def test_plan_stale_ok_reprobes():
+    rec = {"verdict": "ok", "at_unix": 1000.0}
+    assert plan_accel_attempt(rec, now=10_000.0, ttl_s=1800.0) == "probe"
+
+
+def test_plan_missing_or_corrupt_record_probes():
+    assert plan_accel_attempt(None) == "probe"
+    assert plan_accel_attempt({"verdict": "maybe", "at_unix": 0.0}) == "probe"
+    assert plan_accel_attempt({"verdict": "ok"}) == "probe"  # no timestamp
