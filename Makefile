@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke profile-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +25,16 @@ bench:
 # perf-plumbing regressions (fused engine, dispatch/host_sync spans) in tier-1.
 bench-smoke:
 	python -m pytest tests/integration/test_bench_smoke.py -q -s
+
+# Compile-only cost profile on CPU (observability.profiling): the `profile`
+# subcommand must produce a non-empty roofline table — single step, fused
+# block, and SCAFFOLD programs — without running a federation.
+profile-smoke:
+	python -m nanofed_tpu.cli profile --model digits_mlp --clients 8 \
+	  --batch-size 16 --rounds-per-block 2 | tee /tmp/profile_smoke.txt
+	@grep -q "round_block" /tmp/profile_smoke.txt
+	@grep -q "scaffold_round_step" /tmp/profile_smoke.txt
+	@grep -q "roofline basis" /tmp/profile_smoke.txt
 
 example:
 	python examples/mnist/run_experiment.py --synthetic

@@ -17,8 +17,12 @@ buffer can never truncate it mid-JSON; see ``compact_summary``):
    chunking (clients >> chips).  The reference never ran this scale; ``vs_baseline``
    scales its tutorial number by sample-passes (53.48 s / 32k passes -> 120k passes
    = 200.55 s extrapolated CPU time) and says so in the ``baseline_basis`` field.
-   Extra fields: rounds/sec, analytic-FLOP MFU estimate, min/max round times, and a
-   stated v5e-8 extrapolation (client axis splits 8 ways; the psum is params-sized).
+   Extra fields: rounds/sec, analytic-FLOP MFU estimate, a ``cost_analysis`` record
+   with the COMPILER's own FLOP/byte numbers for the headline block program (XLA
+   ``cost_analysis``/``memory_analysis`` via ``observability.profiling`` — on TPU the
+   compiler-FLOPs MFU lands as ``est_mfu_pct_cost_basis`` next to the analytic
+   ``est_mfu_pct``, both bases labeled), min/max round times, and a stated v5e-8
+   extrapolation (client axis splits 8 ways; the psum is params-sized).
 
 All values are the MEDIAN of the timed steady-state rounds (3 on accelerators; in the
 scaled CPU fallback 3 at the primary scale + 2 at the larger secondary scale; compile
@@ -365,6 +369,9 @@ def compact_summary(results: list) -> dict:
         out["strict"] = True
     if "est_mfu_pct" in flagship:
         out["est_mfu_pct"] = flagship["est_mfu_pct"]
+    if "est_mfu_pct_cost_basis" in flagship:
+        # Compiler-FLOPs MFU (cost_analysis basis) next to the analytic one.
+        out["est_mfu_pct_cost_basis"] = flagship["est_mfu_pct_cost_basis"]
     if "error" in flagship:
         out["error"] = flagship["error"]
     if "phases" in flagship:
@@ -675,8 +682,8 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         out["rounds_per_sec"] = round(1.0 / value, 3)
         if on_cpu:
             out["measured_clients"] = [1000 // s for s in flagship_scales]
+        flops = CNN_TRAIN_FLOPS_PER_SAMPLE * FLAGSHIP_SAMPLE_PASSES
         if is_tpu:
-            flops = CNN_TRAIN_FLOPS_PER_SAMPLE * FLAGSHIP_SAMPLE_PASSES
             mfu = flops / value / (V5E_BF16_PEAK_FLOPS * n_dev)
             out["est_mfu_pct"] = round(100 * mfu, 2)
             out["mfu_basis"] = (
@@ -691,6 +698,54 @@ def run_worker(platform: str, workloads: list[str]) -> None:
                 out["north_star"] = (
                     f"target <1s on v5e-8; measured {value:.3f}s on ONE v5e chip"
                 )
+        else:
+            # The analytic FLOP basis is recorded on CPU fallback runs too, so
+            # the perf trajectory stays comparable across wedged-accel rounds.
+            # The MFU PERCENTAGE stays TPU-only: there is no published CPU bf16
+            # peak, and a made-up one would fabricate an MFU.
+            out["mfu_basis"] = (
+                f"analytic {flops / 1e12:.2f} TFLOP/round (3x fwd MACs); "
+                f"platform={out['platform']} has no published bf16 peak — MFU "
+                "percentage undefined, FLOP basis recorded for cross-round "
+                "comparability"
+            )
+        # Compiler-based cost record (observability.profiling): what XLA's own
+        # cost_analysis says the HEADLINE block program costs, next to the
+        # analytic basis above (both labeled).  The AOT lower+compile hits the
+        # persistent compilation cache the warm-up populated, so this costs a
+        # deserialize, not a second full compile; any failure degrades the
+        # record, never the measurement.
+        try:
+            from nanofed_tpu.observability.profiling import profile_program
+
+            headline_scale, _ = measurements[-1]
+            n_clients = 1000 // headline_scale
+            mask_r = jnp.asarray(np.tile(mask, (headline_rpb, 1)))
+            p0 = jax.device_put(model.init(jax.random.key(0)), repl)
+            s0 = jax.device_put(init_server_state(strategy, p0), repl)
+            report = profile_program(
+                "flagship_round_block", block,
+                p0, s0, data, num_samples,
+                stack_round_keys(0, list(range(headline_rpb))),
+                jnp.ones(headline_rpb, jnp.float32), None, mask_r,
+                rounds=headline_rpb,
+                attrs={"workload_scale": f"1/{headline_scale}",
+                       "clients": n_clients},
+            )
+            out["cost_analysis"] = report.to_dict()
+            log_stage(
+                f"cost profile: {report.flops / headline_rpb:.3g} compiler "
+                f"FLOPs/round/device, peak {report.peak_bytes / 1e6:.1f} MB, "
+                f"AI {report.arithmetic_intensity:.2f} -> {report.verdict} "
+                f"(ready in {report.compile_seconds:.2f}s)", t0=t0,
+            )
+            if is_tpu:
+                cost_mfu = report.mfu(value * headline_rpb)
+                if cost_mfu is not None:
+                    out["est_mfu_pct_cost_basis"] = round(100 * cost_mfu, 2)
+        except Exception as e:  # never fail the record over a profile
+            out["cost_analysis"] = {"error": f"cost profiling failed: {e}"}
+            log_stage(f"cost profiling skipped: {e}", t0=t0)
         print(json.dumps(out), flush=True)
 
     log_stage(f"worker done in {time.time() - t0:.1f}s total", t0=t0)

@@ -5,7 +5,9 @@ The reference declares a CLI entry point that doesn't exist (``pyproject.toml:22
 real: ``run`` drives a simulated federated experiment (``--dp-epsilon`` engages
 budget-calibrated central DP), ``serve`` hosts the real-network federation server
 (``--secure`` for masked rounds, ``--validate`` for update validation), ``bench`` runs
-the BASELINE.json suite, ``info`` prints environment and model-zoo facts.
+the BASELINE.json suite, ``profile`` compiles the round programs WITHOUT running a
+federation and prints the compiler's cost/roofline table, ``info`` prints environment
+and model-zoo facts.
 """
 
 from __future__ import annotations
@@ -138,9 +140,78 @@ def _cmd_run(args: argparse.Namespace) -> int:
         client_metrics_every=args.client_metrics_every,
         model_shards=args.model_shards,
         strict=args.strict,
+        profile_programs=args.profile_programs,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
+
+
+def _cmd_profile(args: argparse.Namespace) -> int:
+    """Compile the round programs — single step, fused block, SCAFFOLD — WITHOUT
+    running a federation, and print what the COMPILER says each costs: XLA
+    ``cost_analysis`` FLOPs, peak device bytes, arithmetic intensity, and the
+    roofline verdict against the platform's peaks table (see
+    ``observability.profiling`` and docs/performance.md)."""
+    import jax
+
+    from nanofed_tpu.data import federate
+    from nanofed_tpu.experiments import load_datasets_for
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.observability import format_cost_table
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+    from nanofed_tpu.parallel import mesh_shape_for_model_shards
+    from nanofed_tpu.trainer import TrainingConfig
+
+    try:
+        mesh_shape = mesh_shape_for_model_shards(args.model_shards, len(jax.devices()))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    mdl = get_model(args.model)
+    train, _ = load_datasets_for(mdl, args.data_dir, args.train_size, args.seed)
+    client_data = federate(
+        train, num_clients=args.clients, scheme="iid",
+        batch_size=args.batch_size, seed=args.seed,
+    )
+    training = TrainingConfig(
+        batch_size=args.batch_size, local_epochs=args.epochs,
+        learning_rate=args.lr, compute_dtype=args.dtype,
+    )
+
+    def build(scaffold: bool, rounds_per_block: int) -> Coordinator:
+        # save_metrics=False: profiling must leave no run artifacts behind
+        # (telemetry lands only where --telemetry-dir points).  num_rounds
+        # merely has to admit the block length — nothing ever runs.
+        return Coordinator(
+            model=mdl, train_data=client_data,
+            config=CoordinatorConfig(
+                num_rounds=max(1, rounds_per_block),
+                participation_rate=args.participation,
+                seed=args.seed, save_metrics=False,
+                rounds_per_block=rounds_per_block,
+            ),
+            training=training, scaffold=scaffold,
+            client_chunk=args.client_chunk, mesh_shape=mesh_shape,
+            telemetry_dir=args.telemetry_dir,
+        )
+
+    reports = []
+    coordinators = [build(scaffold=False, rounds_per_block=args.rounds_per_block)]
+    if not args.no_scaffold:
+        # The SCAFFOLD program is a different ROUND program (control-variate
+        # state flows through it), so it gets its own coordinator + report.
+        coordinators.append(build(scaffold=True, rounds_per_block=1))
+    for coord in coordinators:
+        reports.extend(coord.profile_programs())
+        if coord.telemetry is not None:
+            coord.telemetry.close()
+
+    if args.json:
+        print(json.dumps([r.to_dict() for r in reports], indent=2))
+    else:
+        print(format_cost_table(reports))
+    return 0 if reports else 1
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
@@ -434,6 +505,15 @@ def main(argv: list[str] | None = None) -> int:
         "dispatch under jax.transfer_guard('disallow') — an implicit host "
         "transfer in the hot path raises instead of silently serializing it",
     )
+    run.add_argument(
+        "--profile-programs", action="store_true",
+        help="profile every built round program at construction (XLA "
+        "cost_analysis/memory_analysis + roofline verdict): reports land in "
+        "the summary, as nanofed_program_* gauges, and as program_profile "
+        "telemetry records. Pays a second XLA compile unless the persistent "
+        "compilation cache is warm; `nanofed-tpu profile` does this without "
+        "running a federation at all",
+    )
 
     serve = sub.add_parser(
         "serve", help="host a real-network federation server (binary HTTP transport)"
@@ -503,6 +583,49 @@ def main(argv: list[str] | None = None) -> int:
         "for the most recent one (default: runs)",
     )
 
+    profile = sub.add_parser(
+        "profile",
+        help="compile the round programs (single step, fused block, SCAFFOLD) "
+        "WITHOUT running a federation and print the compiler's cost/roofline "
+        "table: XLA cost_analysis FLOPs, peak device bytes, arithmetic "
+        "intensity, compute- vs memory-bound verdict",
+    )
+    profile.add_argument("--model", default="mnist_cnn")
+    profile.add_argument("--clients", type=int, default=16)
+    profile.add_argument("--epochs", type=int, default=1)
+    profile.add_argument("--batch-size", type=int, default=64)
+    profile.add_argument("--lr", type=float, default=0.1)
+    profile.add_argument("--seed", type=int, default=0)
+    profile.add_argument("--data-dir", default=None)
+    profile.add_argument(
+        "--train-size", type=int, default=1024,
+        help="training-set size (synthetic unless --data-dir has real data); "
+        "only shapes matter — nothing executes",
+    )
+    profile.add_argument(
+        "--participation", type=float, default=1.0,
+        help="cohort participation rate: < 1 profiles the cohort-gathered "
+        "program the real rounds would dispatch",
+    )
+    profile.add_argument(
+        "--rounds-per-block", type=int, default=4,
+        help="also profile the fused R-round block program at this R "
+        "(1 = single-step only)",
+    )
+    profile.add_argument("--client-chunk", type=int, default=None)
+    profile.add_argument("--model-shards", type=int, default=1, metavar="N",
+                         help="profile the 2-D clients x model (FSDP) programs")
+    profile.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
+    profile.add_argument("--no-scaffold", action="store_true",
+                         help="skip the SCAFFOLD round program")
+    profile.add_argument("--json", action="store_true",
+                         help="full report dicts as JSON instead of the table")
+    profile.add_argument(
+        "--telemetry-dir", default=None,
+        help="also append program_profile records to a telemetry.jsonl here "
+        "(read back with `nanofed-tpu metrics-summary`)",
+    )
+
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
     bench.add_argument("--list", action="store_true", help="list benchmark names")
@@ -521,6 +644,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_serve(args)
     if args.cmd == "metrics-summary":
         return _cmd_metrics_summary(args)
+    if args.cmd == "profile":
+        return _cmd_profile(args)
     return _cmd_run(args)
 
 

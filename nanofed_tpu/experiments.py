@@ -75,6 +75,7 @@ def run_experiment(
     client_metrics_every: int = 1,
     model_shards: int = 1,
     strict: bool = False,
+    profile_programs: bool = False,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -104,6 +105,12 @@ def run_experiment(
     ``jax.eval_shape`` and every device dispatch runs under
     ``jax.transfer_guard("disallow")`` — an implicit host transfer in the hot
     path raises instead of silently serializing dispatch.
+
+    ``profile_programs=True`` (CLI ``--profile-programs``) runs the
+    compiled-program cost profiler at construction: every round program's XLA
+    ``cost_analysis``/``memory_analysis`` lands as ``nanofed_program_*`` gauges
+    and telemetry ``program_profile`` records, and the summary carries the
+    per-program roofline digest (see ``observability.profiling``).
     """
     log = Logger()
     robust = None
@@ -141,6 +148,7 @@ def run_experiment(
             lr_decay_gamma=lr_decay_gamma,
             rounds_per_block=rounds_per_block,
             client_metrics_every=client_metrics_every,
+            profile_programs=profile_programs,
         ),
         training=TrainingConfig(
             batch_size=batch_size,
@@ -167,8 +175,12 @@ def run_experiment(
         if spent is not None
         else None
     )
+    program_profiles = {
+        r.program: r.to_dict() for r in coordinator.program_catalog.reports()
+    }
     return {
         **({"privacy_spent": privacy_summary} if privacy_summary else {}),
+        **({"program_profiles": program_profiles} if program_profiles else {}),
         "model": model,
         "num_clients": num_clients,
         "rounds_completed": len(completed),

@@ -9,10 +9,27 @@ local-train → aggregate → publish; JSONL + Chrome-trace export, composing wi
 device captures from ``utils.profiling.trace``), and :class:`RunTelemetry`, the per-run
 ``telemetry.jsonl`` artifact both coordinators write.
 
+The compiled-program cost layer (:mod:`nanofed_tpu.observability.profiling`) adds
+what the wall-clock layers cannot: XLA's own ``cost_analysis()`` /
+``memory_analysis()`` of every round program, rooflined against per-platform
+peaks into a :class:`ProgramCostReport`, catalogued per process by
+:class:`ProgramCatalog`, and surfaced as ``nanofed_program_*`` gauges,
+``program_profile`` telemetry records, and the ``nanofed-tpu profile``
+subcommand.
+
 See ``docs/observability.md`` for the span taxonomy, metric inventory, and how to
 scrape ``/metrics`` or read ``telemetry.jsonl``.
 """
 
+from nanofed_tpu.observability.profiling import (
+    PlatformPeaks,
+    ProgramCatalog,
+    ProgramCostReport,
+    format_cost_table,
+    peaks_for_device_kind,
+    profile_program,
+    update_device_occupancy,
+)
 from nanofed_tpu.observability.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -36,13 +53,20 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PlatformPeaks",
+    "ProgramCatalog",
+    "ProgramCostReport",
     "RunTelemetry",
     "SPAN_HISTOGRAM",
     "SpanRecord",
     "SpanTracer",
     "TELEMETRY_FILENAME",
     "find_latest_telemetry",
+    "format_cost_table",
     "get_registry",
     "install_jax_event_bridge",
+    "peaks_for_device_kind",
+    "profile_program",
     "summarize_telemetry",
+    "update_device_occupancy",
 ]

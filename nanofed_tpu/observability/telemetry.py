@@ -178,6 +178,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     rounds: dict[str, int] = {}
     round_durations: list[float] = []
     snapshot: dict[str, Any] | None = None
+    program_profiles: dict[str, dict[str, Any]] = {}
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -201,6 +202,18 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     round_durations.append(float(rec["duration_s"]))
             elif rtype == "metrics_snapshot":
                 snapshot = rec.get("metrics")
+            elif rtype == "program_profile":
+                # Last record per program wins (a re-profile supersedes): keep
+                # the cost/roofline fields the summary table prints.
+                program_profiles[str(rec.get("program", "?"))] = {
+                    k: rec[k]
+                    for k in (
+                        "rounds", "flops", "flops_per_round", "bytes_accessed",
+                        "peak_bytes", "arithmetic_intensity", "verdict",
+                        "lower_bound_s", "compile_seconds", "platform",
+                    )
+                    if k in rec
+                }
 
     def _digest(durs: list[float]) -> dict[str, float]:
         durs = sorted(durs)
@@ -220,6 +233,10 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     }
     if round_durations:
         out["round_duration"] = _digest(round_durations)
+    if program_profiles:
+        # Compiled-program cost layer (observability.profiling): per-program
+        # compiler FLOPs, peak device bytes, and the roofline verdict.
+        out["program_profiles"] = dict(sorted(program_profiles.items()))
     if snapshot is not None:
         headline = {}
         for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",

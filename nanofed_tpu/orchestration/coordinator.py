@@ -40,6 +40,11 @@ from nanofed_tpu.aggregation.fedavg import compute_weights
 from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import ClientData, Params
 from nanofed_tpu.models.base import Model
+from nanofed_tpu.observability.profiling import (
+    ProgramCatalog,
+    ProgramCostReport,
+    update_device_occupancy,
+)
 from nanofed_tpu.observability.registry import get_registry
 from nanofed_tpu.observability.spans import SpanTracer
 from nanofed_tpu.observability.telemetry import RunTelemetry, install_jax_event_bridge
@@ -104,6 +109,13 @@ class CoordinatorConfig:
     lr_min_factor: float = 0.0
     lr_decay_every: int = 10  # step schedule: rounds between decays
     lr_decay_gamma: float = 0.5  # step schedule: multiplier per decay
+    # Compiled-program cost profiling (observability.profiling): profile every
+    # built round program at construction — XLA cost/memory analysis, roofline
+    # verdict, nanofed_program_* gauges, and telemetry `program_profile` records.
+    # Opt-in because profiling pays a second XLA compile unless the persistent
+    # compilation cache is warm; `Coordinator.profile_programs()` runs the same
+    # pass on demand either way.
+    profile_programs: bool = False
 
     def __post_init__(self) -> None:
         if self.num_rounds < 1:
@@ -377,6 +389,12 @@ class Coordinator:
                     cohort_mode=self._cohort_mode,
                     donate=True,
                 )
+        # Compiled-program cost catalog (observability.profiling): every program
+        # this coordinator built, registered with LAZY dispatch-shaped argument
+        # factories — registration is free (no trace, no compile, nothing
+        # materializes); `profile_programs()` compiles + extracts on demand.
+        self.program_catalog = ProgramCatalog()
+        self._register_programs()
         self._evaluator = (
             make_evaluator(model.apply, batch_size=256) if eval_data is not None else None
         )
@@ -470,6 +488,10 @@ class Coordinator:
         _registry = (
             self.telemetry.registry if self.telemetry is not None else get_registry()
         )
+        self._registry = _registry
+        # Program-cost gauges publish into the same registry every other
+        # instrument uses, so one /metrics scrape carries them too.
+        self.program_catalog.registry = _registry
         self._m_rounds = _registry.counter(
             "nanofed_rounds_total", "Federation rounds by outcome", labels=("status",)
         )
@@ -550,6 +572,138 @@ class Coordinator:
                 self._log.info(
                     "resumed from round %d checkpoint", restored.round_number
                 )
+
+        if config.profile_programs:
+            self.profile_programs()
+
+    # ------------------------------------------------------------------
+    # Compiled-program cost profiling (observability.profiling)
+    # ------------------------------------------------------------------
+
+    def _register_programs(self) -> None:
+        """Populate the catalog with every round program this coordinator built.
+
+        The argument factories reproduce the DISPATCH-time shapes and shardings
+        exactly — cohort-gathered data rides the client sharding, params/opt
+        state their ``param_sharding`` layout — so the lowered program the
+        profiler costs is the program the rounds actually run, not a
+        replicated-input cousin with different collectives.  Values are
+        irrelevant (lowering never executes), so data placeholders are zeros.
+        """
+        attrs = {
+            "mesh_shape": list(
+                (client_axis_size(self.mesh), self._model_shards)
+            ),
+            "step_clients": self._step_clients,
+        }
+
+        def _data_like():
+            if not self._cohort_mode:
+                return self._data
+            from nanofed_tpu.parallel.mesh import client_sharding
+
+            n = self._step_clients
+            return jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.zeros((n, *x.shape[1:]), x.dtype), self._data
+                ),
+                client_sharding(self.mesh),
+            )
+
+        def _step_common():
+            n = self._step_clients
+            weights = jnp.zeros(n, jnp.float32)
+            rngs = stack_rngs(jax.random.key(self.config.seed), n)
+            return _data_like(), weights, rngs, jnp.float32(1.0)
+
+        if self.scaffold:
+            def _scaffold_args():
+                data, weights, rngs, lr = _step_common()
+                if self._cohort_mode:
+                    from nanofed_tpu.parallel.mesh import client_sharding
+
+                    n = self._step_clients
+                    c_rows = jax.device_put(
+                        jax.tree.map(
+                            lambda x: jnp.zeros((n, *x.shape[1:]), x.dtype),
+                            self.c_stack,
+                        ),
+                        client_sharding(self.mesh),
+                    )
+                else:
+                    c_rows = self.c_stack
+                return (
+                    self.params, self.server_state, self.c_global, c_rows,
+                    data, weights, rngs, lr,
+                ), {}
+
+            self.program_catalog.register(
+                "scaffold_round_step", self._round_step,
+                args_factory=_scaffold_args, attrs=attrs,
+            )
+        else:
+            def _step_args():
+                data, weights, rngs, lr = _step_common()
+                return (
+                    self.params, self.server_state, data, weights, rngs, lr,
+                ), {}
+
+            self.program_catalog.register(
+                "round_step", self._round_step, args_factory=_step_args,
+                attrs=attrs,
+            )
+
+        if self._round_block is not None:
+            def _block_args():
+                rpb = self.config.rounds_per_block
+                n = self._step_clients
+                keys = stack_round_keys(self.config.seed, list(range(rpb)))
+                lr = jnp.ones(rpb, jnp.float32)
+                idx = (
+                    jnp.zeros((rpb, n), jnp.int32) if self._cohort_mode else None
+                )
+                mask = jnp.zeros((rpb, n), jnp.float32)
+                return (
+                    self.params, self.server_state, self._data,
+                    self._num_samples, keys, lr, idx, mask,
+                ), {}
+
+            self.program_catalog.register(
+                "round_block", self._round_block, args_factory=_block_args,
+                rounds=self.config.rounds_per_block,
+                attrs={**attrs, "rounds_per_block": self.config.rounds_per_block},
+            )
+
+    def profile_programs(self, force: bool = False) -> list[ProgramCostReport]:
+        """Compile + cost-analyze every catalogued round program.
+
+        Publishes ``nanofed_program_*`` gauges and the time-to-ready histogram
+        (via the catalog), appends a ``program_profile`` record per program to
+        ``telemetry.jsonl`` when telemetry is on, and returns the reports.
+        Reports are cached — a second call is free unless ``force``.
+        """
+        reports: list[ProgramCostReport] = []
+        for name in self.program_catalog.names():
+            cached = self.program_catalog.report(name) is not None and not force
+            with self._tracer.span("program-profile", program=name):
+                report = self.program_catalog.profile(name, force=force)
+            if not cached:
+                if self.telemetry is not None:
+                    self.telemetry.record("program_profile", **report.to_dict())
+                bound = report.lower_bound_s
+                self._log.info(
+                    "program %s: %.3g FLOPs/round, %.3g bytes accessed, peak "
+                    "%.3g device bytes, intensity %.2f -> %s%s (compiled in "
+                    "%.2fs)",
+                    name, report.flops / report.rounds, report.bytes_accessed,
+                    report.peak_bytes, report.arithmetic_intensity,
+                    report.verdict,
+                    (f", >= {bound / report.rounds:.3g}s/round achievable"
+                     if bound is not None else ""),
+                    report.compile_seconds,
+                )
+            reports.append(report)
+        return reports
 
     # ------------------------------------------------------------------
     # Strict mode (analysis.contracts)
@@ -847,6 +1001,10 @@ class Coordinator:
                 }
         block_duration = time.perf_counter() - t0
         per_round_s = block_duration / n
+        # Derived occupancy: host_sync (host blocked ON the device) over
+        # dispatch + host_sync + publish — updated at every block boundary so
+        # /metrics always carries the current ratio (see observability.profiling).
+        update_device_occupancy(self._registry)
 
         out: list[RoundMetrics] = []
         for i, r in enumerate(rounds):
@@ -952,6 +1110,9 @@ class Coordinator:
         self._m_round_duration.observe(duration)
         self._m_cohort.set(metrics.num_clients)
         self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
+        # Single-round occupancy basis: the local-train span blocks until the
+        # device round completes, so its share of the round span IS device time.
+        update_device_occupancy(self._registry)
         if self.telemetry is not None:
             self.telemetry.record(
                 "round", round=round_id, status=metrics.status.name,

@@ -280,4 +280,9 @@ def build_round_block(
             cohort_ids=ys.get("cohort_ids"),
         )
 
+    # Lowered-program access for the cost profiler (observability.profiling):
+    # round_block is a plain wrapper, so expose the inner jit — its signature is
+    # (params, sos, data, num_samples, base_keys, lr_scales, cohort_idx,
+    # cohort_mask), with None for idx/mask selecting on-device resampling.
+    round_block.jit_program = _block
     return round_block

@@ -501,6 +501,11 @@ def build_round_step(
         )
         return RoundStepResult(gp, sos, metrics, client_metrics, sq_norms)
 
+    # Lowered-program access for the cost profiler (observability.profiling):
+    # the jit callable IS the program — `.jit_program.lower(...)` is the uniform
+    # contract all three round-program builders expose (the fused-block builder
+    # returns a plain wrapper, so the attribute is load-bearing there).
+    round_step.jit_program = round_step
     return round_step
 
 

@@ -229,4 +229,7 @@ def build_scaffold_round_step(
         )
         return ScaffoldStepResult(gp, sos, cg, dc, metrics, client_metrics, sq_norms)
 
+    # Lowered-program access for the cost profiler (observability.profiling):
+    # same uniform `.jit_program` contract as build_round_step/build_round_block.
+    scaffold_step.jit_program = scaffold_step
     return scaffold_step

@@ -54,7 +54,8 @@ MODULES = [
                        "nanofed_tpu.communication.network_coordinator"]),
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
-                       "nanofed_tpu.observability.telemetry"]),
+                       "nanofed_tpu.observability.telemetry",
+                       "nanofed_tpu.observability.profiling"]),
     ("analysis", ["nanofed_tpu.analysis.fedlint",
                   "nanofed_tpu.analysis.contracts"]),
     ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",

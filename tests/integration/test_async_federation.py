@@ -218,7 +218,15 @@ def test_async_q8_reconstructs_against_the_fetched_base():
 def test_heterogeneous_speed_federation_end_to_end(devices):
     """The capability itself: 4 clients at very different speeds, K=2 buffer. The
     federation completes all aggregations without ever waiting for the slowest
-    cohort, stale updates appear (and are discounted), and the model learns."""
+    cohort, stale updates appear (and are discounted), and the model learns.
+
+    Deflaked for the 1-core CI host (CHANGES PR 4: fails under CPU contention on
+    seed code too): timeouts are wide enough to survive a contended core, and the
+    one TIMING-dependent assertion — that version overlap produced a stale update
+    — is gated behind a load check.  The functional assertions (all aggregations
+    complete, loss falls, params move) hold unconditionally."""
+    import os
+
     from nanofed_tpu.data import federate, synthetic_classification
 
     model = get_model("mlp", in_features=8, hidden=16, num_classes=3)
@@ -235,7 +243,7 @@ def test_heterogeneous_speed_federation_end_to_end(devices):
 
     async def client(cid, idx):
         data = jax.tree.map(lambda a: jnp.asarray(a[idx]), cd)
-        async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=30) as c:
+        async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=60) as c:
             while True:
                 fetched, rnd, active = await c.fetch_global_model(like=params)
                 if not active:
@@ -253,27 +261,45 @@ def test_heterogeneous_speed_federation_end_to_end(devices):
         server = HTTPServer(port=port)
         coord = NetworkCoordinator(
             server, params,
+            # round_timeout_s sized for a CONTENDED 1-core host: 6 aggregations
+            # of jitted sub-second fits fit in seconds on a quiet core, but any
+            # concurrent process can stretch one wait past a tight timeout.
             NetworkRoundConfig(num_rounds=6, async_buffer_k=2, staleness_window=4,
-                               round_timeout_s=20.0, poll_interval_s=0.005),
+                               round_timeout_s=60.0, poll_interval_s=0.005),
         )
         assert server.staleness_window == 4  # coordinator wired the window
         await server.start()
         try:
             tasks = [asyncio.create_task(client(f"c{i}", i)) for i in range(4)]
             history = await coord.run()
-            await asyncio.wait_for(asyncio.gather(*tasks), timeout=30)
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=90)
         finally:
             await server.stop()
         return history, coord
 
+    # Sampled BEFORE the run: a load check read afterwards would also count the
+    # test's own just-finished work.  Normalized per core and thresholded ABOVE
+    # 1.0: on the 1-core CI host the suite's own preceding tests keep the
+    # 1-minute loadavg near 1.0 even on a quiet machine, so a <=1.0 gate would
+    # skip the assertion on essentially every CI run — the gate must only trip
+    # on EXTRA contention (a second busy process), not on the suite itself.
+    try:
+        load_per_core = os.getloadavg()[0] / (os.cpu_count() or 1)
+    except OSError:  # platform without getloadavg
+        load_per_core = 0.0
+
     history, coord = asyncio.run(main())
     completed = [h for h in history if h["status"] == "COMPLETED"]
     assert len(completed) == 6
-    # No cohort barrier: every aggregation used exactly-ish the buffer fill, and
-    # at least one aggregated update was stale (heterogeneous speeds guarantee
-    # overlap between versions).
+    # No cohort barrier: every aggregation used exactly-ish the buffer fill.
     assert all(h["num_clients"] >= 2 for h in completed)
-    assert any(s > 0 for h in completed for s in h["staleness"])
+    # TIMING-dependent: stale updates appear only if slow clients' submissions
+    # overlap version publishes, which the delay schedule guarantees on a quiet
+    # core but a contended one can starve (the whole federation serializes and
+    # every update lands fresh).  Gate it on pre-run load; everything functional
+    # above and below stays unconditional.
+    if load_per_core <= 1.5:
+        assert any(s > 0 for h in completed for s in h["staleness"])
     # The model moved and the loss trajectory is sane (finite, generally falling).
     losses = [h["metrics"]["loss"] for h in completed if h["metrics"]["loss"]]
     assert all(np.isfinite(losses))

@@ -224,6 +224,53 @@ def test_metrics_summary_subcommand(tmp_path, capsys):
     assert "--telemetry-dir" in capsys.readouterr().err
 
 
+def test_profile_subcommand_compiles_without_running(tmp_path, capsys):
+    """`nanofed-tpu profile` compiles single-step, fused-block, and SCAFFOLD
+    round programs on CPU WITHOUT running a federation, and the reports reach
+    stdout + telemetry with compiler FLOPs, peak bytes, intensity, verdict."""
+    rc = main([
+        "profile", "--model", "digits_mlp", "--clients", "8",
+        "--batch-size", "16", "--rounds-per-block", "2", "--json",
+        "--telemetry-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    reports = json.loads(capsys.readouterr().out)
+    assert {r["program"] for r in reports} == {
+        "round_step", "round_block", "scaffold_round_step"
+    }
+    for r in reports:
+        assert r["flops"] > 0
+        assert r["peak_bytes"] > 0
+        assert r["arithmetic_intensity"] > 0
+        assert r["verdict"] == "no peak basis"  # CPU: no fabricated roofline
+    (block,) = [r for r in reports if r["program"] == "round_block"]
+    assert block["rounds"] == 2
+
+    # Telemetry carries program_profile records — and NO round records: the
+    # whole point is that nothing federated ran.
+    telemetry = (tmp_path / "telemetry.jsonl").read_text()
+    assert '"type": "program_profile"' in telemetry
+    assert '"type": "round"' not in telemetry
+    # metrics-summary digests them.
+    assert main(["metrics-summary", str(tmp_path)]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert set(summary["program_profiles"]) == {
+        "round_step", "round_block", "scaffold_round_step"
+    }
+
+
+def test_profile_table_output(capsys):
+    rc = main([
+        "profile", "--model", "digits_mlp", "--clients", "8",
+        "--batch-size", "16", "--rounds-per-block", "1", "--no-scaffold",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "round_step" in out
+    assert "roofline basis" in out
+    assert "flops/round" in out
+
+
 def test_unknown_benchmark_name_errors():
     with pytest.raises(KeyError):
         main(["bench", "not_a_benchmark"])
