@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke profile-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +25,12 @@ bench:
 # perf-plumbing regressions (fused engine, dispatch/host_sync spans) in tier-1.
 bench-smoke:
 	python -m pytest tests/integration/test_bench_smoke.py -q -s
+
+# Chaos smoke (nanofed_tpu.faults): a seeded 8-client federation with one
+# planned crash + one straggler must COMPLETE every round on a virtual clock
+# (tier-1-safe: seconds of real time, determinism from the plan's seed).
+chaos-smoke:
+	python -m pytest tests/integration/test_chaos.py::test_chaos_smoke -q
 
 # Compile-only cost profile on CPU (observability.profiling): the `profile`
 # subcommand must produce a non-empty roofline table — single step, fused

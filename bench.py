@@ -396,6 +396,92 @@ def compact_summary(results: list) -> dict:
     return out
 
 
+def provisional_summary(runs_dir: str = "runs") -> dict | None:
+    """A driver-parseable summary line built from the most recent ON-CHIP
+    campaign capture (``runs/bench_tpu_*.json``, written by
+    ``scripts/tpu_campaign.py``), labeled ``provisional_from`` — or None when
+    no capture exists or none parses.
+
+    Printed as the orchestrator's FIRST stdout line (round-6 belt-and-braces on
+    the "driver always records a parseable number" promise): the driver records
+    the LAST line, so if THIS run is killed before any workload completes
+    (rc=124 with a wedged tunnel — BENCH_r01 and r05 both did exactly that),
+    the last line standing is the previous campaign's labeled number instead of
+    nothing.  Any completed workload prints after it and supersedes it.
+
+    Module-level and pure-host (no jax) so the capture-selection and labeling
+    rules are unit-testable."""
+    import glob
+
+    # Tie-break equal mtimes (a fresh checkout stamps every capture alike) by
+    # name, so bench_tpu_r05 beats bench_tpu_r03 deterministically.
+    candidates = sorted(
+        glob.glob(os.path.join(runs_dir, "bench_tpu_*.json")),
+        key=lambda p: (os.path.getmtime(p), p),
+    )
+    for path in reversed(candidates):  # newest capture that parses wins
+        try:
+            with open(path) as f:
+                capture = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        results = capture.get("results", [])
+        summary = next(
+            (r for r in results if r.get("summary") and r.get("metric") == METRIC_FLAGSHIP),
+            None,
+        ) or next(
+            (r for r in results
+             if r.get("metric") == METRIC_FLAGSHIP and "value" in r),
+            None,
+        )
+        if summary is None or not isinstance(summary.get("value"), (int, float)):
+            continue
+        return {
+            "metric": METRIC_FLAGSHIP,
+            "value": summary["value"],
+            "unit": summary.get("unit", "s"),
+            "vs_baseline": summary.get("vs_baseline", 0.0),
+            "platform": summary.get("platform", "tpu"),
+            "summary": True,
+            "provisional": True,
+            "provisional_from": path,
+            "note": ("stale-but-real number from the last on-chip campaign "
+                     "capture, emitted at startup so a killed run still leaves "
+                     "a parseable record; superseded by any line below it"),
+        }
+    return None
+
+
+def cpu_fallback_basis(n_devices: int, physical_cores: int | None) -> dict:
+    """The stated basis of a CPU-fallback measurement, embedded in its records
+    so ``vs_baseline`` is auditable: how many virtual CPU devices the mesh ran
+    (XLA's intra-op thread pool parallelizes within each), and what the host
+    actually had.  On a 1-core host the mesh degenerates to 1 device and the
+    record says so — the comparison is then single-core vs the reference's
+    single-host CPU run, not a silently 100x-pessimized artifact."""
+    return {
+        "mesh_devices": int(n_devices),
+        "physical_cores": physical_cores,
+        "note": (
+            f"multi-device virtual CPU mesh ({n_devices} XLA host device(s), "
+            f"host has {physical_cores or 'unknown'} core(s)); XLA threads "
+            "within each device. The reference baseline is also a single-host "
+            "CPU run, so vs_baseline compares like with like at this core "
+            "count; override device count with NANOFED_BENCH_CPU_DEVICES"
+        ),
+    }
+
+
+def cpu_mesh_devices() -> int:
+    """Virtual CPU device count for the fallback mesh: match the host's cores
+    (capped at the 8 the TPU path uses) so the fallback is as like-for-like as
+    the hardware allows; ``NANOFED_BENCH_CPU_DEVICES`` overrides."""
+    env = os.environ.get("NANOFED_BENCH_CPU_DEVICES")
+    if env:
+        return max(1, int(env))
+    return max(1, min(8, os.cpu_count() or 1))
+
+
 def run_probe() -> None:
     """Short-budget backend probe: init jax's backend under a watchdog and print one
     machine-readable line.  The orchestrator uses this to distinguish a transient
@@ -431,8 +517,13 @@ def run_worker(platform: str, workloads: list[str]) -> None:
     )
 
     log_stage(f"worker({platform}: {','.join(workloads)}) start", t0=t0)
+    cpu_devices = cpu_mesh_devices()
     if platform == "cpu":
-        force_cpu_mesh(1)
+        # Like-for-like fallback (ROADMAP item 5): a multi-device virtual CPU
+        # mesh (threaded XLA within each device) instead of a hardwired single
+        # device, with the basis stated in every record.  On the 1-core CI
+        # host this still degenerates to 1 device — honestly labeled.
+        force_cpu_mesh(cpu_devices)
 
     import jax
     import jax.numpy as jnp
@@ -602,6 +693,8 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         })
         if BENCH_STRICT:
             out["strict"] = True
+        if on_cpu:
+            out["cpu_basis"] = cpu_fallback_basis(n_dev, os.cpu_count())
         out["phases"] = tracer.phase_summary()
         print(json.dumps(out), flush=True)
 
@@ -682,6 +775,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
         out["rounds_per_sec"] = round(1.0 / value, 3)
         if on_cpu:
             out["measured_clients"] = [1000 // s for s in flagship_scales]
+            out["cpu_basis"] = cpu_fallback_basis(n_dev, os.cpu_count())
         flops = CNN_TRAIN_FLOPS_PER_SAMPLE * FLAGSHIP_SAMPLE_PASSES
         if is_tpu:
             mfu = flops / value / (V5E_BF16_PEAK_FLOPS * n_dev)
@@ -822,6 +916,18 @@ def main() -> None:
         have = {r["metric"] for r in results}
         return [w for w, m in (("parity", METRIC_PARITY), ("flagship", METRIC_FLAGSHIP))
                 if m not in have]
+
+    # Un-losable record, part 1 (ROADMAP item 5): the FIRST stdout line is a
+    # provisional summary from the last on-chip campaign capture, labeled
+    # provisional_from.  The driver keeps the LAST line, so this only survives
+    # when everything after it is killed — exactly the rc=124 case that left
+    # BENCH_r01/r05 with parsed=null.
+    provisional = provisional_summary()
+    if provisional is not None:
+        print(json.dumps(provisional), flush=True)
+        print(f"[bench] provisional summary emitted from "
+              f"{provisional['provisional_from']} (superseded by any completed "
+              "workload below)", file=sys.stderr, flush=True)
 
     # Consult the persisted probe verdict BEFORE committing ANY accel budget
     # (plan_accel_attempt): a fresh "wedged" verdict skips the accelerator

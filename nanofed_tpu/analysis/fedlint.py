@@ -40,7 +40,11 @@ Rules
   "the GIL makes it safe" is exactly the hand-wave this rule retires.
 - **FED006** — blocking call inside ``async def`` (``time.sleep``, synchronous
   file IO, ``requests``, ``subprocess``): one blocked coroutine stalls every
-  handler on the event loop.
+  handler on the event loop.  In ``communication`` REQUEST HANDLERS
+  (``_handle_*``) the rule also flags UNBOUNDED awaits of the request body
+  (``await request.read()``/``.json()``/``.text()`` without
+  ``asyncio.wait_for``): a peer trickling bytes — slowloris — holds the
+  handler, and any admission slot it occupies, open forever.
 
 Traced scope is resolved by following ``jit``/``shard_map``/``lax.scan``/
 ``vmap`` wrapper applications and then propagating over call edges within the
@@ -75,7 +79,7 @@ RULES: dict[str, str] = {
     "FED003": "PRNG key consumed more than once without split/fold_in",
     "FED004": "jit of params-shaped state without donate_argnums",
     "FED005": "unlocked mutation of lock-guarded shared state",
-    "FED006": "blocking call inside async code",
+    "FED006": "blocking call inside async code / unbounded await in a request handler",
 }
 
 #: jit-like wrappers whose function argument (or decorated function) executes traced.
@@ -123,6 +127,11 @@ _BLOCKING_CALLS = {
 }
 _BLOCKING_PREFIXES = ("requests.",)
 _SYNC_IO_METHODS = {"write_text", "read_text", "write_bytes", "read_bytes"}
+
+#: Request-body awaits with NO internal timeout (FED006's unbounded-await
+#: extension): in ``communication`` request handlers these must be wrapped in
+#: ``asyncio.wait_for`` — the peer controls how long they take.
+_UNBOUNDED_AWAIT_METHODS = {"read", "json", "text", "receive"}
 
 #: Modules whose NON-traced code is still held to the no-hidden-host-sync bar
 #: (the round-dispatch hot path): block_until_ready / device_get there must be
@@ -925,6 +934,34 @@ def _check_async_blocking(model: _FileModel, out: list[Diagnostic]) -> None:
                     f"blocking call {blocking} inside async function "
                     f"{info.qualname!r}: stalls the whole event loop — use "
                     "asyncio.sleep/aiohttp/asyncio.to_thread",
+                ))
+        # Unbounded-await extension: request handlers in the communication
+        # layer must bound body reads with asyncio.wait_for — the size cap
+        # (client_max_size) does not bound TIME, and a slowloris peer would
+        # hold the handler (and its admission-control slot) open forever.
+        if not (
+            model.module.startswith("nanofed_tpu.communication")
+            and info.qualname.split(".")[-1].startswith("_handle")
+        ):
+            continue
+        handler_params = set(info.params)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Await):
+                continue
+            call = node.value
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _UNBOUNDED_AWAIT_METHODS
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in handler_params
+            ):
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED006",
+                    f"unbounded `await {call.func.value.id}."
+                    f"{call.func.attr}()` in request handler "
+                    f"{info.qualname!r}: the peer controls how long this "
+                    "takes (slowloris) — bound it with asyncio.wait_for",
                 ))
 
 

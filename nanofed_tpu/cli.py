@@ -299,6 +299,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
 
+    chaos = None
+    if args.chaos_plan is not None:
+        from nanofed_tpu.faults import ChaosSchedule, FaultPlan
+
+        try:
+            chaos = ChaosSchedule(FaultPlan.load(args.chaos_plan))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: could not load chaos plan {args.chaos_plan!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
     model = get_model(args.model)
     params = model.init(jax.random.key(args.seed))
     secure = None
@@ -328,8 +339,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
         validation = ValidationConfig(max_norm=args.max_norm)
 
+    state_store = None
+    if args.state_dir is not None:
+        from nanofed_tpu.persistence.state_store import FileStateStore
+
+        state_store = FileStateStore(args.state_dir)
+
     async def serve() -> list[dict]:
-        server = HTTPServer(host=args.host, port=args.port)
+        server = HTTPServer(
+            host=args.host, port=args.port, max_inflight=args.max_inflight,
+            chaos=chaos,
+        )
         await server.start()
         try:
             coordinator = NetworkCoordinator(
@@ -340,6 +360,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     min_completion_rate=completion_rate,
                     round_timeout_s=args.timeout,
                     max_clients=args.max_clients,
+                    straggler_evict_after=args.evict_stragglers,
                     async_buffer_k=args.async_buffer,
                     staleness_window=(
                         args.staleness_window
@@ -349,6 +370,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 validation=validation,
                 secure=secure,
                 telemetry_dir=args.telemetry_dir,
+                state_store=state_store,
+                chaos=chaos,
             )
             return await coordinator.run()
         finally:
@@ -359,6 +382,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     except TimeoutError as e:
         # Cohort never completed enrollment: keep the JSON-output contract.
         print(json.dumps([{"status": "FAILED", "error": str(e)}]))
+        return 1
+    except RuntimeError as e:
+        from nanofed_tpu.faults import InjectedServerCrash
+
+        if not isinstance(e, InjectedServerCrash):
+            raise
+        # A planned server kill: exactly what an operator's supervisor sees.
+        # Re-running the same command with the same --state-dir resumes from
+        # the last completed round's checkpoint.
+        print(json.dumps([{
+            "status": "CRASHED", "error": str(e),
+            "resume": ("re-run with the same --state-dir to resume from the "
+                       "last completed round" if args.state_dir is not None
+                       else "no --state-dir: a restart would begin from round 0"),
+        }]))
         return 1
     print(json.dumps(history, indent=2, default=str))
     return 0 if all(h["status"] == "COMPLETED" for h in history) else 1
@@ -566,6 +604,34 @@ def main(argv: list[str] | None = None) -> int:
         "published versions (default 4; staleness discounted as (1+s)^-0.5)")
     serve.add_argument("--max-norm", type=float, default=100.0,
                        help="per-leaf norm cap for --validate")
+    serve.add_argument(
+        "--max-inflight", type=int, default=None, metavar="N",
+        help="admission control: at most N update bodies in the read/decode "
+        "pipeline at once; excess submits get an immediate 429 + Retry-After "
+        "(clients with a RetryPolicy back off and re-send). Default: unbounded",
+    )
+    serve.add_argument(
+        "--evict-stragglers", type=int, default=0, metavar="K",
+        help="sync rounds: evict a previously-seen client after K consecutive "
+        "missed rounds, shrinking the round barrier (completion-rate graceful "
+        "degradation) so one dead client stops costing every round a timeout; "
+        "0 = never (default)",
+    )
+    serve.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="crash recovery: checkpoint every completed round's params + "
+        "engine state here, and RESUME from the latest checkpoint at startup "
+        "— a killed server re-run with the same --state-dir continues where "
+        "it left off (clients re-sync via retried fetches / stale-round 400s)",
+    )
+    serve.add_argument(
+        "--chaos-plan", default=None, metavar="PLAN.json",
+        help="fault injection: load a seeded FaultPlan (nanofed_tpu.faults) "
+        "and apply its wire faults (drop/ack_drop/delay) at the server "
+        "boundary and its server_kill events in the round loop — for drills "
+        "proving a deployment's retry/admission/recovery configuration "
+        "actually survives the plan",
+    )
     serve.add_argument(
         "--telemetry-dir", default=None,
         help="write this server run's telemetry.jsonl (round/phase spans + round "

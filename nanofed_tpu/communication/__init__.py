@@ -28,6 +28,9 @@ _NET_EXPORTS = {
     "fedbuff_combine": "network_coordinator",
     "stack_model_updates": "network_coordinator",
     "SecAggRoster": "http_client",
+    "RetryPolicy": "retry",
+    "RETRYABLE_STATUSES": "retry",
+    "parse_retry_after": "retry",
 }
 
 
@@ -55,6 +58,9 @@ __all__ = [
     "fedbuff_combine",
     "reconstruct_q8",
     "reconstruct_topk8",
+    "RetryPolicy",
+    "RETRYABLE_STATUSES",
+    "parse_retry_after",
     "SecAggRoster",
     "ServerEndpoints",
     "decode_params",

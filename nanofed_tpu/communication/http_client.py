@@ -10,7 +10,7 @@ from __future__ import annotations
 import asyncio
 import json
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Callable
 
 import aiohttp
 import jax
@@ -33,11 +33,22 @@ from nanofed_tpu.communication.http_server import (
     HEADER_SECAGG,
     HEADER_SIGNATURE,
     HEADER_STATUS,
+    HEADER_SUBMIT,
+)
+from nanofed_tpu.communication.retry import (
+    RETRYABLE_STATUSES,
+    RetryPolicy,
+    parse_retry_after,
 )
 from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import Params
 from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from nanofed_tpu.utils.logger import Logger
+
+#: Connection-level failures a retry can fix (the server restarted, the
+#: connection was severed mid-flight, the request timed out in transit).
+_RETRYABLE_EXCEPTIONS = (aiohttp.ClientConnectionError, asyncio.TimeoutError)
 
 
 @dataclass(frozen=True)
@@ -100,6 +111,9 @@ class HTTPClient:
         update_encoding: str = "npz",
         topk_fraction: float = 0.05,
         registry: MetricsRegistry | None = None,
+        retry: RetryPolicy | None = None,
+        clock: Clock | None = None,
+        wire_filter: Callable[[str, bytes], bytes] | None = None,
     ) -> None:
         """``security_manager`` (a ``nanofed_tpu.security.SecurityManager``) makes every
         submitted update carry an RSA-PSS signature header; pair it with a server
@@ -114,7 +128,25 @@ class HTTPClient:
         round's delta, so the bias of top-k selection cancels over rounds
         (Seide et al. 2014).  Both require fetching the global model through THIS
         client each round (the delta's base); signatures are computed over the
-        server's exact reconstruction, so signing composes."""
+        server's exact reconstruction, so signing composes.
+
+        ``retry`` (a ``RetryPolicy``) makes model fetches and update submits
+        survive transient failures: connection errors, server restarts, and
+        admission-control 429s are retried with exponential backoff + jitter
+        (429 ``Retry-After`` is honored as a floor).  Every logical submit
+        carries an idempotency key (``X-NanoFed-Submit``), so a retry after a
+        lost ACK is folded by the server AT MOST once — the retry policy
+        composes with the topk8 ``_pending_base`` error-feedback contract
+        instead of double-counting deltas.  Protocol rejections (400 stale
+        round, 403 signature, 413) stay final: retrying them verbatim cannot
+        succeed.
+
+        ``clock`` injects the time source for backoff sleeps and poll
+        deadlines (default: the real event-loop clock); ``wire_filter``
+        — ``(endpoint, body) -> body`` — is a fault-injection hook applied to
+        outgoing update bodies at the wire boundary (see
+        ``nanofed_tpu.faults``), simulating in-flight corruption AFTER
+        signing, exactly like a flipped bit on the network."""
         if update_encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
             raise NanoFedError(
                 f"unknown update_encoding {update_encoding!r} (choose 'npz', "
@@ -128,10 +160,16 @@ class HTTPClient:
         self.security_manager = security_manager
         self.update_encoding = update_encoding
         self.topk_fraction = topk_fraction
+        self.retry = retry
+        self.wire_filter = wire_filter
+        self._clock = clock or SYSTEM_CLOCK
+        self._retry_rng = retry.rng_for(client_id) if retry is not None else None
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self._session: aiohttp.ClientSession | None = None
         self._log = Logger()
         self.current_round = 0
+        self._submit_seq = 0  # idempotency-key counter (one per LOGICAL submit)
+        self._last_update_post: tuple[str, bytes, dict[str, str]] | None = None
         self._secagg_session = ""  # cohort session nonce, cached from the roster
         self._last_global: Params | None = None  # compressed-delta base, set by fetch
         self._residual: Params | None = None  # topk8 error-feedback accumulator
@@ -162,6 +200,11 @@ class HTTPClient:
             "Last update's wire bytes / raw float32 bytes, by encoding",
             labels=("encoding",),
         )
+        self._m_retries = reg.counter(
+            "nanofed_client_retries_total",
+            "Request retries by endpoint and failure reason",
+            labels=("endpoint", "reason"),
+        )
 
     @property
     def secagg_session(self) -> str:
@@ -183,24 +226,91 @@ class HTTPClient:
             raise NanoFedError("HTTPClient must be used as an async context manager")
         return self._session
 
+    async def _request_with_retries(
+        self,
+        method: str,
+        url: str,
+        *,
+        data: bytes | None = None,
+        headers: dict[str, str] | None = None,
+        endpoint: str = "",
+    ) -> tuple[int, dict[str, str], bytes | None, str | None]:
+        """One LOGICAL request under the retry policy (a single plain request
+        when no policy is configured).
+
+        Retries connection-level failures and the retryable statuses (429 with
+        its ``Retry-After`` honored as a backoff floor, 502/503/504) with
+        exponential backoff + jitter, inside the policy's attempt and budget
+        limits; protocol rejections (400/403/413/...) return immediately.
+        Returns ``(status, response_headers, body, error_message)`` — body is
+        the response bytes on 200, error_message the server's explanation (or
+        the exception) otherwise; connection-level failure is status ``-1``.
+        The SAME bytes and headers ride every attempt, so a retried submit
+        keeps its idempotency key and its signature."""
+        session = self._require_session()
+        policy = self.retry
+        deadline = (
+            self._clock.time() + policy.budget_s
+            if policy is not None and policy.budget_s is not None
+            else None
+        )
+        attempt = 1
+        while True:
+            retry_after: float | None = None
+            message: str | None = None
+            try:
+                async with session.request(
+                    method, url, data=data, headers=headers
+                ) as resp:
+                    status = resp.status
+                    if status == 200:
+                        return status, dict(resp.headers), await resp.read(), None
+                    retry_after = parse_retry_after(resp.headers.get("Retry-After"))
+                    # Framework error pages (413 too-large, 500) are text, not
+                    # JSON.
+                    try:
+                        message = (await resp.json()).get("message")
+                    except Exception:
+                        message = (await resp.text())[:200]
+                retryable = status in RETRYABLE_STATUSES
+                reason = f"http_{status}"
+            except _RETRYABLE_EXCEPTIONS as e:
+                status = -1
+                message = f"{type(e).__name__}: {e}"
+                retryable, reason = True, type(e).__name__
+            if policy is None or not retryable or attempt >= policy.max_attempts:
+                return status, {}, None, message
+            delay = policy.backoff_s(attempt, self._retry_rng, retry_after)
+            if deadline is not None and self._clock.time() + delay > deadline:
+                return status, {}, None, f"{message} (retry budget exhausted)"
+            self._m_retries.inc(endpoint=endpoint, reason=reason)
+            self._log.warning(
+                "%s %s failed (%s); retry %d/%d in %.3fs",
+                method, endpoint, reason, attempt, policy.max_attempts - 1, delay,
+            )
+            await self._clock.sleep(delay)
+            attempt += 1
+
     async def fetch_global_model(
         self, like: Params | None = None
     ) -> tuple[Params | None, int, bool]:
         """GET the current global model.
 
         Returns ``(params, round_number, training_active)``; params is None when the
-        server has terminated training (parity: ``client.py:104-145``).
+        server has terminated training (parity: ``client.py:104-145``).  With a
+        ``retry`` policy the fetch rides out transient connection failures —
+        including a server restarting mid-round — before raising.
         """
-        session = self._require_session()
         url = self.server_url + self.endpoints.model
-        async with session.get(url) as resp:
-            if resp.status != 200:
-                raise NanoFedError(f"fetch_global_model: HTTP {resp.status}")
-            round_number = int(resp.headers.get(HEADER_ROUND, "0"))
-            self.current_round = round_number
-            if resp.headers.get(HEADER_STATUS) == "terminated":
-                return None, round_number, False
-            payload = await resp.read()
+        status, resp_headers, payload, message = await self._request_with_retries(
+            "GET", url, endpoint="model"
+        )
+        if status != 200 or payload is None:
+            raise NanoFedError(f"fetch_global_model: HTTP {status} ({message})")
+        round_number = int(resp_headers.get(HEADER_ROUND, "0"))
+        self.current_round = round_number
+        if resp_headers.get(HEADER_STATUS) == "terminated":
+            return None, round_number, False
         self._m_bytes_rx.inc(len(payload), endpoint="model")
         params = decode_params(payload, like=like)
         if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
@@ -220,13 +330,25 @@ class HTTPClient:
         Under ``update_encoding="q8-delta"`` the body is the quantized round delta and
         the signature covers the server's exact reconstruction (base + dequantized
         delta — recomputed locally with the same numpy float32 arithmetic), so a
-        verifying server accepts precisely what it will aggregate."""
-        session = self._require_session()
+        verifying server accepts precisely what it will aggregate.
+
+        Every call is one LOGICAL submit with a fresh idempotency key; with a
+        ``retry`` policy the same bytes + key are re-POSTed through transient
+        failures, and the server folds the key at most once (a retry after a
+        lost ACK returns its cached acceptance).  If every attempt fails, the
+        client assumes the update was NOT applied (topk8 folds the whole delta
+        into the error-feedback residual) — the idempotency key is what keeps
+        that assumption safe: should the server actually have buffered a lost-
+        ACK attempt, a later identical retry would be answered as a duplicate
+        rather than double-counted."""
+        self._require_session()
         url = self.server_url + self.endpoints.update
+        self._submit_seq += 1
         headers = {
             HEADER_CLIENT: self.client_id,
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
+            HEADER_SUBMIT: f"{self.client_id}:{self.current_round}:{self._submit_seq}",
         }
         staged_residual: Params | None = None
         if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
@@ -298,31 +420,56 @@ class HTTPClient:
                 headers[HEADER_METRICS],
             )
             headers[HEADER_SIGNATURE] = base64.b64encode(signature).decode()
+        if self.wire_filter is not None:
+            # Fault-injection hook AFTER signing: a corrupted body is what a
+            # flipped bit in transit looks like — the server must reject it
+            # (bad payload / bad signature), never aggregate it.
+            body = self.wire_filter("update", body)
         self._m_bytes_tx.inc(len(body), endpoint="update")
-        async with session.post(url, data=body, headers=headers) as resp:
-            if resp.status != 200:
-                # Framework error pages (413 too-large, 500) are text, not JSON.
-                try:
-                    message = (await resp.json()).get("message")
-                except Exception:
-                    message = (await resp.text())[:200]
-                self._log.warning("update rejected (HTTP %d): %s", resp.status, message)
-                self._m_submissions.inc(result="rejected")
-                if self.update_encoding == ENCODING_TOPK8:
-                    # A rejected submit applied NOTHING server-side: fold the WHOLE
-                    # combined delta (this round's progress + all accumulated tail)
-                    # into the accumulator so true error-feedback semantics hold
-                    # across a dropped round — the mass rides the next round's
-                    # delta instead of vanishing from both sides forever.
-                    # _pending_base pins where the fold stopped, so an immediate
-                    # retry contributes only post-fold training (see submit above).
-                    self._residual = delta
-                    self._pending_base = params
-                return False
+        self._last_update_post = (url, bytes(body), dict(headers))
+        status, _, _, message = await self._request_with_retries(
+            "POST", url, data=body, headers=headers, endpoint="update"
+        )
+        if status != 200:
+            self._log.warning("update rejected (HTTP %d): %s", status, message)
+            self._m_submissions.inc(result="rejected")
+            if self.update_encoding == ENCODING_TOPK8:
+                # A rejected submit applied NOTHING server-side: fold the WHOLE
+                # combined delta (this round's progress + all accumulated tail)
+                # into the accumulator so true error-feedback semantics hold
+                # across a dropped round — the mass rides the next round's
+                # delta instead of vanishing from both sides forever.
+                # _pending_base pins where the fold stopped, so an immediate
+                # retry contributes only post-fold training (see submit above).
+                # A lost-ACK attempt whose retries ALL fail leaves genuine
+                # at-most-once ambiguity (the server may have buffered attempt
+                # 1); the retry policy makes that window small, and the next
+                # fetch_global_model resets the base either way.
+                self._residual = delta
+                self._pending_base = params
+            return False
         if staged_residual is not None:
             self._residual = staged_residual
             self._pending_base = None
         self._m_submissions.inc(result="accepted")
+        return True
+
+    async def resend_last_update(self) -> bool:
+        """Re-POST the EXACT bytes + headers (same idempotency key) of the last
+        ``submit_update`` — the duplicate a retry storm produces after a lost
+        ACK, exposed directly so the chaos harness can drive N duplicates
+        deterministically.  The server must fold the key at most once; error-
+        feedback state is deliberately untouched (the logical submit already
+        settled it)."""
+        if self._last_update_post is None:
+            raise NanoFedError("no update has been submitted yet")
+        url, body, headers = self._last_update_post
+        status, _, _, message = await self._request_with_retries(
+            "POST", url, data=body, headers=headers, endpoint="update"
+        )
+        if status != 200:
+            self._log.warning("duplicate update rejected (HTTP %d): %s", status, message)
+            return False
         return True
 
     # ------------------------------------------------------------------
@@ -383,7 +530,7 @@ class HTTPClient:
 
         session = self._require_session()
         url = self.server_url + self.endpoints.secagg_roster
-        deadline = asyncio.get_event_loop().time() + timeout_s
+        deadline = self._clock.time() + timeout_s
         while True:
             async with session.get(url) as resp:
                 if resp.status != 200:
@@ -400,12 +547,12 @@ class HTTPClient:
                     backend=str(payload.get("backend", "host")),
                     threshold=int(raw_t) if raw_t is not None else None,
                 )
-            if asyncio.get_event_loop().time() > deadline:
+            if self._clock.time() > deadline:
                 raise NanoFedError(
                     f"secagg roster incomplete after {timeout_s}s "
                     f"({payload.get('enrolled')}/{payload.get('expected')})"
                 )
-            await asyncio.sleep(poll_interval_s)
+            await self._clock.sleep(poll_interval_s)
 
     async def fetch_secagg_participants(self) -> list[str]:
         """This round's ACTIVE cohort (enrolled minus evicted) — what the per-round
@@ -483,7 +630,7 @@ class HTTPClient:
 
         session = self._require_session()
         url = self.server_url + self.endpoints.secagg_shares
-        deadline = asyncio.get_event_loop().time() + timeout_s
+        deadline = self._clock.time() + timeout_s
         while True:
             async with session.get(url, headers={HEADER_CLIENT: self.client_id}) as resp:
                 if resp.status != 200:
@@ -498,12 +645,12 @@ class HTTPClient:
                 epks = {c: base64.b64decode(k)
                         for c, k in payload["epks"].items()}
                 return epks, dict(payload["inbox"])
-            if asyncio.get_event_loop().time() > deadline:
+            if self._clock.time() > deadline:
                 raise NanoFedError(
                     f"share deposits incomplete after {timeout_s}s "
                     f"({payload.get('deposited')}/{payload.get('expected')})"
                 )
-            await asyncio.sleep(poll_interval_s)
+            await self._clock.sleep(poll_interval_s)
 
     async def poll_unmask_request(self) -> dict[str, Any] | None:
         """One poll of the unmask endpoint: the active request dict (round / dropped /
@@ -563,11 +710,13 @@ class HTTPClient:
         buf = io.BytesIO()
         np.savez_compressed(buf, masked=np.asarray(masked, np.uint32))
         body = buf.getvalue()
+        self._submit_seq += 1
         headers = {
             HEADER_CLIENT: self.client_id,
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
             HEADER_SECAGG: "masked",
+            HEADER_SUBMIT: f"{self.client_id}:{self.current_round}:{self._submit_seq}",
         }
         if self.security_manager is not None:
             import base64
@@ -603,4 +752,4 @@ class HTTPClient:
             status = await self.check_server_status()
             if not status.get("training_active", False):
                 return
-            await asyncio.sleep(poll_interval_s)
+            await self._clock.sleep(poll_interval_s)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Iterable
 
@@ -34,10 +35,16 @@ from nanofed_tpu.communication.codec import (
 )
 from nanofed_tpu.core.types import ModelUpdate, Params
 from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
 
 MAX_REQUEST_SIZE = 100 * 1024 * 1024  # parity: 100 MB cap, server.py:72
+
+#: Idempotency keys remembered per client: a retry storm's duplicates must
+#: dedupe against a WINDOW of recent submits (a client retries at most a
+#: handful of logical submits concurrently), bounded so memory stays O(clients).
+SUBMIT_KEY_WINDOW = 16
 
 #: Metadata travels in headers; the body is pure npz bytes.
 HEADER_CLIENT = "X-NanoFed-Client"
@@ -47,6 +54,7 @@ HEADER_STATUS = "X-NanoFed-Status"
 HEADER_SIGNATURE = "X-NanoFed-Signature"  # base64 RSA-PSS signature of the npz params
 HEADER_SECAGG = "X-NanoFed-SecAgg"  # "masked" flags a pairwise-masked uint32 payload
 HEADER_ENCODING = "X-NanoFed-Encoding"  # absent/"npz" = full params; "q8-delta" = codec
+HEADER_SUBMIT = "X-NanoFed-Submit"  # idempotency key: one per LOGICAL submit, rides retries
 
 
 @dataclass(frozen=True)
@@ -78,6 +86,11 @@ class HTTPServer:
         require_signatures: bool = False,
         staleness_window: int = 0,
         registry: MetricsRegistry | None = None,
+        max_inflight: int | None = None,
+        retry_after_s: float = 0.25,
+        read_timeout_s: float = 30.0,
+        chaos: Any | None = None,
+        clock: Clock | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -98,17 +111,46 @@ class HTTPServer:
         ``registry`` (default: the process-wide one) receives this server's wire
         metrics — bytes tx/rx per endpoint, update acceptances/rejections by reason,
         secure-aggregation evictions — and is what ``GET /metrics`` renders in
-        Prometheus text format."""
+        Prometheus text format.
+
+        ``max_inflight`` is the admission-control bound: at most that many
+        update bodies may be in the read/decode pipeline at once; excess
+        submits are answered ``429`` + ``Retry-After: retry_after_s`` WITHOUT
+        reading their bodies, so overload degrades to client backoff instead
+        of unbounded memory growth and event-loop starvation (None = no bound,
+        the pre-admission-control behavior).  ``read_timeout_s`` bounds how
+        long any request BODY may take to arrive (``client_max_size`` bounds
+        its size): a peer trickling bytes can no longer hold a handler — and
+        its admission slot — open forever; a stalled read is answered 408.
+
+        ``chaos`` (a ``nanofed_tpu.faults.ChaosSchedule``, duck-typed to keep
+        this module dependency-light) injects wire faults at the server
+        boundary: per the seeded plan, an update request is severed before
+        handling (``drop``), severed after handling but before its response
+        (``ack_drop`` — the lost-ACK case idempotent submit keys exist for),
+        or delayed.  ``clock`` injects the time source for those delays."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
+        if max_inflight is not None and max_inflight < 0:
+            raise ValueError("max_inflight must be >= 0 (0 rejects every submit)")
+        if read_timeout_s <= 0:
+            raise ValueError("read_timeout_s must be > 0")
         self.host = host
         self.port = port
         self.endpoints = endpoints or ServerEndpoints()
         self.client_keys = dict(client_keys or {})
         self.require_signatures = require_signatures
         self.staleness_window = staleness_window
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s
+        self.read_timeout_s = read_timeout_s
+        self._chaos = chaos
+        self._clock = clock or SYSTEM_CLOCK
         self._log = Logger()
         self._lock = asyncio.Lock()
+        self._inflight = 0  # submits currently in the read/decode pipeline
+        # client -> recent (submit key, fingerprint) pairs (see _submit_fingerprint)
+        self._seen_submits: dict[str, deque[tuple[str, str]]] = {}
         self._updates: dict[str, ModelUpdate] = {}
         self._params: Params | None = None
         self._params_bytes: bytes | None = None
@@ -161,7 +203,25 @@ class HTTPServer:
             "nanofed_secagg_evictions_total",
             "Clients evicted from the secure-aggregation cohort",
         )
-        self._app = web.Application(client_max_size=max_request_size)
+        self._m_429 = self.metrics_registry.counter(
+            "nanofed_http_429_total",
+            "Requests shed by admission control (429 + Retry-After), by endpoint",
+            labels=("endpoint",),
+        )
+        self._m_read_timeouts = self.metrics_registry.counter(
+            "nanofed_read_timeouts_total",
+            "Request bodies that failed to arrive within read_timeout_s (408)",
+        )
+        middlewares = []
+        if chaos is not None:
+            @web.middleware
+            async def chaos_mw(request: web.Request, handler: Any) -> Any:
+                return await self._apply_chaos(request, handler)
+
+            middlewares.append(chaos_mw)
+        self._app = web.Application(
+            client_max_size=max_request_size, middlewares=middlewares
+        )
         self._app.router.add_get(self.endpoints.model, self._handle_get_model)
         self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
         self._app.router.add_get(self.endpoints.status, self._handle_status)
@@ -449,6 +509,115 @@ class HTTPServer:
         return self._round
 
     # ------------------------------------------------------------------
+    # Fault injection (chaos middleware) + bounded reads
+    # ------------------------------------------------------------------
+
+    async def _apply_chaos(self, request: web.Request, handler: Any) -> Any:
+        """Apply the chaos schedule's wire fault to this request, if any.
+
+        Only the update endpoint is faulted (the model/status/secagg paths have
+        their own failure modes driven from the client side): ``drop`` severs
+        the connection BEFORE the handler — the submit never happened;
+        ``ack_drop`` runs the handler (the update IS buffered) and severs the
+        connection before the response — the lost ACK that makes idempotent
+        submit keys necessary; ``delay`` holds the request for its seconds.
+        One-shot events are consumed by the schedule, so a retry eventually
+        gets through."""
+        if self._chaos is None or request.path != self.endpoints.update:
+            return await handler(request)
+        event = self._chaos.wire_fault(
+            request.headers.get(HEADER_CLIENT), request.headers.get(HEADER_ROUND)
+        )
+        if event is None:
+            return await handler(request)
+        if event.kind == "delay":
+            await self._clock.sleep(event.seconds)
+            return await handler(request)
+        if event.kind == "drop":
+            self._log.warning("chaos: dropping request from %s pre-handler",
+                              request.headers.get(HEADER_CLIENT))
+            if request.transport is not None:
+                request.transport.close()
+            return web.Response(status=500)  # never reaches the severed peer
+        # ack_drop: the handler's effects are REAL, only the response is lost.
+        response = await handler(request)
+        self._log.warning("chaos: severing connection from %s before its ACK",
+                          request.headers.get(HEADER_CLIENT))
+        if request.transport is not None:
+            request.transport.close()
+        return response
+
+    async def _read_body(self, request: web.Request) -> bytes:
+        """Read the request body with a TIME bound (``client_max_size`` bounds
+        the size): a slowloris peer trickling bytes must not hold this handler
+        — and its admission slot — open past ``read_timeout_s``."""
+        try:
+            return await asyncio.wait_for(request.read(), timeout=self.read_timeout_s)
+        except asyncio.TimeoutError:
+            self._m_read_timeouts.inc()
+            raise web.HTTPRequestTimeout(
+                text=json.dumps({
+                    "status": "error",
+                    "message": (f"request body not received within "
+                                f"{self.read_timeout_s:g}s"),
+                }),
+                content_type="application/json",
+            ) from None
+
+    def _submit_fingerprint(self, request: web.Request) -> str:
+        """What a duplicate must MATCH beyond its idempotency key.  On a
+        ``require_signatures`` server that is the sha256 of the signature
+        header: a retry re-sends the accepted attempt's exact headers, so the
+        legitimate client matches for free, while an unauthenticated prober
+        who merely guesses the (fully predictable) submit key cannot elicit a
+        success-shaped duplicate-200 — the signature gate is preserved even on
+        the dedupe fast path.  Unsigned servers have no authentication
+        anywhere, so the fingerprint is empty there."""
+        if not self.require_signatures:
+            return ""
+        import hashlib
+
+        return hashlib.sha256(
+            request.headers.get(HEADER_SIGNATURE, "").encode()
+        ).hexdigest()
+
+    def _duplicate_submit(
+        self, client_id: str, submit_id: str | None, fingerprint: str
+    ) -> bool:
+        """True when this (idempotency key, fingerprint) pair was already
+        accepted from this client.  Callers hold ``self._lock`` for the
+        authoritative pre-buffer check; the lock-free call at handler entry is
+        an optimization (no await has happened yet in that handler, so the
+        read is race-free) that skips the body read for obvious duplicates."""
+        return (
+            submit_id is not None
+            and (submit_id, fingerprint) in self._seen_submits.get(client_id, ())
+        )
+
+    def _record_submit_locked(
+        self, client_id: str, submit_id: str | None, fingerprint: str
+    ) -> None:
+        """Remember an ACCEPTED submit's idempotency key + fingerprint (caller
+        holds the lock).  The per-client window is bounded: dedupe protects
+        against retry storms (seconds), not replay (signatures handle that)."""
+        if submit_id is None:
+            return
+        # fedlint: disable=FED005 (every mutation of _seen_submits goes through this helper, whose callers hold self._lock)
+        self._seen_submits.setdefault(
+            client_id, deque(maxlen=SUBMIT_KEY_WINDOW)
+        ).append((submit_id, fingerprint))
+
+    def _duplicate_response(self, client_id: str, kind: str) -> web.StreamResponse:
+        self._m_updates.inc(kind=kind, result="duplicate")
+        self._log.info("duplicate submit from %s folded at most once", client_id)
+        return web.json_response({
+            "status": "success",
+            "message": "duplicate submit (already accepted; folded at most once)",
+            "update_id": client_id,
+            "duplicate": True,
+        })
+
+    # ------------------------------------------------------------------
     # Handlers
     # ------------------------------------------------------------------
 
@@ -502,6 +671,22 @@ class HTTPServer:
             return web.json_response(
                 {"status": "error", "message": "no model published"}, status=503
             )
+        masked = request.headers.get(HEADER_SECAGG) == "masked"
+        # Idempotent-submit dedupe FIRST — even before the stale-round check: a
+        # retry of an ACCEPTED submit may arrive after publish_model advanced
+        # the round, and answering it 400-stale would make a topk8 client fold
+        # a delta the server already aggregated (the double-count this key
+        # exists to prevent).  Lock-free here is race-free (no await yet); the
+        # authoritative re-check runs under the buffer lock below.  The
+        # fingerprint keeps the fast path authenticated: on a signing server a
+        # duplicate only matches when it carries the ACCEPTED attempt's exact
+        # signature, so guessing the (predictable) key buys nothing.
+        submit_id = request.headers.get(HEADER_SUBMIT)
+        fingerprint = self._submit_fingerprint(request)
+        if self._duplicate_submit(client_id, submit_id, fingerprint):
+            return self._duplicate_response(
+                client_id, "masked" if masked else "plain"
+            )
         # Cheap stale-round rejection BEFORE reading/decompressing up to 100 MB; the
         # authoritative check re-runs under the lock below.
         if not self._round_acceptable(round_number):
@@ -514,21 +699,57 @@ class HTTPServer:
                 status=400,
             )
         encoding = request.headers.get(HEADER_ENCODING, "npz")
-        if request.headers.get(HEADER_SECAGG) == "masked":
-            if encoding != "npz":
-                # Masked payloads are uint32 fixed-point with their own codec; a
-                # client that ALSO asks for q8-delta is misconfigured — refuse
-                # rather than silently interpret the body one way or the other.
-                self._reject_update("bad_encoding", kind="masked")
-                return web.json_response(
-                    {"status": "error",
-                     "message": f"encoding {encoding!r} cannot combine with "
-                                "SecAgg masked payloads"},
-                    status=400,
+        if masked and encoding != "npz":
+            # Masked payloads are uint32 fixed-point with their own codec; a
+            # client that ALSO asks for q8-delta is misconfigured — refuse
+            # rather than silently interpret the body one way or the other.
+            self._reject_update("bad_encoding", kind="masked")
+            return web.json_response(
+                {"status": "error",
+                 "message": f"encoding {encoding!r} cannot combine with "
+                            "SecAgg masked payloads"},
+                status=400,
+            )
+        # Admission control: bound the submits — PLAIN AND MASKED — that
+        # concurrently hold body/decode resources.  Past the cap the answer is
+        # an IMMEDIATE 429 + Retry-After — the body is never read — so
+        # overload degrades to client backoff (exponential, jittered) instead
+        # of unbounded memory growth and event-loop starvation.  (_inflight is
+        # mutated only from the event loop with no await between check and
+        # increment.)
+        if self.max_inflight is not None and self._inflight >= self.max_inflight:
+            self._m_429.inc(endpoint="update")
+            self._reject_update("admission_reject",
+                                kind="masked" if masked else "plain")
+            return web.json_response(
+                {"status": "error",
+                 "message": (f"server at capacity ({self.max_inflight} submits "
+                             "in flight); retry after backoff")},
+                status=429,
+                headers={"Retry-After": f"{self.retry_after_s:g}"},
+            )
+        self._inflight += 1
+        try:
+            if masked:
+                return await self._handle_masked_update(
+                    request, client_id, round_number, metrics, submit_id,
+                    fingerprint,
                 )
-            return await self._handle_masked_update(request, client_id, round_number, metrics)
-        body = await request.read()
+            return await self._admitted_submit_update(
+                request, client_id, round_number, metrics, submit_id, fingerprint
+            )
+        finally:
+            self._inflight -= 1
+
+    async def _admitted_submit_update(
+        self, request: web.Request, client_id: str, round_number: int,
+        metrics: dict[str, Any], submit_id: str | None, fingerprint: str,
+    ) -> web.StreamResponse:
+        """The body of a plain-update submit AFTER admission: the caller holds
+        one in-flight slot for the duration (read + decode + verify + buffer)."""
+        body = await self._read_body(request)
         self._m_bytes_rx.inc(len(body), endpoint="update")
+        encoding = request.headers.get(HEADER_ENCODING, "npz")
         if encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
             self._reject_update("bad_encoding")
             return web.json_response(
@@ -591,6 +812,11 @@ class HTTPServer:
                 self._reject_update("bad_signature")
                 return verdict
         async with self._lock:
+            # Authoritative duplicate re-check: two concurrent attempts of the
+            # same retry storm can both pass the lock-free entry check while
+            # their bodies read; only the first to reach this lock buffers.
+            if self._duplicate_submit(client_id, submit_id, fingerprint):
+                return self._duplicate_response(client_id, "plain")
             # Stale-round rejection (parity: server.py:260-272); in async mode the
             # window may have MOVED during the decode, so the authoritative
             # re-check matters for correctness, not just races.
@@ -610,6 +836,7 @@ class HTTPServer:
                 metrics=metrics,
                 timestamp=get_current_time().isoformat(),
             )
+            self._record_submit_locked(client_id, submit_id, fingerprint)
             accepted = len(self._updates)
         self._m_updates.inc(kind="plain", result="accepted")
         self._log.info("update from %s (round %d, %d buffered)", client_id, round_number,
@@ -737,8 +964,9 @@ class HTTPServer:
             return web.json_response(
                 {"status": "error", "message": "secure aggregation not open"}, status=403
             )
+        raw = await self._read_body(request)
         try:
-            body = await request.json()
+            body = json.loads(raw)
             public_key = base64.b64decode(body["public_key"])
             num_samples = float(body["num_samples"])
             backend = str(body.get("backend", "host"))
@@ -897,7 +1125,7 @@ class HTTPServer:
                             f"{self._round}"},
                 status=400,
             )
-        body = await request.read()
+        body = await self._read_body(request)
         if self.require_signatures:
             from nanofed_tpu.security.signing import verify_secagg_body_signature
 
@@ -1047,7 +1275,7 @@ class HTTPServer:
                             f"{snapshot['round']}"},
                 status=400,
             )
-        body = await request.read()
+        body = await self._read_body(request)
         if self.require_signatures:
             from nanofed_tpu.security.signing import verify_secagg_body_signature
 
@@ -1096,7 +1324,8 @@ class HTTPServer:
 
     async def _handle_masked_update(
         self, request: web.Request, client_id: str, round_number: int,
-        metrics: dict[str, Any],
+        metrics: dict[str, Any], submit_id: str | None = None,
+        fingerprint: str = "",
     ) -> web.StreamResponse:
         """Buffer a pairwise-masked uint32 vector (flagged via ``HEADER_SECAGG``).
 
@@ -1125,7 +1354,7 @@ class HTTPServer:
                 {"status": "error",
                  "message": f"{client_id!r} was evicted from this cohort"}, status=403
             )
-        body = await request.read()
+        body = await self._read_body(request)
         self._m_bytes_rx.inc(len(body), endpoint="update")
         if self.require_signatures:
             from nanofed_tpu.security.signing import verify_masked_signature
@@ -1153,6 +1382,8 @@ class HTTPServer:
                 {"status": "error", "message": f"bad masked payload: {e}"}, status=400
             )
         async with self._lock:
+            if self._duplicate_submit(client_id, submit_id, fingerprint):
+                return self._duplicate_response(client_id, "masked")
             if round_number != self._round:
                 self._reject_update("stale_round", kind="masked")
                 return web.json_response(
@@ -1161,6 +1392,7 @@ class HTTPServer:
                     status=400,
                 )
             self._masked_updates[client_id] = (masked, metrics)
+            self._record_submit_locked(client_id, submit_id, fingerprint)
             accepted = len(self._masked_updates)
         self._m_updates.inc(kind="masked", result="accepted")
         self._log.info("masked update from %s (round %d, %d buffered)", client_id,

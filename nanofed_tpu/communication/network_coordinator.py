@@ -33,6 +33,7 @@ from nanofed_tpu.aggregation.robust import (
 )
 from nanofed_tpu.communication.http_server import HTTPServer
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, ModelUpdate, Params
+from nanofed_tpu.faults.plan import InjectedServerCrash
 from nanofed_tpu.observability.registry import MetricsRegistry
 from nanofed_tpu.observability.spans import SpanTracer
 from nanofed_tpu.observability.telemetry import RunTelemetry
@@ -45,12 +46,14 @@ from nanofed_tpu.security.validation import (
     validate_range,
     validate_shape,
 )
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from nanofed_tpu.utils.logger import Logger
 
 if TYPE_CHECKING:
     # Imported lazily at runtime: secure_agg needs the ``cryptography`` package,
     # which the plain (non-secure) network path must not require just to import
     # this module.
+    from nanofed_tpu.persistence.state_store import FileStateStore
     from nanofed_tpu.security.secure_agg import SecureAggregationConfig
 
 
@@ -70,6 +73,15 @@ class NetworkRoundConfig:
     # derived from who actually enrolled (> n/2; see run()).
     max_clients: int | None = None
     enrollment_grace_s: float = 1.0
+    # Straggler eviction (sync, non-secure rounds): a client that has been seen
+    # before but misses this many CONSECUTIVE rounds is evicted from the
+    # expected population, and the round barrier degrades gracefully —
+    # ``required`` is recomputed as ceil((min_clients - evicted) *
+    # min_completion_rate) — so one dead client stops costing every later
+    # round a full timeout.  0 disables (the pre-PR-6 behavior).  Evicted
+    # clients' submits are still ACCEPTED if they return (eviction shrinks the
+    # barrier, it is not a ban); a returning client rejoins the expected set.
+    straggler_evict_after: int = 0
     # Asynchronous buffered aggregation (FedBuff, Nguyen et al. 2022): aggregate as
     # soon as async_buffer_k updates are buffered instead of waiting for a
     # synchronized cohort; updates based on any of the last staleness_window
@@ -230,6 +242,9 @@ class NetworkCoordinator:
         robust: RobustAggregationConfig | None = None,
         telemetry_dir: str | Path | None = None,
         registry: MetricsRegistry | None = None,
+        state_store: "FileStateStore | None" = None,
+        chaos: Any | None = None,
+        clock: Clock | None = None,
     ):
         """``robust`` (a ``RobustAggregationConfig``) swaps the weighted FedAvg of
         drained updates for the coordinate-wise trimmed mean — the network path is
@@ -242,7 +257,27 @@ class NetworkCoordinator:
         phase spans and outcome stream into ``<telemetry_dir>/telemetry.jsonl``
         (plus a final registry snapshot on ``run()`` exit).  Round metrics and span
         durations always flow into ``registry`` (default: the server's, so one
-        ``GET /metrics`` scrape covers the wire counters AND the round engine)."""
+        ``GET /metrics`` scrape covers the wire counters AND the round engine).
+
+        ``state_store`` (a ``persistence.FileStateStore``) makes the engine
+        crash-recoverable: every COMPLETED round/aggregation checkpoints the
+        global params + engine state (off the event loop, via
+        ``asyncio.to_thread``), and a coordinator CONSTRUCTED over a non-empty
+        store resumes from the latest checkpoint — params, round number, and
+        the straggler-eviction set all restore, so a server kill-restart
+        re-publishes the last completed round's model and continues.  Clients
+        re-sync through their normal loop: fetches retry until the new server
+        answers, and in-flight submits for torn rounds land on the stale-round
+        400 path (or dedupe, for retries of already-accepted submits).
+
+        ``chaos`` (a ``nanofed_tpu.faults.ChaosSchedule``) injects round-loop
+        faults: a planned ``server_kill`` raises ``InjectedServerCrash``
+        mid-round (after publish, before aggregation), which
+        ``persistence.is_recoverable`` classifies as recoverable — the chaos
+        harness rebuilds server + coordinator from ``state_store`` exactly as
+        an operator's process supervisor would.  ``clock`` injects the time
+        source for every deadline and poll sleep (tests pass a
+        ``VirtualClock`` so timeout behavior is load-independent)."""
         if robust is not None and secure is not None:
             raise ValueError(
                 "robust= cannot be combined with secure=: the server only ever "
@@ -284,8 +319,37 @@ class NetworkCoordinator:
         self.validation = validation
         self.secure = secure
         self.robust = robust
+        self.state_store = state_store
+        self.chaos = chaos
         self.history: list[dict[str, Any]] = []
+        self._clock = clock or SYSTEM_CLOCK
         self._log = Logger()
+        # Straggler accounting (sync rounds): consecutive missed rounds per
+        # ever-seen client, and the evicted set the round barrier excludes.
+        self._known_clients: set[str] = set()
+        self._absence: dict[str, int] = {}
+        self._evicted_stragglers: set[str] = set()
+        # Crash recovery: resume from the latest COMPLETED checkpoint.  The
+        # restored round number is where the CRASHED run got to; this engine
+        # starts at the round after it, publishing the restored params.
+        self.start_round = 0
+        if state_store is not None:
+            restored = state_store.restore_latest()
+            if restored is not None:
+                self.params = restored.params
+                self.start_round = restored.round_number + 1
+                engine_state = restored.server_state or {}
+                if isinstance(engine_state, dict):
+                    self._evicted_stragglers = set(
+                        engine_state.get("evicted_stragglers", ())
+                    )
+                    self._known_clients = set(self._evicted_stragglers)
+                self._log.info(
+                    "resumed from checkpoint: round %d (restarting at %d, "
+                    "%d evicted stragglers restored)",
+                    restored.round_number, self.start_round,
+                    len(self._evicted_stragglers),
+                )
         self.metrics_registry = registry or server.metrics_registry
         self.telemetry = (
             RunTelemetry(telemetry_dir, registry=self.metrics_registry)
@@ -312,16 +376,77 @@ class NetworkCoordinator:
             "nanofed_validation_rejections_total",
             "Drained updates rejected by host-path validation",
         )
+        self._m_straggler_evictions = self.metrics_registry.counter(
+            "nanofed_straggler_evictions_total",
+            "Clients evicted from the sync round barrier after consecutive misses",
+        )
 
     async def _wait_for_clients(self, required: int) -> bool:
         """Poll the update buffer until ``required`` updates arrive or timeout
         (parity: ``coordinator.py:205-245``)."""
-        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
-        while asyncio.get_event_loop().time() < deadline:
+        deadline = self._clock.time() + self.config.round_timeout_s
+        while self._clock.time() < deadline:
             if self.server.num_updates() >= required:
                 return True
-            await asyncio.sleep(self.config.poll_interval_s)
+            await self._clock.sleep(self.config.poll_interval_s)
         return self.server.num_updates() >= required
+
+    def _required_clients(self) -> int:
+        """This round's barrier: completion-rate over the LIVE expected
+        population (min_clients minus evicted stragglers) — graceful
+        degradation, so a permanently-dead client costs ``straggler_evict_after``
+        timed-out rounds and then stops failing the federation."""
+        expected = max(1, self.config.min_clients - len(self._evicted_stragglers))
+        return max(1, math.ceil(expected * self.config.min_completion_rate))
+
+    def _note_participation(self, reported: set[str]) -> list[str]:
+        """Track per-client absences after a sync round's drain; returns the
+        clients newly evicted this round.  Only ever-seen clients accrue
+        absence (an expected-but-never-connected population is a configuration
+        problem the timeout already surfaces), and a returning evictee rejoins
+        the expected set — eviction shrinks the barrier, it is not a ban."""
+        if self.config.straggler_evict_after <= 0:
+            return []
+        returned = reported & self._evicted_stragglers
+        if returned:
+            self._log.info("stragglers returned, rejoining the barrier: %s",
+                           sorted(returned))
+            self._evicted_stragglers -= returned
+        self._known_clients |= reported
+        newly_evicted: list[str] = []
+        for cid in reported:
+            self._absence[cid] = 0
+        for cid in sorted(self._known_clients - reported - self._evicted_stragglers):
+            self._absence[cid] = self._absence.get(cid, 0) + 1
+            if self._absence[cid] >= self.config.straggler_evict_after:
+                self._evicted_stragglers.add(cid)
+                newly_evicted.append(cid)
+        if newly_evicted:
+            self._m_straggler_evictions.inc(len(newly_evicted))
+            self._log.warning(
+                "evicting stragglers after %d consecutive missed rounds: %s "
+                "(barrier degrades to %d required)",
+                self.config.straggler_evict_after, newly_evicted,
+                self._required_clients(),
+            )
+        return newly_evicted
+
+    async def _checkpoint_round(
+        self, round_number: int, record: dict[str, Any]
+    ) -> None:
+        """Persist a COMPLETED round's state (params + engine state) off the
+        event loop.  This is the recovery point a restarted coordinator
+        resumes from; FAILED rounds are not checkpointed (the params did not
+        change, and restore_latest skips non-COMPLETED checkpoints anyway)."""
+        if self.state_store is None or record.get("status") != "COMPLETED":
+            return
+        await asyncio.to_thread(
+            self.state_store.checkpoint,
+            round_number,
+            self.params,
+            {"evicted_stragglers": sorted(self._evicted_stragglers)},
+            {k: v for k, v in (record.get("metrics") or {}).items()},
+        )
 
     def _validate_updates(self, updates: list[ModelUpdate]) -> list[ModelUpdate]:
         """Drop invalid updates (wrong shape / non-finite / norm cap / cohort anomaly)
@@ -389,12 +514,12 @@ class NetworkCoordinator:
                                  "client active cohort (unsatisfiable)")}
             self.history.append(record)
             return record
-        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        deadline = self._clock.time() + self.config.round_timeout_s
         while (
             self.server.num_masked_updates() < expected
-            and asyncio.get_event_loop().time() < deadline
+            and self._clock.time() < deadline
         ):
-            await asyncio.sleep(self.config.poll_interval_s)
+            await self._clock.sleep(self.config.poll_interval_s)
         masked = await self.server.drain_masked_updates()
         survivors = [c for c in cohort if c in masked]
         dropped = [c for c in cohort if c not in masked]
@@ -447,12 +572,12 @@ class NetworkCoordinator:
         # Unmask round: even with zero dropouts the survivors' SELF masks must be
         # removed, so this phase always runs in tolerant mode.
         await self.server.open_unmask(round_number, dropped_after_shares, survivors)
-        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        deadline = self._clock.time() + self.config.round_timeout_s
         while (
             self.server.num_unmask_reveals() < len(survivors)
-            and asyncio.get_event_loop().time() < deadline
+            and self._clock.time() < deadline
         ):
-            await asyncio.sleep(self.config.poll_interval_s)
+            await self._clock.sleep(self.config.poll_interval_s)
         reveals = await self.server.drain_unmask_reveals()
         if len(reveals) < threshold:
             # The non-submitters are known dead either way; shed them so the next
@@ -511,12 +636,12 @@ class NetworkCoordinator:
             return await self._tolerant_secure_round(round_number, required)
         cohort = self.server.secagg_client_order()
         expected = len(cohort)
-        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
+        deadline = self._clock.time() + self.config.round_timeout_s
         while (
             self.server.num_masked_updates() < expected
-            and asyncio.get_event_loop().time() < deadline
+            and self._clock.time() < deadline
         ):
-            await asyncio.sleep(self.config.poll_interval_s)
+            await self._clock.sleep(self.config.poll_interval_s)
         masked = await self.server.drain_masked_updates()
         if len(masked) < expected or expected < required:
             # Any missing cohort member leaves uncancelled pairwise masks in the sum.
@@ -560,12 +685,22 @@ class NetworkCoordinator:
         self._m_cohort.set(record.get("num_clients", 0))
         if self.telemetry is not None:
             self.telemetry.record("round", duration_s=round(duration, 6), **record)
+        await self._checkpoint_round(round_number, record)
         return record
 
     async def _train_round_inner(self, round_number: int) -> dict[str, Any]:
         with self._tracer.span("publish", round=round_number):
             await self.server.publish_model(self.params, round_number)
-        required = max(1, math.ceil(self.config.min_clients * self.config.min_completion_rate))
+        if self.chaos is not None and self.chaos.take_server_kill(round_number):
+            # Mid-round crash: the model for this round IS published (clients
+            # may have fetched, trained, submitted) but aggregation never
+            # happens.  Recovery: rebuild from the state store; this round
+            # re-runs from scratch on the restored params.
+            raise InjectedServerCrash(
+                f"chaos plan (seed {getattr(self.chaos.plan, 'seed', '?')}): "
+                f"server killed mid-round {round_number}"
+            )
+        required = self._required_clients()
         if self.secure is not None:
             with self._tracer.span("secure-aggregate", round=round_number):
                 return await self._secure_round(round_number, required)
@@ -579,18 +714,25 @@ class NetworkCoordinator:
             num_rejected = num_received - len(updates)
             if num_rejected:
                 self._m_validation_rejects.inc(num_rejected)
+        newly_evicted = self._note_participation({u.client_id for u in updates})
         if not ok or len(updates) < required:
             self._log.warning(
                 "round %d FAILED: %d/%d updates (%d rejected)",
                 round_number, len(updates), required, num_rejected,
             )
             record = {"round": round_number, "status": "FAILED",
-                      "num_clients": len(updates), "num_rejected": num_rejected}
+                      "num_clients": len(updates), "num_rejected": num_rejected,
+                      "required": required}
+            if newly_evicted:
+                record["evicted_stragglers"] = newly_evicted
             self.history.append(record)
             return record
         with self._tracer.span("aggregate", round=round_number,
                                num_clients=len(updates)):
             record = self._aggregate_round(round_number, updates, num_rejected)
+        record["required"] = required
+        if newly_evicted:
+            record["evicted_stragglers"] = newly_evicted
         if record["status"] == "COMPLETED":
             self._log.info("round %d: %s", round_number, record["metrics"])
         self.history.append(record)
@@ -650,12 +792,12 @@ class NetworkCoordinator:
     async def _wait_for_buffer(self, k: int) -> int:
         """Async mode: poll until >= k updates are buffered or the timeout expires;
         returns the buffered count at exit."""
-        deadline = asyncio.get_event_loop().time() + self.config.round_timeout_s
-        while asyncio.get_event_loop().time() < deadline:
+        deadline = self._clock.time() + self.config.round_timeout_s
+        while self._clock.time() < deadline:
             n = self.server.num_updates()
             if n >= k:
                 return n
-            await asyncio.sleep(self.config.poll_interval_s)
+            await self._clock.sleep(self.config.poll_interval_s)
         return self.server.num_updates()
 
     async def _run_async(self) -> list[dict[str, Any]]:
@@ -671,8 +813,11 @@ class NetworkCoordinator:
         compressed-delta reconstruction use, so the three can never disagree.
         """
         k = self.config.async_buffer_k
-        version = 0
-        for agg_i in range(self.config.num_rounds):
+        # Crash recovery: resume at the checkpointed VERSION (checkpoints are
+        # written per completed aggregation, keyed by the version they
+        # produced); already-spent aggregations stay spent.
+        version = self.start_round
+        for agg_i in range(self.start_round, self.config.num_rounds):
             t0 = time.perf_counter()
             with self._tracer.span("round", aggregation=agg_i, version=version):
                 with self._tracer.span("publish", aggregation=agg_i):
@@ -725,6 +870,10 @@ class NetworkCoordinator:
                     "round", duration_s=round(duration, 6),
                     **{key: v for key, v in record.items() if key != "discounts"},
                 )
+            if record["status"] == "COMPLETED":
+                # Keyed by the PRODUCED version: a resumed engine starts its
+                # next aggregation from exactly this model.
+                await self._checkpoint_round(version - 1, record)
         await self.server.publish_model(self.params, version)
         self.server.stop_training()
         return self.history
@@ -750,7 +899,6 @@ class NetworkCoordinator:
         if self.config.async_buffer_k is not None:
             return await self._run_async()
         if self.secure is not None:
-            loop = asyncio.get_event_loop()
             tolerant = self.secure.dropout_tolerant
             if tolerant:
                 # min_clients is a true MINIMUM here: the Shamir threshold must
@@ -769,12 +917,12 @@ class NetworkCoordinator:
                 )
             else:
                 await self.server.open_secagg(self.config.min_clients)
-            deadline = loop.time() + self.config.round_timeout_s
+            deadline = self._clock.time() + self.config.round_timeout_s
             while (
                 self.server.secagg_enrolled() < self.config.min_clients
-                and loop.time() < deadline
+                and self._clock.time() < deadline
             ):
-                await asyncio.sleep(self.config.poll_interval_s)
+                await self._clock.sleep(self.config.poll_interval_s)
             if self.server.secagg_enrolled() < self.config.min_clients:
                 self.server.stop_training()
                 raise TimeoutError(
@@ -784,16 +932,16 @@ class NetworkCoordinator:
                 if not self.server.secagg_roster_complete():
                     # Straggler window: admit whoever else shows up until the
                     # roster has been quiet for the grace period, then freeze.
-                    last_n, last_t = self.server.secagg_enrolled(), loop.time()
-                    while loop.time() < deadline:
+                    last_n, last_t = self.server.secagg_enrolled(), self._clock.time()
+                    while self._clock.time() < deadline:
                         n = self.server.secagg_enrolled()
                         if n != last_n:
-                            last_n, last_t = n, loop.time()
-                        elif loop.time() - last_t >= self.config.enrollment_grace_s:
+                            last_n, last_t = n, self._clock.time()
+                        elif self._clock.time() - last_t >= self.config.enrollment_grace_s:
                             break
                         if self.server.secagg_roster_complete():
                             break  # max_clients froze it implicitly
-                        await asyncio.sleep(self.config.poll_interval_s)
+                        await self._clock.sleep(self.config.poll_interval_s)
                 # Idempotent: a no-op when max_clients already froze the roster —
                 # the validation below must run on BOTH freeze paths.
                 n = await self.server.close_secagg()
@@ -816,7 +964,9 @@ class NetworkCoordinator:
             # (Dropout-tolerant share distribution is PER-ROUND — fresh ephemeral
             # secrets every round, see _tolerant_secure_round — so there is no
             # enrollment-time share barrier.)
-        for r in range(self.config.num_rounds):
+        # start_round > 0 after a state-store resume: completed rounds are not
+        # re-run, the restored params are simply re-published at the next one.
+        for r in range(self.start_round, self.config.num_rounds):
             await self.train_round(r)
         self.server.stop_training()
         return self.history

@@ -1,0 +1,39 @@
+"""Fault injection + resilience harness (PR 6's chaos subsystem).
+
+Deterministic, seeded failure schedules (:class:`FaultPlan` /
+:class:`ChaosSchedule`) injectable at three boundaries — the HTTP client
+(:class:`ChaosClient`), the HTTP server wire (``HTTPServer(chaos=...)``
+middleware), and the round loop (``NetworkCoordinator(chaos=...)`` raising
+:class:`InjectedServerCrash`) — plus the production mechanisms they exercise:
+client ``RetryPolicy`` backoff (``communication.retry``), server admission
+control (429 + Retry-After), idempotent submit keys, straggler eviction, and
+state-store crash recovery.  See docs/robustness.md.
+
+``plan`` is pure stdlib; ``injector`` needs the ``[net]`` extra (aiohttp) and
+is imported lazily.
+"""
+
+from nanofed_tpu.faults.plan import (
+    FAULT_KINDS,
+    ChaosSchedule,
+    FaultEvent,
+    FaultPlan,
+    InjectedServerCrash,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "ChaosClient",
+    "ChaosSchedule",
+    "FaultEvent",
+    "FaultPlan",
+    "InjectedServerCrash",
+]
+
+
+def __getattr__(name: str):
+    if name == "ChaosClient":
+        from nanofed_tpu.faults.injector import ChaosClient
+
+        return ChaosClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,332 @@
+"""Deterministic, seeded fault plans for the federation's failure modes.
+
+ROADMAP items 1, 2, and 4c all make scale claims that presuppose failures —
+flaky clients, overloaded servers, mid-round crashes — yet nothing in the repo
+could *inject* one reproducibly.  This module is the missing half of every
+robustness claim: a :class:`FaultPlan` is a frozen, JSON-serializable list of
+fault events, either hand-written or drawn from a seed
+(:meth:`FaultPlan.generate`), and a :class:`ChaosSchedule` is its consumable
+runtime view — injection sites ask it "does a fault fire HERE, for THIS client,
+in THIS round?" and every firing is counted in the metrics registry
+(``nanofed_faults_injected_total{kind=...}``), so a chaos run's telemetry shows
+exactly which failures it survived.
+
+Fault kinds and their injection sites:
+
+==============  ============================================================
+kind            where it fires
+==============  ============================================================
+``crash``       scripted client loop / simulator cohort: the client stops
+                participating from ``round`` on (``ChaosSchedule.crashed``)
+``delay``       client boundary: ``seconds`` of extra latency before the
+                round's submit (a straggler)
+``skew``        client boundary: the submit's round header is shifted back by
+                ``int(seconds)`` rounds — a clock-skewed straggler that
+                exercises the server's stale-round 400 path
+``corrupt``     client wire boundary: the submit body is bit-flipped in
+                flight (``HTTPClient(wire_filter=...)``), exercising the
+                server's bad-payload rejection
+``duplicate``   client wire boundary: the last update is re-POSTed ``count``
+                extra times with the SAME idempotency key (a retry storm),
+                exercising the server's exactly-once dedupe
+``drop``        server wire boundary (``HTTPServer(chaos=...)`` middleware):
+                the connection is severed BEFORE the handler runs — the
+                submit never happened; the client's RetryPolicy re-sends
+``ack_drop``    server wire boundary: the handler runs (the update IS
+                buffered) and the connection is severed before the response —
+                the lost-ACK case idempotent submit keys exist for
+``server_kill`` the ``NetworkCoordinator`` round loop: raises
+                :class:`InjectedServerCrash` mid-round; recovery is the
+                ``persistence.state_store`` resume path
+==============  ============================================================
+
+Pure stdlib — importable by anything (the communication layer takes a schedule
+duck-typed, so no import cycle).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Any, Iterable
+
+__all__ = [
+    "FAULT_KINDS",
+    "ChaosSchedule",
+    "FaultEvent",
+    "FaultPlan",
+    "InjectedServerCrash",
+]
+
+FAULT_KINDS = (
+    "crash", "delay", "skew", "corrupt", "duplicate", "drop", "ack_drop",
+    "server_kill",
+)
+
+#: Kinds the server-side wire middleware handles (everything else is a client-
+#: boundary or round-loop fault).
+WIRE_KINDS = ("drop", "ack_drop", "delay")
+
+
+class InjectedServerCrash(RuntimeError):
+    """A ``server_kill`` fault firing in the round loop.
+
+    Subclasses ``RuntimeError`` so ``persistence.state_store.is_recoverable``
+    treats it exactly like a real crash: ``run_fault_tolerant`` (or the chaos
+    harness) rebuilds the server + coordinator from the state store and the
+    run resumes at the checkpointed round.
+    """
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One fault: ``kind`` fires against ``client`` in ``round``.
+
+    ``seconds`` parameterizes ``delay`` (latency) and ``skew`` (rounds of
+    header skew, as an int); ``count`` is how many times a one-shot wire fault
+    fires (``drop``/``ack_drop``) or how many extra duplicates are sent.
+    ``client`` is None for ``server_kill``.  Simulator clients are ints,
+    network clients strings — both are stored as given and compared as given.
+    """
+
+    kind: str
+    round: int
+    client: str | int | None = None
+    seconds: float = 0.0
+    count: int = 1
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (choose from {FAULT_KINDS})")
+        if self.round < 0:
+            raise ValueError("round must be >= 0")
+        if self.count < 1:
+            raise ValueError("count must be >= 1")
+        if self.seconds < 0:
+            raise ValueError("seconds must be >= 0")
+        if self.kind == "server_kill" and self.client is not None:
+            raise ValueError("server_kill is not a per-client fault")
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"kind": self.kind, "round": self.round}
+        if self.client is not None:
+            d["client"] = self.client
+        if self.seconds:
+            d["seconds"] = self.seconds
+        if self.count != 1:
+            d["count"] = self.count
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FaultEvent":
+        return cls(
+            kind=str(d["kind"]),
+            round=int(d["round"]),
+            client=d.get("client"),
+            seconds=float(d.get("seconds", 0.0)),
+            count=int(d.get("count", 1)),
+        )
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A frozen, seeded, JSON-serializable fault schedule.
+
+    The ``seed`` is carried even for hand-written plans so the run artifact
+    records which schedule produced it; :meth:`generate` draws a plan FROM the
+    seed, making "round completes despite f crashes" a reproducible claim
+    rather than a lucky run.
+    """
+
+    seed: int = 0
+    events: tuple[FaultEvent, ...] = field(default_factory=tuple)
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "events", tuple(self.events))
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        clients: Iterable[str | int],
+        num_rounds: int,
+        *,
+        crash_fraction: float = 0.0,
+        straggler_fraction: float = 0.0,
+        straggler_delay_s: float = 1.0,
+        drop_fraction: float = 0.0,
+        duplicate_fraction: float = 0.0,
+        corrupt_fraction: float = 0.0,
+        server_kill_round: int | None = None,
+    ) -> "FaultPlan":
+        """Draw a plan from ``seed``: each ``*_fraction`` of the client
+        population is assigned that fault at a seeded round.  Crashes land in
+        the first half of the run (so the survival claim covers most rounds);
+        wire faults are spread uniformly.  Deterministic: the same arguments
+        always yield the same plan."""
+        rng = random.Random(seed)
+        pool = list(clients)
+        events: list[FaultEvent] = []
+
+        def pick(fraction: float) -> list[str | int]:
+            k = round(fraction * len(pool))
+            return rng.sample(pool, k) if k else []
+
+        for cid in pick(crash_fraction):
+            events.append(FaultEvent(
+                kind="crash", round=rng.randrange(max(1, num_rounds // 2)),
+                client=cid,
+            ))
+        for cid in pick(straggler_fraction):
+            events.append(FaultEvent(
+                kind="delay", round=rng.randrange(num_rounds), client=cid,
+                seconds=straggler_delay_s,
+            ))
+        for kind, fraction in (("drop", drop_fraction),
+                               ("duplicate", duplicate_fraction),
+                               ("corrupt", corrupt_fraction)):
+            for cid in pick(fraction):
+                events.append(FaultEvent(
+                    kind=kind, round=rng.randrange(num_rounds), client=cid,
+                ))
+        if server_kill_round is not None:
+            events.append(FaultEvent(kind="server_kill", round=server_kill_round))
+        events.sort(key=lambda e: (e.round, e.kind, str(e.client)))
+        return cls(seed=seed, events=tuple(events))
+
+    # -- serialization ---------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "events": [e.to_dict() for e in self.events]},
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(
+            seed=int(d.get("seed", 0)),
+            events=tuple(FaultEvent.from_dict(e) for e in d.get("events", [])),
+        )
+
+    @classmethod
+    def load(cls, path: str | Path) -> "FaultPlan":
+        return cls.from_json(Path(path).read_text())
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_json())
+
+    def with_events(self, *events: FaultEvent) -> "FaultPlan":
+        return replace(self, events=(*self.events, *events))
+
+
+class ChaosSchedule:
+    """The consumable runtime view of a :class:`FaultPlan`.
+
+    Injection sites query it; one-shot events (``drop``/``ack_drop``/
+    ``duplicate``/``server_kill``) are CONSUMED as they fire, so a retried
+    request meets the fault ``count`` times and then passes — which is exactly
+    the semantics a retry policy must be proven against.  Every firing
+    increments ``nanofed_faults_injected_total{kind=...}`` in the given
+    registry (default: the process-wide one), so ``/metrics`` and
+    ``telemetry.jsonl`` show which faults a run actually absorbed.
+
+    Single-event-loop use only (like everything in ``communication``): no
+    internal locking.
+    """
+
+    def __init__(self, plan: FaultPlan, registry: Any | None = None) -> None:
+        from nanofed_tpu.observability.registry import get_registry
+
+        self.plan = plan
+        self._fired: dict[int, int] = {}  # event index -> times fired
+        self._m_faults = (registry or get_registry()).counter(
+            "nanofed_faults_injected_total",
+            "Chaos-schedule faults actually fired, by kind",
+            labels=("kind",),
+        )
+
+    def _take(self, index: int, event: FaultEvent) -> bool:
+        """Consume one firing of a counted event; False once exhausted."""
+        fired = self._fired.get(index, 0)
+        if fired >= event.count:
+            return False
+        self._fired[index] = fired + 1
+        self._m_faults.inc(kind=event.kind)
+        return True
+
+    # -- client-boundary queries -----------------------------------------
+
+    def crashed(self, client: str | int, round_number: int) -> bool:
+        """True when the plan crashed ``client`` at or before this round
+        (crashes are permanent: a crashed client never reports again)."""
+        for i, e in enumerate(self.plan.events):
+            if e.kind == "crash" and e.client == client and e.round <= round_number:
+                if self._fired.get(i, 0) == 0:
+                    self._fired[i] = 1
+                    self._m_faults.inc(kind="crash")
+                return True
+        return False
+
+    def client_events(self, client: str | int, round_number: int) -> list[FaultEvent]:
+        """The client-boundary faults (delay/skew/corrupt/duplicate) firing for
+        this client's submit this round.  Each event applies to ONE logical
+        submit and is consumed on return (a ``duplicate`` event's ``count`` is
+        how many duplicates that submit sends, not how many submits it
+        haunts)."""
+        out = []
+        for i, e in enumerate(self.plan.events):
+            if e.client != client or e.round != round_number:
+                continue
+            if e.kind not in ("delay", "skew", "corrupt", "duplicate"):
+                continue
+            if self._fired.get(i, 0) == 0:
+                self._fired[i] = 1
+                self._m_faults.inc(kind=e.kind)
+                out.append(e)
+        return out
+
+    # -- server-boundary queries -----------------------------------------
+
+    def wire_fault(
+        self, client: str | None, round_header: str | None
+    ) -> FaultEvent | None:
+        """The wire fault (drop/ack_drop/delay-at-server) to apply to THIS
+        request, or None.  One-shot kinds are consumed per firing: a dropped
+        request's retry gets through once ``count`` attempts have been
+        severed."""
+        if client is None:
+            return None
+        try:
+            rnd = int(round_header) if round_header is not None else None
+        except ValueError:
+            rnd = None
+        for i, e in enumerate(self.plan.events):
+            if e.kind not in WIRE_KINDS or e.client != client:
+                continue
+            if rnd is not None and e.round != rnd:
+                continue
+            if self._take(i, e):
+                return e
+        return None
+
+    # -- round-loop queries ----------------------------------------------
+
+    def take_server_kill(self, round_number: int) -> bool:
+        """True exactly once when the plan kills the server in this round."""
+        for i, e in enumerate(self.plan.events):
+            if e.kind == "server_kill" and e.round == round_number:
+                if self._take(i, e):
+                    return True
+        return False
+
+    def counts(self) -> dict[str, int]:
+        """Fired-fault totals by kind (for run records / assertions)."""
+        out: dict[str, int] = {}
+        for i, n in self._fired.items():
+            kind = self.plan.events[i].kind
+            out[kind] = out.get(kind, 0) + n
+        return out

@@ -170,11 +170,18 @@ class Coordinator:
         on_round_end: Callable[[RoundMetrics], None] | None = None,
         telemetry_dir: str | Path | None = None,
         strict: bool = False,
+        chaos=None,
     ) -> None:
         self.model = model
         self.config = config
         self.training = training or TrainingConfig()
         self.strategy = strategy or fedavg_strategy()
+        # Fault injection (nanofed_tpu.faults.ChaosSchedule): planned per-client
+        # crashes are applied to every sampled cohort — the in-process analogue
+        # of a network client going silent — exercising the same completion-rate
+        # gating real dropouts hit.  Deterministic under the plan's seed, unlike
+        # config.dropout_rate's per-round coin flips.
+        self._chaos = chaos
         # mesh_shape=(n_client_shards, n_model_shards) builds the 2-D clients x
         # model mesh (FSDP-style parameter sharding — see parallel.mesh); an
         # explicit mesh= wins and must not be combined with it.
@@ -908,6 +915,14 @@ class Coordinator:
         if self.config.dropout_rate > 0:
             keep = host_rng.random(len(sampled)) >= self.config.dropout_rate
             sampled = sampled[keep]
+        if self._chaos is not None:
+            # Planned crashes (faults.ChaosSchedule): a crashed client is gone
+            # from this and every later cohort, deterministically — the round
+            # then stands or falls on min_completion_rate exactly like a real
+            # dropout wave.
+            alive = [c for c in sampled
+                     if not self._chaos.crashed(int(c), round_id)]
+            sampled = np.asarray(alive, dtype=sampled.dtype)
         return sampled
 
     # ------------------------------------------------------------------

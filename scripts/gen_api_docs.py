@@ -51,7 +51,10 @@ MODULES = [
     ("communication", ["nanofed_tpu.communication.codec",
                        "nanofed_tpu.communication.http_server",
                        "nanofed_tpu.communication.http_client",
+                       "nanofed_tpu.communication.retry",
                        "nanofed_tpu.communication.network_coordinator"]),
+    ("faults", ["nanofed_tpu.faults.plan",
+                "nanofed_tpu.faults.injector"]),
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry",
@@ -62,7 +65,7 @@ MODULES = [
              "nanofed_tpu.ops.quantize"]),
     ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.profiling",
                "nanofed_tpu.utils.trees", "nanofed_tpu.utils.platform",
-               "nanofed_tpu.utils.dates"]),
+               "nanofed_tpu.utils.clock", "nanofed_tpu.utils.dates"]),
     ("top-level", ["nanofed_tpu.experiments", "nanofed_tpu.benchmarks",
                    "nanofed_tpu.cli"]),
 ]

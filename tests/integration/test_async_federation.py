@@ -220,14 +220,17 @@ def test_heterogeneous_speed_federation_end_to_end(devices):
     federation completes all aggregations without ever waiting for the slowest
     cohort, stale updates appear (and are discounted), and the model learns.
 
-    Deflaked for the 1-core CI host (CHANGES PR 4: fails under CPU contention on
-    seed code too): timeouts are wide enough to survive a contended core, and the
-    one TIMING-dependent assertion — that version overlap produced a stale update
-    — is gated behind a load check.  The functional assertions (all aggregations
-    complete, loss falls, params move) hold unconditionally."""
-    import os
-
+    Deflaked PROPERLY (ISSUE 6 satellite; history: PR 4 widened timeouts, PR 5
+    gated the staleness assertion on a load-average check): every wait —
+    client "compute speed" delays, coordinator deadlines, poll intervals —
+    now rides an injectable ``VirtualClock``, so the slow clients are slow BY
+    CONSTRUCTION (virtual deadline order) and not by hoping the CI core is
+    contended the right amount.  c3's 0.15 s delay overlapping the first
+    version publishes is an ordering guarantee, so the staleness assertion is
+    UNCONDITIONAL — no load gate — and host contention can neither starve it
+    nor expire a round timeout."""
     from nanofed_tpu.data import federate, synthetic_classification
+    from nanofed_tpu.utils.clock import VirtualClock
 
     model = get_model("mlp", in_features=8, hidden=16, num_classes=3)
     ds = synthetic_classification(512, 3, (8,), seed=0)
@@ -239,33 +242,34 @@ def test_heterogeneous_speed_federation_end_to_end(devices):
     ))
     params = model.init(jax.random.key(0))
     port = PORT + 4
+    clock = VirtualClock()
     delays = {"c0": 0.0, "c1": 0.01, "c2": 0.05, "c3": 0.15}
 
     async def client(cid, idx):
         data = jax.tree.map(lambda a: jnp.asarray(a[idx]), cd)
-        async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=60) as c:
+        async with HTTPClient(f"http://127.0.0.1:{port}", cid, timeout_s=60,
+                              clock=clock) as c:
             while True:
                 fetched, rnd, active = await c.fetch_global_model(like=params)
                 if not active:
                     return
                 result = fit(jax.tree.map(jnp.asarray, fetched), data,
                              jax.random.key(idx))
-                await asyncio.sleep(delays[cid])  # heterogeneous compute speed
+                await clock.sleep(delays[cid])  # heterogeneous compute speed
                 await c.submit_update(
                     result.params,
                     {"loss": float(result.metrics.loss), "num_samples": 128.0},
                 )
-                await asyncio.sleep(0.005)
+                await clock.sleep(0.005)
 
     async def main():
-        server = HTTPServer(port=port)
+        server = HTTPServer(port=port, clock=clock)
         coord = NetworkCoordinator(
             server, params,
-            # round_timeout_s sized for a CONTENDED 1-core host: 6 aggregations
-            # of jitted sub-second fits fit in seconds on a quiet core, but any
-            # concurrent process can stretch one wait past a tight timeout.
+            # Virtual seconds: expire by schedule, never by host contention.
             NetworkRoundConfig(num_rounds=6, async_buffer_k=2, staleness_window=4,
-                               round_timeout_s=60.0, poll_interval_s=0.005),
+                               round_timeout_s=30.0, poll_interval_s=0.005),
+            clock=clock,
         )
         assert server.staleness_window == 4  # coordinator wired the window
         await server.start()
@@ -277,29 +281,16 @@ def test_heterogeneous_speed_federation_end_to_end(devices):
             await server.stop()
         return history, coord
 
-    # Sampled BEFORE the run: a load check read afterwards would also count the
-    # test's own just-finished work.  Normalized per core and thresholded ABOVE
-    # 1.0: on the 1-core CI host the suite's own preceding tests keep the
-    # 1-minute loadavg near 1.0 even on a quiet machine, so a <=1.0 gate would
-    # skip the assertion on essentially every CI run — the gate must only trip
-    # on EXTRA contention (a second busy process), not on the suite itself.
-    try:
-        load_per_core = os.getloadavg()[0] / (os.cpu_count() or 1)
-    except OSError:  # platform without getloadavg
-        load_per_core = 0.0
-
     history, coord = asyncio.run(main())
     completed = [h for h in history if h["status"] == "COMPLETED"]
     assert len(completed) == 6
     # No cohort barrier: every aggregation used exactly-ish the buffer fill.
     assert all(h["num_clients"] >= 2 for h in completed)
-    # TIMING-dependent: stale updates appear only if slow clients' submissions
-    # overlap version publishes, which the delay schedule guarantees on a quiet
-    # core but a contended one can starve (the whole federation serializes and
-    # every update lands fresh).  Gate it on pre-run load; everything functional
-    # above and below stays unconditional.
-    if load_per_core <= 1.5:
-        assert any(s > 0 for h in completed for s in h["staleness"])
+    # UNCONDITIONAL now: c3 trains from version 0 for 0.15 virtual seconds
+    # while c0/c1 fill the K=2 buffer at ~0.01 — at least one later
+    # aggregation must therefore see a stale base.  On the virtual clock this
+    # is deadline ordering, not a race.
+    assert any(s > 0 for h in completed for s in h["staleness"])
     # The model moved and the loss trajectory is sane (finite, generally falling).
     losses = [h["metrics"]["loss"] for h in completed if h["metrics"]["loss"]]
     assert all(np.isfinite(losses))

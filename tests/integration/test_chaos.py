@@ -1,0 +1,418 @@
+"""Chaos harness (nanofed_tpu.faults): liveness invariants under seeded failure.
+
+The ISSUE-6 acceptance criteria, as executable claims — all on a
+``VirtualClock`` so every timeout/straggler behavior is a pure function of the
+seeded ``FaultPlan``, not of host load:
+
+(a) a sync round survives f = 25% client crashes via completion-rate graceful
+    degradation, and the dead clients are EVICTED from the barrier after
+    ``straggler_evict_after`` consecutive misses;
+(b) a server kill-restart mid-round resumes from the persisted round state
+    (``persistence.state_store``) and converges to the same loss trajectory as
+    an unfailed run within tolerance — with the SAME client tasks surviving
+    the restart through their retry policy;
+(c) duplicate submits under the retry policy (a lost-ACK storm) change the
+    global params exactly once (FedBuff would otherwise double-count across
+    drains);
+
+plus the chaos-smoke seed the CI job runs, and the in-process simulator's
+deterministic crash injection.  Retry/eviction/429/fault counters are asserted
+visible in the Prometheus rendering and ``telemetry.jsonl``.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.communication import (
+    HTTPClient,
+    HTTPServer,
+    NetworkCoordinator,
+    NetworkRoundConfig,
+    RetryPolicy,
+)
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.types import ClientData
+from nanofed_tpu.faults import (
+    ChaosClient,
+    ChaosSchedule,
+    FaultEvent,
+    FaultPlan,
+    InjectedServerCrash,
+)
+from nanofed_tpu.models import get_model
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.persistence.state_store import FileStateStore, is_recoverable
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.trainer.local import make_local_fit
+from nanofed_tpu.utils.clock import VirtualClock
+
+PORT = 19050
+
+_MODEL = get_model("linear", in_features=6, num_classes=2)
+_TEMPLATE = _MODEL.init(jax.random.key(0))
+_FIT = jax.jit(make_local_fit(
+    _MODEL.apply, TrainingConfig(batch_size=8, local_epochs=1, learning_rate=0.1)
+))
+
+
+def _client_data(idx: int) -> ClientData:
+    r = np.random.default_rng(100 + idx)
+    x = r.normal(size=(16, 6)).astype(np.float32)
+    w = r.normal(size=(6,))
+    y = (x @ w > 0).astype(np.int32)
+    return ClientData(x=jnp.asarray(x), y=jnp.asarray(y), mask=jnp.ones((16,)))
+
+
+async def _run_client(
+    cid: str,
+    idx: int,
+    port: int,
+    clock: VirtualClock,
+    schedule: ChaosSchedule | None,
+    registry: MetricsRegistry,
+    resubmit_after: float = 2.0,
+) -> None:
+    """A production-shaped scripted client: fetch → train (deterministic in
+    (round, client)) → submit, with retries, under the chaos plan.  If the
+    SAME round stays open ``resubmit_after`` virtual seconds after our submit
+    (a restarted server lost its buffer), re-submit — the server's dedupe and
+    latest-wins buffering make this safe."""
+    data = _client_data(idx)
+    retry = RetryPolicy(max_attempts=10, base_backoff_s=0.02, max_backoff_s=0.5,
+                        seed=1234)
+    async with HTTPClient(
+        f"http://127.0.0.1:{port}", cid, timeout_s=60,
+        registry=registry, retry=retry, clock=clock,
+    ) as client:
+        chaos = ChaosClient(client, schedule, clock=clock) if schedule else None
+        submitted: dict[int, float] = {}
+        while True:
+            try:
+                params, rnd, active = await client.fetch_global_model(like=_TEMPLATE)
+            except NanoFedError:
+                return  # server gone past the retry budget
+            if not active:
+                return
+            if chaos is not None and not chaos.alive(rnd):
+                return  # planned crash: silence, like a dead process
+            if rnd in submitted and clock.time() - submitted[rnd] < resubmit_after:
+                await clock.sleep(0.05)
+                continue
+            result = _FIT(jax.tree.map(jnp.asarray, params), data,
+                          jax.random.key(1000 * rnd + idx))
+            metrics = {"loss": float(result.metrics.loss), "num_samples": 16.0}
+            if chaos is not None:
+                await chaos.submit(result.params, metrics, rnd)
+            else:
+                await client.submit_update(result.params, metrics)
+            submitted[rnd] = clock.time()
+            await clock.sleep(0.05)
+
+
+def test_round_survives_25pct_crashes_with_eviction(tmp_path):
+    """(a) 8 clients, 2 crash at round 1 (f = 25%): every round completes via
+    the 0.75 completion-rate gate, the dead pair is evicted after 2
+    consecutive misses, the barrier degrades, and the counters land in
+    /metrics and telemetry.jsonl — all deterministic under the plan."""
+    registry = MetricsRegistry()
+    plan = FaultPlan(seed=11, events=(
+        FaultEvent(kind="crash", round=1, client="c6"),
+        FaultEvent(kind="crash", round=1, client="c7"),
+    ))
+    schedule = ChaosSchedule(plan, registry=registry)
+    clock = VirtualClock()
+    port = PORT + 0
+
+    async def main():
+        server = HTTPServer(port=port, registry=registry, clock=clock)
+        coordinator = NetworkCoordinator(
+            server, _TEMPLATE,
+            NetworkRoundConfig(
+                num_rounds=5, min_clients=8, min_completion_rate=0.75,
+                round_timeout_s=20.0, poll_interval_s=0.01,
+                straggler_evict_after=2,
+            ),
+            telemetry_dir=tmp_path, registry=registry, clock=clock,
+        )
+        await server.start()
+        try:
+            tasks = [
+                asyncio.create_task(
+                    _run_client(f"c{i}", i, port, clock, schedule, registry)
+                )
+                for i in range(8)
+            ]
+            history = await coordinator.run()
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=60)
+            return history, coordinator
+        finally:
+            await server.stop()
+
+    history, coordinator = asyncio.run(main())
+    assert [h["status"] for h in history] == ["COMPLETED"] * 5
+    # Round 0 had all 8; post-crash rounds ran on the 6 survivors, above the
+    # ceil(8 * 0.75) = 6 gate (graceful degradation, not a stall).
+    assert history[0]["num_clients"] >= 6
+    assert all(h["num_clients"] == 6 for h in history[2:])
+    # The dead pair — and only it — was evicted, and the barrier shrank.
+    evicted = sorted(
+        c for h in history for c in h.get("evicted_stragglers", ())
+    )
+    assert evicted == ["c6", "c7"]
+    assert history[-1]["required"] == 5  # ceil((8 - 2) * 0.75)
+    assert coordinator._evicted_stragglers == {"c6", "c7"}
+    # Counters visible where the ISSUE wants them: Prometheus + telemetry.
+    text = registry.render_prometheus()
+    assert "nanofed_straggler_evictions_total 2" in text
+    assert 'nanofed_faults_injected_total{kind="crash"} 2' in text
+    telemetry = [
+        json.loads(line)
+        for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()
+    ]
+    rounds = [t for t in telemetry if t.get("type") == "round"]
+    assert len(rounds) == 5
+    assert any(t.get("evicted_stragglers") for t in rounds)
+
+
+def test_server_kill_restart_resumes_and_converges(tmp_path):
+    """(b) The kill-restart drill: a planned ``server_kill`` fires mid-round 3,
+    the run crashes exactly as ``persistence.is_recoverable`` expects, a new
+    server + coordinator rebuilt over the SAME state store resume at round 3,
+    the surviving client tasks re-sync through their retry policy, and the
+    combined run converges to the unfailed run's loss trajectory."""
+    registry_ref = MetricsRegistry()
+    clock_ref = VirtualClock()
+    port_ref = PORT + 1
+
+    config = dict(num_rounds=6, min_clients=4, min_completion_rate=1.0,
+                  round_timeout_s=30.0, poll_interval_s=0.01)
+
+    async def reference():
+        server = HTTPServer(port=port_ref, registry=registry_ref, clock=clock_ref)
+        coordinator = NetworkCoordinator(
+            server, _TEMPLATE, NetworkRoundConfig(**config),
+            registry=registry_ref, clock=clock_ref,
+        )
+        await server.start()
+        try:
+            tasks = [
+                asyncio.create_task(_run_client(
+                    f"c{i}", i, port_ref, clock_ref, None, registry_ref))
+                for i in range(4)
+            ]
+            history = await coordinator.run()
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=60)
+            return history, coordinator.params
+        finally:
+            await server.stop()
+
+    ref_history, ref_params = asyncio.run(reference())
+    assert [h["status"] for h in ref_history] == ["COMPLETED"] * 6
+
+    registry = MetricsRegistry()
+    clock = VirtualClock()
+    port = PORT + 2
+    store = FileStateStore(tmp_path / "state")
+    schedule = ChaosSchedule(
+        FaultPlan(seed=7, events=(FaultEvent(kind="server_kill", round=3),)),
+        registry=registry,
+    )
+
+    async def chaotic():
+        tasks = [
+            asyncio.create_task(
+                _run_client(f"c{i}", i, port, clock, None, registry))
+            for i in range(4)
+        ]
+
+        async def incarnation():
+            server = HTTPServer(port=port, registry=registry, clock=clock)
+            coordinator = NetworkCoordinator(
+                server, _TEMPLATE, NetworkRoundConfig(**config),
+                registry=registry, clock=clock,
+                state_store=FileStateStore(tmp_path / "state"),
+                chaos=schedule,
+            )
+            await server.start()
+            try:
+                return coordinator, await coordinator.run(), None
+            except InjectedServerCrash as crash:
+                return coordinator, list(coordinator.history), crash
+            finally:
+                await server.stop()
+
+        try:
+            coord1, h1, crash = await incarnation()
+            assert crash is not None and is_recoverable(crash)
+            assert coord1.start_round == 0
+            # Rounds 0-2 completed and were checkpointed before the kill.
+            assert [h["status"] for h in h1] == ["COMPLETED"] * 3
+            coord2, h2, crash2 = await incarnation()
+            assert crash2 is None
+            assert coord2.start_round == 3  # resumed, not re-run
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=120)
+            return h1 + h2, coord2.params
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    history, params = asyncio.run(chaotic())
+    assert store.restore_latest().round_number == 5
+    assert [h["round"] for h in history] == list(range(6))
+    assert [h["status"] for h in history] == ["COMPLETED"] * 6
+    # Convergence: the resumed trajectory matches the unfailed run round for
+    # round (identical cohorts + deterministic fits; tolerance covers
+    # arrival-order float reassociation in the weighted mean).
+    for got, want in zip(history, ref_history):
+        assert got["metrics"]["loss"] == pytest.approx(
+            want["metrics"]["loss"], abs=1e-4
+        )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert 'nanofed_faults_injected_total{kind="server_kill"} 1' \
+        in registry.render_prometheus()
+
+
+def test_duplicate_submits_change_global_params_exactly_once():
+    """(c) FedBuff + lost ACK + retry storm: the aggregation applies the
+    client's KNOWN delta exactly once, and the straggling duplicates that
+    arrive after the drain never re-enter the buffer."""
+    registry = MetricsRegistry()
+    clock = VirtualClock()
+    port = PORT + 3
+    schedule = ChaosSchedule(
+        FaultPlan(seed=5, events=(
+            FaultEvent(kind="ack_drop", round=0, client="c1", count=1),
+        )),
+        registry=registry,
+    )
+    base = {"w": jnp.zeros(4, jnp.float32)}
+    trained = {"w": jnp.ones(4, jnp.float32)}  # known delta: +1
+
+    async def main():
+        server = HTTPServer(port=port, registry=registry, clock=clock,
+                            chaos=schedule)
+        coordinator = NetworkCoordinator(
+            server, base,
+            NetworkRoundConfig(num_rounds=1, async_buffer_k=1,
+                               staleness_window=2, round_timeout_s=10.0,
+                               poll_interval_s=0.001),
+            registry=registry, clock=clock,
+        )
+        await server.start()
+        try:
+
+            async def client():
+                async with HTTPClient(
+                    f"http://127.0.0.1:{port}", "c1", timeout_s=30,
+                    registry=registry, clock=clock,
+                    # Backoff LONGER than the coordinator's poll: the retry
+                    # lands after the drain, the worst case for double-count.
+                    retry=RetryPolicy(max_attempts=6, base_backoff_s=0.05,
+                                      seed=0),
+                ) as c:
+                    await c.fetch_global_model(like=base)
+                    assert await c.submit_update(trained, {"loss": 0.5})
+                    for _ in range(3):  # keep the storm going post-drain
+                        assert await c.resend_last_update()
+
+            task = asyncio.create_task(client())
+            history = await coordinator.run()
+            await asyncio.wait_for(task, timeout=60)
+            return history, coordinator, server
+
+        finally:
+            await server.stop()
+
+    history, coordinator, server = asyncio.run(main())
+    assert history[0]["status"] == "COMPLETED"
+    assert history[0]["num_clients"] == 1
+    # Exactly once: base + 1.0, not base + 2.0 (or more).
+    np.testing.assert_allclose(np.asarray(coordinator.params["w"]),
+                               np.ones(4), atol=1e-6)
+    assert server.num_updates() == 0  # duplicates never re-buffered
+    text = registry.render_prometheus()
+    assert 'nanofed_faults_injected_total{kind="ack_drop"} 1' in text
+    assert 'result="duplicate"' in text
+
+
+def test_chaos_smoke(tmp_path):
+    """The CI chaos-smoke seed (make chaos-smoke): a GENERATED 8-client plan
+    with one crash and one straggler; the federation completes every round and
+    the injected faults are visible in the counters."""
+    registry = MetricsRegistry()
+    plan = FaultPlan.generate(
+        seed=6, clients=[f"c{i}" for i in range(8)], num_rounds=3,
+        crash_fraction=1 / 8, straggler_fraction=1 / 8, straggler_delay_s=3.0,
+    )
+    assert sum(1 for e in plan.events if e.kind == "crash") == 1
+    assert sum(1 for e in plan.events if e.kind == "delay") == 1
+    schedule = ChaosSchedule(plan, registry=registry)
+    clock = VirtualClock()
+    port = PORT + 4
+
+    async def main():
+        server = HTTPServer(port=port, registry=registry, clock=clock,
+                            chaos=schedule)
+        coordinator = NetworkCoordinator(
+            server, _TEMPLATE,
+            NetworkRoundConfig(num_rounds=3, min_clients=8,
+                               min_completion_rate=0.75, round_timeout_s=20.0,
+                               poll_interval_s=0.01, straggler_evict_after=2),
+            telemetry_dir=tmp_path, registry=registry, clock=clock, chaos=schedule,
+        )
+        await server.start()
+        try:
+            tasks = [
+                asyncio.create_task(
+                    _run_client(f"c{i}", i, port, clock, schedule, registry))
+                for i in range(8)
+            ]
+            history = await coordinator.run()
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=60)
+            return history
+        finally:
+            await server.stop()
+
+    history = asyncio.run(main())
+    assert [h["status"] for h in history] == ["COMPLETED"] * 3
+    counts = schedule.counts()
+    assert counts.get("crash", 0) == 1
+    assert (tmp_path / "telemetry.jsonl").exists()
+
+
+def test_simulator_chaos_crashes_gate_rounds(devices):
+    """In-process injection point: the SPMD simulator's cohorts drop planned
+    crashes deterministically, standing or falling on min_completion_rate
+    exactly like a real dropout wave — and an identical run without the plan
+    completes."""
+    from nanofed_tpu.data import federate, synthetic_classification
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+    from nanofed_tpu.orchestration.types import RoundStatus
+
+    ds = synthetic_classification(256, 3, (8,), seed=0)
+    cd = federate(ds, num_clients=8, scheme="iid", batch_size=16)
+    config = CoordinatorConfig(num_rounds=2, min_completion_rate=0.9, seed=0,
+                               save_metrics=False)
+    training = TrainingConfig(batch_size=16, local_epochs=1)
+    model = get_model("mlp", in_features=8, hidden=8, num_classes=3)
+
+    plan = FaultPlan(seed=3, events=tuple(
+        FaultEvent(kind="crash", round=0, client=i) for i in range(3)
+    ))
+    chaotic = Coordinator(
+        model=model, train_data=cd, config=config, training=training,
+        chaos=ChaosSchedule(plan, registry=MetricsRegistry()),
+    )
+    rounds = chaotic.run()
+    # 5/8 survivors < 0.9 completion: every round FAILS, deterministically.
+    assert all(r.status == RoundStatus.FAILED for r in rounds)
+
+    clean = Coordinator(model=model, train_data=cd, config=config,
+                        training=training)
+    assert all(r.status == RoundStatus.COMPLETED for r in clean.run())

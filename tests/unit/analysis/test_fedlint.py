@@ -524,6 +524,77 @@ class TestFed006:
         assert diags == []
 
 
+class TestFed006UnboundedAwait:
+    """The PR-6 extension: request handlers in communication/ must bound
+    request-body awaits with asyncio.wait_for (slowloris defense)."""
+
+    HANDLER = """
+        class Server:
+            async def _handle_update(self, request):
+                body = await request.read()
+                return body
+        """
+
+    def test_unbounded_read_in_communication_handler_flagged(self):
+        diags = _lint(self.HANDLER, module="nanofed_tpu.communication.fake")
+        assert _codes(diags) == ["FED006"]
+        assert "asyncio.wait_for" in diags[0].message
+
+    def test_json_and_text_also_flagged(self):
+        diags = _lint(
+            """
+            class Server:
+                async def _handle_register(self, request):
+                    a = await request.json()
+                    b = await request.text()
+                    return a, b
+            """,
+            module="nanofed_tpu.communication.fake",
+        )
+        assert _codes(diags) == ["FED006", "FED006"]
+
+    def test_wait_for_wrapped_read_is_clean(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            class Server:
+                async def _handle_update(self, request):
+                    body = await asyncio.wait_for(request.read(), timeout=30.0)
+                    return body
+            """,
+            module="nanofed_tpu.communication.fake",
+        )
+        assert diags == []
+
+    def test_helper_indirection_is_clean(self):
+        # The production shape: handlers delegate to a bounded _read_body.
+        diags = _lint(
+            """
+            class Server:
+                async def _handle_update(self, request):
+                    body = await self._read_body(request)
+                    return body
+            """,
+            module="nanofed_tpu.communication.fake",
+        )
+        assert diags == []
+
+    def test_non_handler_and_other_packages_out_of_scope(self):
+        # A client-side poller (not _handle*) and the same code outside
+        # communication/ are both out of the rule's scope.
+        diags = _lint(
+            """
+            class Client:
+                async def fetch(self, resp):
+                    return await resp.read()
+            """,
+            module="nanofed_tpu.communication.fake",
+        )
+        assert diags == []
+        assert _lint(self.HANDLER, module="nanofed_tpu.orchestration.fake") == []
+
+
 # ---------------------------------------------------------------------------
 # Engine plumbing
 # ---------------------------------------------------------------------------

@@ -1,0 +1,398 @@
+"""Retry policy (communication.retry) + idempotent submit keys on the wire.
+
+Covers the pure backoff arithmetic, and — over a real localhost server — the
+exactly-once contract the idempotency keys buy: N identical retries of one
+logical submit (the storm a lost ACK produces) fold into the round AT MOST
+once, including in the topk8 error-feedback path where a double-fold would
+silently double-count the client's delta (ISSUE 6 satellite)."""
+
+import asyncio
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.communication import (
+    HTTPClient,
+    HTTPServer,
+    RETRYABLE_STATUSES,
+    RetryPolicy,
+    parse_retry_after,
+)
+from nanofed_tpu.faults import ChaosSchedule, FaultEvent, FaultPlan
+from nanofed_tpu.models import get_model
+from nanofed_tpu.observability.registry import MetricsRegistry
+
+PORT = 18950
+
+
+# ---------------------------------------------------------------------------
+# Pure policy arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="base_backoff_s"):
+        RetryPolicy(base_backoff_s=2.0, max_backoff_s=1.0)
+    with pytest.raises(ValueError, match="multiplier"):
+        RetryPolicy(multiplier=0.5)
+    with pytest.raises(ValueError, match="jitter_fraction"):
+        RetryPolicy(jitter_fraction=1.5)
+    with pytest.raises(ValueError, match="budget_s"):
+        RetryPolicy(budget_s=0)
+
+
+def test_backoff_grows_exponentially_and_caps():
+    policy = RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0, multiplier=2.0,
+                         jitter_fraction=0.0)
+    rng = random.Random(0)
+    delays = [policy.backoff_s(a, rng) for a in range(1, 7)]
+    assert delays[:4] == pytest.approx([0.1, 0.2, 0.4, 0.8])
+    assert delays[4] == delays[5] == 1.0  # capped
+
+
+def test_jitter_is_seeded_and_bounded():
+    policy = RetryPolicy(base_backoff_s=1.0, max_backoff_s=1.0,
+                         jitter_fraction=0.5, seed=42)
+    a = [policy.backoff_s(1, policy.rng_for("c1")) for _ in range(3)]
+    b = [policy.backoff_s(1, policy.rng_for("c1")) for _ in range(3)]
+    assert a == b  # deterministic per (seed, client)
+    assert a != [policy.backoff_s(1, policy.rng_for("c2")) for _ in range(3)]
+    rng = policy.rng_for("c1")
+    for _ in range(50):
+        d = policy.backoff_s(1, rng)
+        assert 0.5 <= d <= 1.0  # jitter shaves at most jitter_fraction
+
+
+def test_retry_after_is_a_floor_under_the_backoff():
+    policy = RetryPolicy(base_backoff_s=0.1, jitter_fraction=0.0)
+    rng = random.Random(0)
+    assert policy.backoff_s(1, rng, retry_after_s=2.0) == 2.0
+    assert policy.backoff_s(1, rng, retry_after_s=0.01) == pytest.approx(0.1)
+
+
+def test_parse_retry_after():
+    assert parse_retry_after("3") == 3.0
+    assert parse_retry_after("0.25") == 0.25
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("Wed, 21 Oct 2026") is None
+    assert parse_retry_after("-1") is None
+
+
+def test_retryable_statuses_are_transient_only():
+    assert 429 in RETRYABLE_STATUSES and 503 in RETRYABLE_STATUSES
+    # Protocol rejections are final: retrying a stale round / bad signature
+    # verbatim cannot succeed, and topk8 must fold instead.
+    assert 400 not in RETRYABLE_STATUSES and 403 not in RETRYABLE_STATUSES
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once on the wire (idempotent submit keys)
+# ---------------------------------------------------------------------------
+
+
+def _linear_params():
+    model = get_model("linear", in_features=4, num_classes=2)
+    return model.init(jax.random.key(0))
+
+
+def test_lost_ack_retry_folds_exactly_once():
+    """ack_drop severs the connection AFTER the server buffers the update; the
+    client's retry (same idempotency key) must be answered as a duplicate, and
+    — the FedBuff double-count case — a duplicate arriving after the buffer
+    was DRAINED must not re-enter it."""
+    params = _linear_params()
+    trained = jax.tree.map(lambda p: p + 1.0, params)
+    registry = MetricsRegistry()
+    schedule = ChaosSchedule(
+        FaultPlan(seed=1, events=(
+            FaultEvent(kind="ack_drop", round=0, client="c1", count=1),
+        )),
+        registry=registry,
+    )
+    port = PORT + 1
+
+    async def main():
+        server = HTTPServer(port=port, staleness_window=2, chaos=schedule,
+                            registry=registry)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(
+                f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                registry=registry,
+                retry=RetryPolicy(max_attempts=4, base_backoff_s=0.01, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                # Attempt 1 is buffered but its ACK is severed; the retry gets
+                # the duplicate answer — the LOGICAL submit succeeds.
+                assert await c.submit_update(trained, {"loss": 0.1})
+                assert server.num_updates() == 1
+                taken = await server.take_updates(1)
+                assert [u.client_id for u in taken] == ["c1"]
+                assert server.num_updates() == 0
+                # The storm continues after the drain (retries can straggle in
+                # long after aggregation): still deduped, never re-buffered.
+                for _ in range(3):
+                    assert await c.resend_last_update()
+                assert server.num_updates() == 0
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    assert 'nanofed_faults_injected_total{kind="ack_drop"} 1' in text
+    # The client retried at least once, and the server answered duplicates.
+    assert 'nanofed_client_retries_total' in text
+    assert 'result="duplicate"' in text
+
+
+def test_topk8_retry_storm_folds_delta_exactly_once():
+    """The ISSUE 6 satellite: topk8 error feedback under a retry storm.  One
+    logical submit, its ACK lost, N identical retries — the server must hold
+    exactly ONE copy of the reconstructed update, and the client must commit
+    its staged residual exactly once (``_pending_base`` cleared, residual =
+    quantization tail, NOT the whole delta)."""
+    params = _linear_params()
+    delta = jax.tree.map(lambda p: 0.01 * jnp.ones_like(p), params)
+    trained = jax.tree.map(jnp.add, params, delta)
+    registry = MetricsRegistry()
+    schedule = ChaosSchedule(
+        FaultPlan(seed=2, events=(
+            FaultEvent(kind="ack_drop", round=0, client="c1", count=2),
+        )),
+        registry=registry,
+    )
+    port = PORT + 2
+
+    async def main():
+        server = HTTPServer(port=port, chaos=schedule, registry=registry)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(
+                f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                update_encoding="topk8-delta", topk_fraction=1.0,
+                registry=registry,
+                retry=RetryPolicy(max_attempts=5, base_backoff_s=0.01, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                assert await c.submit_update(trained, {"loss": 0.1})
+                # Residual committed ONCE: pending base cleared, and what
+                # remains is only the quantization tail (tiny), not the delta.
+                assert c._pending_base is None
+                for r, d in zip(jax.tree.leaves(c._residual),
+                                jax.tree.leaves(delta)):
+                    assert float(np.abs(np.asarray(r)).max()) \
+                        < 0.1 * float(np.abs(np.asarray(d)).max())
+                # Extra duplicates beyond the policy's own retries.
+                for _ in range(4):
+                    assert await c.resend_last_update()
+            updates = await server.drain_updates()
+            assert len(updates) == 1
+            # The single buffered copy IS the client's signed reconstruction.
+            for got, want in zip(jax.tree.leaves(updates[0].params),
+                                 jax.tree.leaves(trained)):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=1e-3
+                )
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    assert schedule.counts() == {"ack_drop": 2}
+
+
+def test_topk8_out_of_order_stale_then_duplicate():
+    """Out-of-order composition: a FINAL rejection (stale round — retrying it
+    verbatim can never succeed, so the policy must NOT retry) folds the whole
+    delta into the residual with ``_pending_base`` pinned; a then-identical
+    re-submit for the NEW round measures zero post-fold training, so the mass
+    is carried exactly once."""
+    params = _linear_params()
+    trained = jax.tree.map(lambda p: p + 0.02 * jnp.ones_like(p), params)
+    registry = MetricsRegistry()
+    port = PORT + 3
+
+    async def main():
+        server = HTTPServer(port=port, registry=registry)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=5)
+            async with HTTPClient(
+                f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                update_encoding="topk8-delta", topk_fraction=1.0,
+                registry=registry,
+                retry=RetryPolicy(max_attempts=5, base_backoff_s=0.01, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                # Clock-skewed straggler: submits for a round long gone.
+                c.current_round = 3
+                assert not await c.submit_update(trained, {"loss": 0.1})
+                assert server.num_updates() == 0
+                # Whole delta folded; the fold's base is pinned.
+                assert c._pending_base is not None
+                # Re-sync and retry on the CURRENT round: the submit carries
+                # residual + zero post-fold training = the same mass, once.
+                c.current_round = 5
+                assert await c.submit_update(trained, {"loss": 0.1})
+                assert c._pending_base is None
+            (update,) = await server.drain_updates()
+            for got, want in zip(jax.tree.leaves(update.params),
+                                 jax.tree.leaves(trained)):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=1e-3
+                )
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    # 400-stale is FINAL: the retry counter must show zero http_400 retries.
+    assert 'reason="http_400"' not in registry.render_prometheus()
+
+
+def test_admission_control_429_then_retry_succeeds():
+    """max_inflight=0 sheds every submit with 429 + Retry-After; lifting the
+    cap lets the client's retry through — the load-shedding handshake end to
+    end, with the 429 counter visible in the registry."""
+    params = _linear_params()
+    registry = MetricsRegistry()
+    port = PORT + 4
+
+    async def main():
+        server = HTTPServer(port=port, max_inflight=0, retry_after_s=0.02,
+                            registry=registry)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            url = f"http://127.0.0.1:{port}"
+            # No retry policy: the 429 surfaces as a failed submit.
+            async with HTTPClient(url, "c1", timeout_s=10,
+                                  registry=registry) as c:
+                await c.fetch_global_model(like=params)
+                assert not await c.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 0
+            # With a policy: first attempt sheds, cap lifts, retry lands.
+            async with HTTPClient(
+                url, "c2", timeout_s=10, registry=registry,
+                retry=RetryPolicy(max_attempts=4, base_backoff_s=0.05, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                async def lift_cap():
+                    await asyncio.sleep(0.01)
+                    server.max_inflight = None
+                lifted = asyncio.create_task(lift_cap())
+                assert await c.submit_update(params, {"loss": 0.1})
+                await lifted
+            assert server.num_updates() == 1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    assert 'nanofed_http_429_total{endpoint="update"} 2' in text
+    assert 'nanofed_client_retries_total{endpoint="update",reason="http_429"} 1' \
+        in text
+
+
+def test_admission_control_covers_masked_submits():
+    """The secagg masked path must hit the same 429 gate as plain submits —
+    its bodies hold the identical read/decode resources."""
+    params = _linear_params()
+    registry = MetricsRegistry()
+    port = PORT + 5
+
+    async def main():
+        server = HTTPServer(port=port, max_inflight=0, registry=registry)
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                                  registry=registry) as c:
+                assert not await c.submit_masked_update(
+                    np.zeros(4, np.uint32), {"num_samples": 1.0}
+                )
+            assert server.num_masked_updates() == 0
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    assert 'nanofed_http_429_total{endpoint="update"} 1' in text
+    assert 'nanofed_updates_total{kind="masked",result="admission_reject"} 1' in text
+
+
+def test_submit_fingerprint_binds_dedupe_to_the_signature():
+    """Crypto-free pin of the dedupe-authentication rule: on a signing server
+    the (key, fingerprint) pair must only match when the duplicate carries the
+    ACCEPTED attempt's exact signature header; unsigned servers use an empty
+    fingerprint (no authentication exists anywhere there)."""
+    from types import SimpleNamespace
+
+    from nanofed_tpu.communication.http_server import HEADER_SIGNATURE
+
+    signing = HTTPServer(port=1, require_signatures=True,
+                         registry=MetricsRegistry())
+    signed = SimpleNamespace(headers={HEADER_SIGNATURE: "c2lnbmF0dXJl"})
+    unsigned = SimpleNamespace(headers={})
+    fp = signing._submit_fingerprint(signed)
+    signing._record_submit_locked("victim", "victim:0:1", fp)
+    assert signing._duplicate_submit("victim", "victim:0:1", fp)
+    # A prober guessing the predictable key without the signature: no match.
+    assert not signing._duplicate_submit(
+        "victim", "victim:0:1", signing._submit_fingerprint(unsigned)
+    )
+    # Unsigned servers: fingerprint is empty either way, plain key dedupe.
+    plain = HTTPServer(port=1, registry=MetricsRegistry())
+    assert plain._submit_fingerprint(signed) == ""
+    plain._record_submit_locked("c1", "c1:0:1", "")
+    assert plain._duplicate_submit("c1", "c1:0:1", plain._submit_fingerprint(unsigned))
+
+
+def test_signed_server_duplicate_fast_path_stays_authenticated():
+    """An unauthenticated prober guessing the (predictable) submit key must
+    NOT get a success-shaped duplicate-200 from a require_signatures server —
+    the dedupe fast path matches on the accepted attempt's signature
+    fingerprint, which only the legitimate client can reproduce."""
+    pytest.importorskip("cryptography")
+    from nanofed_tpu.security import SecurityManager
+
+    params = _linear_params()
+    registry = MetricsRegistry()
+    signer = SecurityManager(key_size=2048)
+    port = PORT + 6
+
+    async def main():
+        server = HTTPServer(
+            port=port, registry=registry,
+            client_keys={"victim": signer.get_public_key()},
+            require_signatures=True,
+        )
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            url = f"http://127.0.0.1:{port}"
+            async with HTTPClient(url, "victim", timeout_s=10, registry=registry,
+                                  security_manager=signer) as c:
+                assert await c.submit_update(params, {"loss": 0.1})
+                # The legitimate retry (same bytes, same signature) dedupes.
+                assert await c.resend_last_update()
+            # The prober replays the victim's submit key WITHOUT the signature:
+            # it must fall through dedupe and die at the signature gate.
+            async with HTTPClient(url, "victim", timeout_s=10,
+                                  registry=registry) as prober:
+                prober.current_round = 0
+                prober._submit_seq = 0  # forge key "victim:0:1"
+                assert not await prober.submit_update(params, {"loss": 0.1})
+            assert server.num_updates() == 1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    assert 'result="duplicate"' in text
+    assert 'result="bad_signature"' in text

@@ -1,0 +1,111 @@
+"""Fault plans (nanofed_tpu.faults.plan): seeded determinism, JSON round-trip,
+and the schedule's consumption semantics — the properties every chaos claim
+("survives the plan") rests on."""
+
+import json
+
+import pytest
+
+from nanofed_tpu.faults import ChaosSchedule, FaultEvent, FaultPlan
+from nanofed_tpu.observability.registry import MetricsRegistry
+
+
+def test_event_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent(kind="meteor", round=0)
+    with pytest.raises(ValueError, match="round"):
+        FaultEvent(kind="crash", round=-1, client="c0")
+    with pytest.raises(ValueError, match="count"):
+        FaultEvent(kind="drop", round=0, client="c0", count=0)
+    with pytest.raises(ValueError, match="per-client"):
+        FaultEvent(kind="server_kill", round=1, client="c0")
+
+
+def test_generate_is_deterministic_in_the_seed():
+    clients = [f"c{i}" for i in range(16)]
+    a = FaultPlan.generate(7, clients, 10, crash_fraction=0.25,
+                           straggler_fraction=0.25, drop_fraction=0.125)
+    b = FaultPlan.generate(7, clients, 10, crash_fraction=0.25,
+                           straggler_fraction=0.25, drop_fraction=0.125)
+    c = FaultPlan.generate(8, clients, 10, crash_fraction=0.25,
+                           straggler_fraction=0.25, drop_fraction=0.125)
+    assert a == b
+    assert a != c
+    assert sum(1 for e in a.events if e.kind == "crash") == 4  # 25% of 16
+    # Crashes land in the first half so the survival claim covers most rounds.
+    assert all(e.round < 5 for e in a.events if e.kind == "crash")
+
+
+def test_json_round_trip_and_file_io(tmp_path):
+    plan = FaultPlan(seed=3, events=(
+        FaultEvent(kind="crash", round=1, client="c2"),
+        FaultEvent(kind="ack_drop", round=0, client="c0", count=2),
+        FaultEvent(kind="delay", round=2, client="c1", seconds=1.5),
+        FaultEvent(kind="server_kill", round=2),
+    ))
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    path = tmp_path / "plan.json"
+    plan.save(path)
+    assert FaultPlan.load(path) == plan
+    # The artifact is plain JSON an operator can write by hand.
+    raw = json.loads(path.read_text())
+    assert raw["seed"] == 3 and len(raw["events"]) == 4
+
+
+def test_crash_is_permanent_from_its_round():
+    schedule = ChaosSchedule(
+        FaultPlan(events=(FaultEvent(kind="crash", round=2, client="c1"),)),
+        registry=MetricsRegistry(),
+    )
+    assert not schedule.crashed("c1", 0)
+    assert not schedule.crashed("c1", 1)
+    assert schedule.crashed("c1", 2)
+    assert schedule.crashed("c1", 5)  # permanent
+    assert not schedule.crashed("c2", 5)
+    assert schedule.counts() == {"crash": 1}  # counted once, not per query
+
+
+def test_wire_faults_are_consumed_per_count():
+    reg = MetricsRegistry()
+    schedule = ChaosSchedule(
+        FaultPlan(events=(
+            FaultEvent(kind="drop", round=0, client="c0", count=2),
+        )),
+        registry=reg,
+    )
+    assert schedule.wire_fault("c0", "0").kind == "drop"
+    assert schedule.wire_fault("c0", "0").kind == "drop"
+    assert schedule.wire_fault("c0", "0") is None  # exhausted: the retry gets through
+    assert schedule.wire_fault("c0", "1") is None  # other rounds unaffected
+    assert schedule.wire_fault(None, "0") is None
+    assert schedule.counts() == {"drop": 2}
+    text = reg.render_prometheus()
+    assert 'nanofed_faults_injected_total{kind="drop"} 2' in text
+
+
+def test_server_kill_fires_exactly_once():
+    schedule = ChaosSchedule(
+        FaultPlan(events=(FaultEvent(kind="server_kill", round=3),)),
+        registry=MetricsRegistry(),
+    )
+    assert not schedule.take_server_kill(2)
+    assert schedule.take_server_kill(3)
+    assert not schedule.take_server_kill(3)  # consumed: the restarted run proceeds
+
+
+def test_client_events_collects_this_rounds_faults():
+    schedule = ChaosSchedule(
+        FaultPlan(events=(
+            FaultEvent(kind="delay", round=1, client="c0", seconds=0.5),
+            FaultEvent(kind="skew", round=1, client="c0", seconds=2),
+            FaultEvent(kind="corrupt", round=2, client="c0"),
+            FaultEvent(kind="duplicate", round=1, client="c1", count=3),
+        )),
+        registry=MetricsRegistry(),
+    )
+    kinds = sorted(e.kind for e in schedule.client_events("c0", 1))
+    assert kinds == ["delay", "skew"]
+    assert [e.kind for e in schedule.client_events("c0", 2)] == ["corrupt"]
+    assert [e.kind for e in schedule.client_events("c1", 1)] == ["duplicate"]
+    # duplicate is counted: consumed after its count is spent.
+    assert schedule.client_events("c1", 1) == []

@@ -279,3 +279,86 @@ def test_plan_missing_or_corrupt_record_probes():
     assert plan_accel_attempt(None) == "probe"
     assert plan_accel_attempt({"verdict": "maybe", "at_unix": 0.0}) == "probe"
     assert plan_accel_attempt({"verdict": "ok"}) == "probe"  # no timestamp
+
+
+# ---------------------------------------------------------------------------
+# Un-losable record (ROADMAP item 5): provisional startup summary + CPU basis
+# ---------------------------------------------------------------------------
+
+from bench import cpu_fallback_basis, cpu_mesh_devices, provisional_summary  # noqa: E402
+
+
+def _write_capture(path, results):
+    import json
+
+    path.write_text(json.dumps({"artifact": path.stem, "results": results}))
+
+
+def test_provisional_summary_prefers_the_capture_summary_record(tmp_path):
+    _write_capture(tmp_path / "bench_tpu_r05.json", [
+        {"metric": METRIC_PARITY, "value": 0.31, "unit": "s"},
+        {"metric": METRIC_FLAGSHIP, "value": 0.7378, "unit": "s",
+         "vs_baseline": 271.81, "platform": "tpu", "summary": True},
+    ])
+    out = provisional_summary(str(tmp_path))
+    assert out is not None
+    assert out["metric"] == METRIC_FLAGSHIP
+    assert out["value"] == 0.7378 and out["vs_baseline"] == 271.81
+    assert out["provisional"] is True
+    assert out["provisional_from"].endswith("bench_tpu_r05.json")
+    # Driver-parseable: the schema fields the tail parser needs are all there.
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(out)
+
+
+def test_provisional_summary_newest_parseable_capture_wins(tmp_path):
+    import os
+    import time as _t
+
+    _write_capture(tmp_path / "bench_tpu_r03.json", [
+        {"metric": METRIC_FLAGSHIP, "value": 1.5, "unit": "s", "summary": True},
+    ])
+    newer = tmp_path / "bench_tpu_r05.json"
+    newer.write_text("{ corrupt")
+    past = _t.time() - 60
+    os.utime(tmp_path / "bench_tpu_r03.json", (past, past))
+    # The newest capture is corrupt: fall back to the older parseable one
+    # rather than returning nothing.
+    out = provisional_summary(str(tmp_path))
+    assert out["value"] == 1.5
+
+
+def test_provisional_summary_without_summary_record_uses_flagship_line(tmp_path):
+    _write_capture(tmp_path / "bench_tpu_r04.json", [
+        {"metric": METRIC_FLAGSHIP, "value": 0.9, "unit": "s",
+         "vs_baseline": 222.0, "platform": "tpu"},
+    ])
+    out = provisional_summary(str(tmp_path))
+    assert out["value"] == 0.9 and out["vs_baseline"] == 222.0
+
+
+def test_provisional_summary_absent_or_useless_captures_yield_none(tmp_path):
+    assert provisional_summary(str(tmp_path)) is None  # empty dir
+    _write_capture(tmp_path / "bench_tpu_r01.json", [
+        {"metric": METRIC_FLAGSHIP, "value": None, "unit": "s"},
+    ])
+    assert provisional_summary(str(tmp_path)) is None  # no numeric value
+    assert provisional_summary(str(tmp_path / "missing")) is None
+
+
+def test_cpu_fallback_basis_states_the_mesh_and_cores():
+    basis = cpu_fallback_basis(8, 8)
+    assert basis["mesh_devices"] == 8 and basis["physical_cores"] == 8
+    assert "multi-device virtual CPU mesh" in basis["note"]
+    # The degenerate 1-core case is labeled, not hidden.
+    one = cpu_fallback_basis(1, 1)
+    assert one["mesh_devices"] == 1
+    assert "1 XLA host device" in one["note"]
+
+
+def test_cpu_mesh_devices_env_override_and_core_cap(monkeypatch):
+    monkeypatch.setenv("NANOFED_BENCH_CPU_DEVICES", "4")
+    assert cpu_mesh_devices() == 4
+    monkeypatch.delenv("NANOFED_BENCH_CPU_DEVICES")
+    import os
+
+    assert cpu_mesh_devices() == max(1, min(8, os.cpu_count() or 1))

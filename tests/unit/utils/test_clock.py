@@ -1,0 +1,134 @@
+"""Injectable clocks (utils.clock): the real clock's contract and the virtual
+clock's determinism guarantees — deadline ordering, load-independence, and the
+"time only moves when everyone is parked" rule the deflaked async federation
+test relies on."""
+
+import asyncio
+import time
+
+import pytest
+
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock, VirtualClock
+
+
+def test_real_clock_monotonic_and_sleeps():
+    clock = Clock()
+    t0 = clock.time()  # off-loop: time.monotonic fallback
+    assert clock.time() >= t0
+
+    async def main():
+        start = clock.time()
+        await clock.sleep(0.01)
+        assert clock.time() - start >= 0.009
+
+    asyncio.run(main())
+
+
+def test_system_clock_is_a_clock():
+    assert isinstance(SYSTEM_CLOCK, Clock)
+
+
+def test_virtual_clock_expires_long_timeouts_without_real_waiting():
+    """A 500-virtual-second wait completes in well under a real second — the
+    property that makes round timeouts load-independent in tests."""
+    clock = VirtualClock()
+
+    async def main():
+        await clock.sleep(500.0)
+        return clock.time()
+
+    real0 = time.perf_counter()
+    virtual = asyncio.run(main())
+    assert virtual >= 500.0
+    assert time.perf_counter() - real0 < 5.0
+
+
+def test_virtual_clock_wakes_sleepers_in_deadline_order():
+    clock = VirtualClock()
+    order = []
+
+    async def sleeper(name, seconds):
+        await clock.sleep(seconds)
+        order.append((name, clock.time()))
+
+    async def main():
+        # Started slow-first so wake order must come from deadlines, not
+        # task-creation order.
+        await asyncio.gather(
+            sleeper("slow", 3.0), sleeper("fast", 1.0), sleeper("mid", 2.0)
+        )
+
+    asyncio.run(main())
+    assert [n for n, _ in order] == ["fast", "mid", "slow"]
+    # Each woke at (or after) its own deadline.
+    for (_, at), want in zip(order, (1.0, 2.0, 3.0)):
+        assert at >= want
+
+
+def test_virtual_clock_poll_loop_with_deadline():
+    """The communication-layer idiom: a poll loop against clock.time()
+    deadlines terminates by VIRTUAL timeout, never by host speed."""
+    clock = VirtualClock()
+
+    async def main():
+        deadline = clock.time() + 10.0
+        polls = 0
+        while clock.time() < deadline:
+            polls += 1
+            await clock.sleep(0.5)
+        return polls
+
+    polls = asyncio.run(main())
+    assert polls == 20
+
+
+def test_virtual_clock_zero_sleep_is_a_yield():
+    clock = VirtualClock()
+
+    async def main():
+        t = clock.time()
+        await clock.sleep(0)
+        assert clock.time() == t
+
+    asyncio.run(main())
+
+
+def test_virtual_clock_survives_multiple_event_loops():
+    """One instance across sequential asyncio.run calls (the advancer task is
+    per-loop and must be rebuilt)."""
+    clock = VirtualClock()
+
+    async def main():
+        await clock.sleep(1.0)
+        return clock.time()
+
+    assert asyncio.run(main()) >= 1.0
+    assert asyncio.run(main()) >= 2.0
+
+
+def test_virtual_clock_cancelled_sleeper_does_not_jump_time():
+    """A cancelled sleep's deadline is dead: advancing to it would spuriously
+    expire every LIVE deadline computed from time() (round timeouts, retry
+    budgets)."""
+    clock = VirtualClock()
+
+    async def main():
+        long_wait = asyncio.create_task(clock.sleep(300.0))
+        await asyncio.sleep(0)  # let it park
+        long_wait.cancel()
+        await asyncio.gather(long_wait, return_exceptions=True)
+        await clock.sleep(1.0)
+        return clock.time()
+
+    assert asyncio.run(main()) < 300.0
+
+
+def test_virtual_clock_manual_advance_and_validation():
+    clock = VirtualClock(start=5.0)
+    assert clock.time() == 5.0
+    clock.advance(2.5)
+    assert clock.time() == 7.5
+    with pytest.raises(ValueError):
+        clock.advance(-1.0)
+    with pytest.raises(ValueError):
+        VirtualClock(grace_yields=0)
