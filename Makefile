@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -31,6 +31,14 @@ bench-smoke:
 # (tier-1-safe: seconds of real time, determinism from the plan's seed).
 chaos-smoke:
 	python -m pytest tests/integration/test_chaos.py::test_chaos_smoke -q
+
+# Loadtest smoke (nanofed_tpu.loadgen): a ~200-client synthetic swarm on a
+# VirtualClock drives BOTH serving paths — per-submit and batched device
+# ingest — against a live HTTPServer; the loadtest artifact must parse, p99
+# submit latency must be finite, and no submit may be lost outright.
+# Tier-1-safe: virtual time, seconds of real time, seeded determinism.
+loadtest-smoke:
+	python -m pytest tests/integration/test_loadtest_smoke.py -q
 
 # Compile-only cost profile on CPU (observability.profiling): the `profile`
 # subcommand must produce a non-empty roofline table — single step, fused

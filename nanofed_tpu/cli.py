@@ -238,6 +238,43 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "aggregation mode)", file=sys.stderr)
         return 2
 
+    if args.ingest_batch is not None and args.validate:
+        # The coordinator refuses this too, with a traceback; say why up front
+        # (per-update validation needs individual update trees, which batched
+        # ingest folds into the device buffer at submit time).
+        print("error: --ingest-batch cannot be combined with --validate — "
+              "batched ingest folds updates into a device buffer at submit "
+              "time, so per-update shape/norm/z-score checks have nothing to "
+              "inspect", file=sys.stderr)
+        return 2
+    if args.ingest_batch is None and (
+        args.ingest_capacity is not None or args.decode_workers is not None
+    ):
+        print("error: --ingest-capacity/--decode-workers only apply with "
+              "--ingest-batch (they size the batched ingest pipeline)",
+              file=sys.stderr)
+        return 2
+
+    ingest = None
+    if args.ingest_batch is not None:
+        from nanofed_tpu.ingest import IngestConfig
+
+        capacity = (
+            args.ingest_capacity if args.ingest_capacity is not None else 1024
+        )
+        try:
+            ingest = IngestConfig(
+                capacity=capacity,
+                batch_size=min(args.ingest_batch, capacity),
+                decode_workers=(
+                    args.decode_workers
+                    if args.decode_workers is not None else 4
+                ),
+            )
+        except ValueError as e:
+            print(f"error: invalid ingest config: {e}", file=sys.stderr)
+            return 2
+
     if args.async_buffer is not None:
         # Sync-only cohort flags are meaningless under FedBuff (no cohort barrier:
         # aggregations fire on buffer fill, and the buffer size IS --async-buffer);
@@ -348,7 +385,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     async def serve() -> list[dict]:
         server = HTTPServer(
             host=args.host, port=args.port, max_inflight=args.max_inflight,
-            chaos=chaos,
+            chaos=chaos, ingest=ingest,
         )
         await server.start()
         try:
@@ -415,6 +452,45 @@ def _cmd_metrics_summary(args: argparse.Namespace) -> int:
         return 1
     print(json.dumps(summarize_telemetry(path), indent=2))
     return 0
+
+
+def _cmd_loadtest(args: argparse.Namespace) -> int:
+    """Run the synthetic client swarm against one (or both) serving paths and
+    print the artifact (also written under --out-dir)."""
+    from nanofed_tpu.loadgen import run_loadtest_comparison
+
+    modes = (
+        ("per-submit", "ingest") if args.mode == "both" else (args.mode,)
+    )
+    artifact = run_loadtest_comparison(
+        modes=modes,
+        out_dir=args.out_dir,
+        telemetry_dir=args.telemetry_dir,
+        clients=args.clients,
+        submits_per_client=args.submits_per_client,
+        model=args.model,
+        async_buffer_k=args.async_buffer,
+        aggregations=args.aggregations,
+        ingest_capacity=args.ingest_capacity,
+        decode_workers=args.decode_workers,
+        max_inflight=args.max_inflight,
+        arrival=args.arrival,
+        arrival_rate=args.rate,
+        weight_skew=args.weight_skew,
+        staleness_window=args.staleness_window,
+        round_timeout_s=args.timeout,
+        virtual_clock=args.virtual_clock,
+        seed=args.seed,
+    )
+    print(json.dumps(artifact, indent=2))
+    # A loadtest that lost submits outright (not 429-shed — those retry) is a
+    # failed measurement; surface it in the exit code for CI.
+    ok = all(
+        rec.get("failed_submits", 0) == 0
+        and rec["submit_latency_s"]["count"] > 0
+        for rec in artifact["modes"].values()
+    )
+    return 0 if ok else 1
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
@@ -611,6 +687,30 @@ def main(argv: list[str] | None = None) -> int:
         "(clients with a RetryPolicy back off and re-send). Default: unbounded",
     )
     serve.add_argument(
+        "--ingest-batch", type=int, default=None, metavar="K",
+        help="batched device-resident ingest (nanofed_tpu.ingest): decoded "
+        "deltas accumulate into a preallocated on-device buffer and ONE "
+        "jit-compiled batched reduce fires per drain instead of one "
+        "aggregation per client; npz decode moves into a bounded worker "
+        "pool and a full buffer answers 429 + Retry-After. K is the "
+        "EXPECTED drain size: the flush programs for batches up to K "
+        "pre-compile at startup so no realistic drain compiles on the "
+        "event loop (drain granularity itself is --async-buffer in FedBuff "
+        "mode, the round barrier in sync mode). Incompatible with "
+        "--validate",
+    )
+    serve.add_argument(
+        "--ingest-capacity", type=int, default=None, metavar="N",
+        help="with --ingest-batch: buffer slots (bounds device memory at "
+        "N * params * 4 bytes and is the 429 backpressure point; "
+        "default 1024)",
+    )
+    serve.add_argument(
+        "--decode-workers", type=int, default=None, metavar="N",
+        help="with --ingest-batch: bounded decode pool size (default 4) — "
+        "the event loop never decompresses an update body itself",
+    )
+    serve.add_argument(
         "--evict-stragglers", type=int, default=0, metavar="K",
         help="sync rounds: evict a previously-seen client after K consecutive "
         "missed rounds, shrinking the round barrier (completion-rate graceful "
@@ -692,6 +792,61 @@ def main(argv: list[str] | None = None) -> int:
         "(read back with `nanofed-tpu metrics-summary`)",
     )
 
+    loadtest = sub.add_parser(
+        "loadtest",
+        help="synthetic client swarm load harness (nanofed_tpu.loadgen): "
+        "drive N concurrent submits against an in-process federation "
+        "server and record p50/p99 submit latency, rounds/sec, and "
+        "429/retry counts as a runs/loadtest_*.json artifact",
+    )
+    loadtest.add_argument("--clients", type=int, default=10_000)
+    loadtest.add_argument("--submits-per-client", type=int, default=1)
+    loadtest.add_argument(
+        "--mode", default="both", choices=["per-submit", "ingest", "both"],
+        help="serving path under test; 'both' runs the per-submit and "
+        "batched-ingest paths on identical traffic and records the "
+        "rounds/sec ratio",
+    )
+    loadtest.add_argument("--model", default="digits_mlp")
+    loadtest.add_argument(
+        "--async-buffer", type=int, default=64, metavar="K",
+        help="FedBuff aggregation size K (the round engine runs in async "
+        "mode: aggregations fire on buffer fill)",
+    )
+    loadtest.add_argument(
+        "--aggregations", type=int, default=None,
+        help="aggregations to run (default: total submits // K)",
+    )
+    loadtest.add_argument("--ingest-capacity", type=int, default=1024)
+    loadtest.add_argument("--decode-workers", type=int, default=4)
+    loadtest.add_argument("--max-inflight", type=int, default=512)
+    loadtest.add_argument(
+        "--arrival", default="poisson", choices=["poisson", "uniform", "burst"],
+    )
+    loadtest.add_argument(
+        "--rate", type=float, default=2000.0,
+        help="mean arrival rate, submits/sec (poisson & uniform)",
+    )
+    loadtest.add_argument(
+        "--weight-skew", type=float, default=0.0,
+        help="lognormal sigma over reported num_samples (0 = homogeneous)",
+    )
+    loadtest.add_argument("--staleness-window", type=int, default=4)
+    loadtest.add_argument("--timeout", type=float, default=120.0,
+                          help="per-aggregation round timeout (seconds)")
+    loadtest.add_argument(
+        "--virtual-clock", action="store_true",
+        help="run arrivals/backoffs on a VirtualClock (deterministic, "
+        "seconds of real time — what the CI smoke uses)",
+    )
+    loadtest.add_argument("--seed", type=int, default=0)
+    loadtest.add_argument("--out-dir", default="runs")
+    loadtest.add_argument(
+        "--telemetry-dir", default=None,
+        help="also append per-mode 'loadtest' telemetry records here "
+        "(read back with `nanofed-tpu metrics-summary`)",
+    )
+
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
     bench.add_argument("--list", action="store_true", help="list benchmark names")
@@ -712,6 +867,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_metrics_summary(args)
     if args.cmd == "profile":
         return _cmd_profile(args)
+    if args.cmd == "loadtest":
+        return _cmd_loadtest(args)
     return _cmd_run(args)
 
 

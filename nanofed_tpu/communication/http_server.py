@@ -91,6 +91,7 @@ class HTTPServer:
         read_timeout_s: float = 30.0,
         chaos: Any | None = None,
         clock: Clock | None = None,
+        ingest: Any | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -128,7 +129,20 @@ class HTTPServer:
         boundary: per the seeded plan, an update request is severed before
         handling (``drop``), severed after handling but before its response
         (``ack_drop`` — the lost-ACK case idempotent submit keys exist for),
-        or delayed.  ``clock`` injects the time source for those delays."""
+        or delayed.  ``clock`` injects the time source for those delays.
+
+        ``ingest`` (a ``nanofed_tpu.ingest.IngestConfig``) switches PLAIN
+        update submits to the batched device-resident path: decoded deltas
+        accumulate into a preallocated FedBuff-style device buffer and ONE
+        jit-compiled batched reduce fires per drain instead of one
+        aggregation per client; npz decode/verify moves into the pipeline's
+        BOUNDED worker pool, and a full buffer answers 429 + Retry-After
+        (the same backpressure contract as ``max_inflight``) instead of
+        queueing unboundedly.  Masked (secure-aggregation) submits keep
+        their own buffer — masked vectors cannot be batch-reduced before
+        unmasking — but their CPU-bound decode rides the same bounded pool.
+        The idempotent-key, stale-round, and signature contracts are
+        identical on both paths."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
         if max_inflight is not None and max_inflight < 0:
@@ -146,6 +160,10 @@ class HTTPServer:
         self.read_timeout_s = read_timeout_s
         self._chaos = chaos
         self._clock = clock or SYSTEM_CLOCK
+        self.ingest = ingest
+        # Built lazily at the first publish_model (the params template fixes
+        # the buffer's flat size); every mutation happens under self._lock.
+        self._ingest_pipeline: Any | None = None
         self._log = Logger()
         self._lock = asyncio.Lock()
         self._inflight = 0  # submits currently in the read/decode pipeline
@@ -246,6 +264,23 @@ class HTTPServer:
             self._params = params
             self._params_bytes = payload
             self._round = round_number
+            if self.ingest is not None:
+                if self._ingest_pipeline is None:
+                    from nanofed_tpu.ingest import IngestPipeline
+
+                    self._ingest_pipeline = IngestPipeline(
+                        params, self.ingest, registry=self.metrics_registry
+                    )
+                # The pipeline's flat-base cache mirrors the version window
+                # EXACTLY (same publish, same pruning rule), so wire
+                # acceptance and delta reconstruction can never disagree.
+                self._ingest_pipeline.note_version(
+                    round_number, params, window=self.staleness_window
+                )
+                if self.staleness_window == 0:
+                    # Sync parity with the _updates.clear() below: a new
+                    # round invalidates every unaggregated buffered delta.
+                    self._ingest_pipeline.clear()
             if self.staleness_window > 0:
                 # Async mode: keep the window of base versions for delta
                 # reconstruction, and keep buffered updates — a straggler's update
@@ -274,6 +309,8 @@ class HTTPServer:
         # _updates is under self._lock — an invariant fedlint FED005 enforces on this
         # class, not a GIL hand-wave.  The round engine treats this as a hint and
         # re-checks under the lock via drain_updates()/take_updates().
+        if self._ingest_pipeline is not None:
+            return self._ingest_pipeline.fill
         return len(self._updates)
 
     async def drain_updates(self) -> list[ModelUpdate]:
@@ -299,6 +336,28 @@ class HTTPServer:
             keys = list(self._updates.keys())[:k]
             taken = [self._updates.pop(key) for key in keys]
         return taken
+
+    async def drain_ingest_fedavg(self) -> tuple[Any | None, list[Any]]:
+        """Sync-round drain of the batched-ingest buffer: ONE jitted reduce of
+        every buffered delta against the CURRENT round's base.  Returns
+        ``(new_flat_params, slot_metas)`` — ``(None, [])`` when nothing is
+        buffered; the round engine unravels the flat result into params."""
+        async with self._lock:
+            return self._ingest_pipeline.drain_fedavg(self._round)
+
+    async def drain_ingest_fedbuff(
+        self, k: int, current_version: int,
+        staleness_exponent: float = 0.5, server_lr: float = 1.0,
+    ) -> tuple[Any, list[Any], dict[str, Any]]:
+        """Async-mode drain: ONE jitted reduce of the K OLDEST buffered deltas
+        (staleness-discounted, out-of-window slots skipped) applied to the
+        current version — the batched counterpart of ``take_updates(k)`` +
+        ``fedbuff_combine``.  Surplus newer slots stay buffered."""
+        async with self._lock:
+            return self._ingest_pipeline.drain_fedbuff(
+                k, current_version,
+                staleness_exponent=staleness_exponent, server_lr=server_lr,
+            )
 
     def stop_training(self) -> None:
         """Signal clients to stop polling (parity: ``server.py:313-317``)."""
@@ -508,6 +567,13 @@ class HTTPServer:
     def current_round(self) -> int:
         return self._round
 
+    @property
+    def ingest_pipeline(self) -> Any | None:
+        """The batched-ingest pipeline, once the first ``publish_model`` built
+        it (None before, and always None without ``ingest=``) — the load
+        harness reads decode-pool utilization and buffer stats from here."""
+        return self._ingest_pipeline
+
     # ------------------------------------------------------------------
     # Fault injection (chaos middleware) + bounded reads
     # ------------------------------------------------------------------
@@ -546,6 +612,17 @@ class HTTPServer:
         if request.transport is not None:
             request.transport.close()
         return response
+
+    async def _offload(self, fn: Any, *args: Any, **kwargs: Any) -> Any:
+        """Run one CPU-bound submit stage (npz decode, delta reconstruction,
+        RSA verify, flatten) off the event loop: on the ingest pipeline's
+        BOUNDED worker pool when one exists — ``asyncio.to_thread``'s default
+        executor grows with concurrency, so a submit storm (plain OR masked)
+        could otherwise fan out unbounded decode threads — else ``to_thread``
+        (the pre-ingest behavior, still off-loop)."""
+        if self._ingest_pipeline is not None:
+            return await self._ingest_pipeline.run_decode(fn, *args, **kwargs)
+        return await asyncio.to_thread(fn, *args, **kwargs)
 
     async def _read_body(self, request: web.Request) -> bytes:
         """Read the request body with a TIME bound (``client_max_size`` bounds
@@ -728,6 +805,27 @@ class HTTPServer:
                 status=429,
                 headers={"Retry-After": f"{self.retry_after_s:g}"},
             )
+        # Batched ingest: a FULL buffer is known before any work — shed the
+        # submit NOW (body unread, no decode-pool slot burned) rather than
+        # after paying the whole decode pipeline for a guaranteed bounce.
+        # Lock-free fill read is a hint (no await yet); the authoritative
+        # re-check runs at the locked offer.  Clients whose slot would merely
+        # be REPLACED (latest-wins resubmit) are not full-rejected.
+        if (
+            not masked
+            and self._ingest_pipeline is not None
+            and self._ingest_pipeline.fill >= self.ingest.capacity
+            and not self._ingest_pipeline.buffer.has_client(client_id)
+        ):
+            self._m_429.inc(endpoint="update")
+            self._reject_update("ingest_full")
+            return web.json_response(
+                {"status": "error",
+                 "message": (f"ingest buffer full ({self.ingest.capacity} "
+                             "slots); retry after backoff")},
+                status=429,
+                headers={"Retry-After": f"{self.retry_after_s:g}"},
+            )
         self._inflight += 1
         try:
             if masked:
@@ -777,6 +875,14 @@ class HTTPServer:
                 if self.staleness_window > 0
                 else self._params
             )
+            # Batched ingest: the flat base for the SAME version, from the
+            # snapshot the lock guarantees consistent — the worker thread
+            # computes (flat(params) - base_flat) against it below.
+            base_flat = (
+                self._ingest_pipeline.base_flat(round_number)
+                if self._ingest_pipeline is not None
+                else None
+            )
         if base is None:
             # _round_acceptable passed under the lock, so async mode's window held
             # the version; this is unreachable short of state corruption — refuse
@@ -787,30 +893,57 @@ class HTTPServer:
                  "message": self._round_rejection_message(round_number)},
                 status=400,
             )
-        try:
-            # Offload the CPU-bound decode (up to 100 MB decompress + structure checks)
-            # so concurrent /model and /status requests aren't stalled behind it.
+        def _decode() -> Params:
+            # CPU-bound decode (up to 100 MB decompress + structure checks);
+            # compressed round deltas reconstruct base + dequantized delta in
+            # numpy float32 — bit-identical to the client's signing-side
+            # reconstruction, so signature verification composes.
             if encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):
-                # Compressed round delta: reconstruct base + dequantized delta in
-                # numpy float32 — bit-identical to the client's signing-side
-                # reconstruction, so signature verification composes.
-                params = await asyncio.to_thread(
-                    self._reconstruct_compressed_update, body, encoding, base
-                )
+                return self._reconstruct_compressed_update(body, encoding, base)
+            return decode_params(body, like=base)
+
+        ingest_flat = None
+        try:
+            # Offloaded so concurrent /model and /status requests aren't
+            # stalled behind it.  On the batched-ingest path WITHOUT
+            # signatures the flatten fuses into the same pool job — the full
+            # params tree never comes back to the handler, and each submit
+            # pays ONE pool round trip, not two.
+            if (
+                self._ingest_pipeline is not None
+                and not self.require_signatures
+                and base_flat is not None
+            ):
+
+                def _decode_flat() -> Any:
+                    from nanofed_tpu.ingest.pipeline import flatten_params
+
+                    # Host float32 [P]: the buffer stages it and flushes the
+                    # batch to device in one scatter at drain — no per-submit
+                    # device dispatch anywhere on this path.
+                    return flatten_params(_decode()) - base_flat
+
+                ingest_flat = await self._offload(_decode_flat)
+                params = None
             else:
-                params = await asyncio.to_thread(decode_params, body, like=base)
+                params = await self._offload(_decode)
         except Exception as e:
             self._reject_update("bad_payload")
             return web.json_response(
                 {"status": "error", "message": f"bad payload: {e}"}, status=400
             )
         if self.require_signatures:
-            verdict = await asyncio.to_thread(
+            verdict = await self._offload(
                 self._verify_update_signature, client_id, round_number, request, params
             )
             if verdict is not None:
                 self._reject_update("bad_signature")
                 return verdict
+        if self._ingest_pipeline is not None:
+            return await self._ingest_buffer_update(
+                client_id, round_number, metrics, submit_id, fingerprint,
+                params, base_flat, ingest_flat,
+            )
         async with self._lock:
             # Authoritative duplicate re-check: two concurrent attempts of the
             # same retry storm can both pass the lock-free entry check while
@@ -843,6 +976,77 @@ class HTTPServer:
                        accepted)
         return web.json_response(
             {"status": "success", "message": "update accepted", "update_id": client_id}
+        )
+
+    async def _ingest_buffer_update(
+        self, client_id: str, round_number: int, metrics: dict[str, Any],
+        submit_id: str | None, fingerprint: str, params: Params | None,
+        base_flat: Any, flat_delta: Any | None = None,
+    ) -> web.StreamResponse:
+        """Batched-ingest tail of an admitted plain submit: flatten the decoded
+        params into a delta against the snapshotted base (worker pool — one
+        O(P) subtract, then the device upload, both off the event loop) and
+        offer it into the device buffer under the lock.  A FULL buffer is the
+        backpressure boundary: 429 + Retry-After, the idempotency key NOT
+        recorded — exactly the admission-control contract, so a retrying
+        client lands later and a topk8 client that exhausts its retries folds
+        the delta into its error-feedback residual exactly once."""
+        if base_flat is None:
+            # The flat cache mirrors the acceptance window exactly, so an
+            # acceptable round always has a base; unreachable short of state
+            # corruption — refuse rather than guess (parity with the plain
+            # path's base-None refusal).
+            self._reject_update("stale_round")
+            return web.json_response(
+                {"status": "error",
+                 "message": self._round_rejection_message(round_number)},
+                status=400,
+            )
+        if flat_delta is None:
+            # Signed path: the decode job had to return the full params tree
+            # for signature verification, so flattening is its own pool job.
+            from nanofed_tpu.ingest.pipeline import flatten_params
+
+            def _flat_delta() -> Any:
+                return flatten_params(params) - base_flat
+
+            flat_delta = await self._offload(_flat_delta)
+        async with self._lock:
+            # Same authoritative re-checks as the per-submit path: duplicate
+            # first (a racing retry storm's second body must not double-buffer),
+            # then the round (the window may have moved during decode).
+            if self._duplicate_submit(client_id, submit_id, fingerprint):
+                return self._duplicate_response(client_id, "plain")
+            if not self._round_acceptable(round_number):
+                self._reject_update("stale_round")
+                return web.json_response(
+                    {"status": "error",
+                     "message": self._round_rejection_message(round_number)},
+                    status=400,
+                )
+            slot = self._ingest_pipeline.offer(
+                flat_delta, client_id=client_id, round_number=round_number,
+                metrics=metrics,
+            )
+            if slot is not None:
+                self._record_submit_locked(client_id, submit_id, fingerprint)
+                buffered = self._ingest_pipeline.fill
+        if slot is None:
+            self._m_429.inc(endpoint="update")
+            self._reject_update("ingest_full")
+            return web.json_response(
+                {"status": "error",
+                 "message": (f"ingest buffer full ({self.ingest.capacity} "
+                             "slots); retry after backoff")},
+                status=429,
+                headers={"Retry-After": f"{self.retry_after_s:g}"},
+            )
+        self._m_updates.inc(kind="plain", result="accepted")
+        self._log.info("ingested update from %s (round %d, slot %d, %d buffered)",
+                       client_id, round_number, slot, buffered)
+        return web.json_response(
+            {"status": "success", "message": "update accepted",
+             "update_id": client_id}
         )
 
     def _round_acceptable(self, round_number: int) -> bool:
@@ -934,7 +1138,7 @@ class HTTPServer:
             signature = base64.b64decode(request.headers.get(HEADER_SIGNATURE, ""))
         except Exception:
             signature = b""
-        ok = signature and await asyncio.to_thread(verify, *verify_args, signature, pem)
+        ok = signature and await self._offload(verify, *verify_args, signature, pem)
         if not ok:
             self._log.warning("invalid signature from %s on %s", client_id,
                               request.path)
@@ -1366,16 +1570,24 @@ class HTTPServer:
             if verdict is not None:
                 self._reject_update("bad_signature", kind="masked")
                 return verdict
-        try:
+        def _decode_masked() -> np.ndarray:
+            # CPU-bound npz decompress + structural check: a masked-submit
+            # storm must not starve the event loop, so this runs on the SAME
+            # bounded pool as plain-update decodes (``_offload``) — not inline
+            # in the handler, and not on to_thread's unbounded default pool.
             with np.load(io.BytesIO(body)) as z:
-                masked = z["masked"]
+                vec = z["masked"]
             expected_size = int(
                 sum(np.prod(leaf.shape) for leaf in jax.tree.leaves(self._params))
             )
-            if masked.dtype != np.uint32 or masked.shape != (expected_size,):
+            if vec.dtype != np.uint32 or vec.shape != (expected_size,):
                 raise ValueError(
-                    f"expected uint32[{expected_size}], got {masked.dtype}{masked.shape}"
+                    f"expected uint32[{expected_size}], got {vec.dtype}{vec.shape}"
                 )
+            return vec
+
+        try:
+            masked = await self._offload(_decode_masked)
         except Exception as e:
             self._reject_update("bad_payload", kind="masked")
             return web.json_response(
@@ -1440,3 +1652,5 @@ class HTTPServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        if self._ingest_pipeline is not None:
+            self._ingest_pipeline.close()

@@ -285,6 +285,21 @@ class NetworkCoordinator:
                 "order statistics over individual updates — that blindness is the "
                 "point of secure aggregation"
             )
+        if getattr(server, "ingest", None) is not None:
+            # Batched ingest folds every delta into a device buffer at submit
+            # time; individual update trees never exist server-side, so the
+            # per-update mechanisms cannot run.  (secure= composes fine: the
+            # masked path keeps its own buffer and only borrows the ingest
+            # pipeline's bounded decode pool.)
+            bad = [name for name, v in (("validation", validation),
+                                        ("robust", robust)) if v is not None]
+            if bad:
+                raise ValueError(
+                    f"batched ingest (server ingest=) cannot be combined with "
+                    f"{', '.join(bad)} — these inspect INDIVIDUAL updates, "
+                    "which the device-resident buffer folds away at submit "
+                    "time; disable ingest or drop the per-update mechanism"
+                )
         if config.async_buffer_k is not None:
             # Async federation composes with neither round-locked protocol:
             # SecAgg masks are bound to ONE round's cohort (a stale masked vector
@@ -316,6 +331,14 @@ class NetworkCoordinator:
         self.server = server
         self.params = params
         self.config = config
+        self._ingest_mode = getattr(server, "ingest", None) is not None
+        if self._ingest_mode:
+            # The buffer's drains return FLAT [P] params; the unravel (built
+            # once — the tree structure never changes across rounds) restores
+            # the pytree.  Same tree_ravel layout the pipeline flattens with.
+            from nanofed_tpu.utils.trees import tree_ravel
+
+            _, self._flat_unravel = tree_ravel(params)
         self.validation = validation
         self.secure = secure
         self.robust = robust
@@ -706,7 +729,12 @@ class NetworkCoordinator:
                 return await self._secure_round(round_number, required)
         with self._tracer.span("cohort-sample", round=round_number):
             ok = await self._wait_for_clients(required)
-            updates = await self.server.drain_updates()
+            if self._ingest_mode:
+                updates = []
+            else:
+                updates = await self.server.drain_updates()
+        if self._ingest_mode:
+            return await self._ingest_round_tail(round_number, required, ok)
         num_received = len(updates)
         num_rejected = 0
         if self.validation is not None and updates:
@@ -735,6 +763,51 @@ class NetworkCoordinator:
             record["evicted_stragglers"] = newly_evicted
         if record["status"] == "COMPLETED":
             self._log.info("round %d: %s", round_number, record["metrics"])
+        self.history.append(record)
+        return record
+
+    async def _ingest_round_tail(
+        self, round_number: int, required: int, ok: bool
+    ) -> dict[str, Any]:
+        """Sync-round completion on the batched-ingest path: ONE jitted reduce
+        over the device buffer replaces drain + host stack + per-leaf mean.
+        Weighted FedAvg semantics are identical (the weighted mean of deltas
+        against the round's shared base IS the weighted mean of params); the
+        round record keeps the per-submit shape so telemetry consumers and the
+        straggler-eviction accounting see no difference."""
+        with self._tracer.span("aggregate", round=round_number, ingest=True):
+            new_flat, metas = await self.server.drain_ingest_fedavg()
+        newly_evicted = self._note_participation({m.client_id for m in metas})
+        if not ok or len(metas) < required:
+            self._log.warning(
+                "round %d FAILED: %d/%d batched updates",
+                round_number, len(metas), required,
+            )
+            record: dict[str, Any] = {
+                "round": round_number, "status": "FAILED",
+                "num_clients": len(metas), "num_rejected": 0,
+                "required": required, "ingest": True,
+            }
+            if newly_evicted:
+                record["evicted_stragglers"] = newly_evicted
+            self.history.append(record)
+            return record
+        self.params = self._flat_unravel(new_flat)
+        wsum = sum(m.weight for m in metas)
+        round_metrics = {
+            "loss": sum(_metric(m.metrics, "loss", 0.0) * m.weight
+                        for m in metas) / wsum,
+            "accuracy": sum(_metric(m.metrics, "accuracy", 0.0) * m.weight
+                            for m in metas) / wsum,
+        }
+        record = {
+            "round": round_number, "status": "COMPLETED",
+            "num_clients": len(metas), "num_rejected": 0,
+            "metrics": round_metrics, "required": required, "ingest": True,
+        }
+        if newly_evicted:
+            record["evicted_stragglers"] = newly_evicted
+        self._log.info("round %d (batched ingest): %s", round_number, round_metrics)
         self.history.append(record)
         return record
 
@@ -826,40 +899,86 @@ class NetworkCoordinator:
                     got = await self._wait_for_buffer(k)
                     # Exactly K per aggregation (surplus stays buffered for the next
                     # one) — "buffer of K" means K, or the update-budget accounting
-                    # lies.
-                    updates = await self.server.take_updates(k)
-                if not updates:
+                    # lies.  The batched-ingest drain enforces the same K below.
+                    updates = (
+                        [] if self._ingest_mode
+                        else await self.server.take_updates(k)
+                    )
+                if not updates and not (self._ingest_mode and got):
                     record = {"aggregation": agg_i, "version": version,
                               "status": "FAILED", "num_clients": 0,
                               "reason": f"timeout with an empty buffer (wanted {k})"}
                     self._log.warning("aggregation %d FAILED: empty buffer", agg_i)
+                elif self._ingest_mode:
+                    # Batched path: ONE jitted reduce of the K oldest buffered
+                    # deltas, staleness-discounted — numerically
+                    # fedbuff_combine to float tolerance, without K host-side
+                    # tree traversals per aggregation.
+                    try:
+                        with self._tracer.span("aggregate", aggregation=agg_i,
+                                               num_clients=got, ingest=True):
+                            new_flat, live, stats = (
+                                await self.server.drain_ingest_fedbuff(
+                                    k, version,
+                                    staleness_exponent=self.config.staleness_exponent,
+                                    server_lr=self.config.async_server_lr,
+                                )
+                            )
+                    except ValueError as e:
+                        record = self._async_stale_drain_record(agg_i, version, e)
+                    else:
+                        self.params = self._flat_unravel(new_flat)
+                        version += 1
+                        losses = [_metric(m.metrics, "loss", float("nan"))
+                                  for m in live]
+                        finite = [v for v in losses if math.isfinite(v)]
+                        record = {
+                            "aggregation": agg_i, "version": version,
+                            "status": "COMPLETED",
+                            "num_clients": stats["num_aggregated"],
+                            "buffered_at_drain": got, "ingest": True,
+                            "metrics": {"loss": float(np.mean(finite)) if finite
+                                        else None},
+                            **stats,
+                        }
+                        self._log.info(
+                            "aggregation %d -> version %d (batched ingest): %d "
+                            "updates, staleness %s",
+                            agg_i, version, stats["num_aggregated"],
+                            stats["staleness"],
+                        )
                 else:
                     # The server's published-version window is the single source of
                     # truth for which bases are still reconstructable — no
                     # coordinator-side copy whose pruning could silently diverge.
-                    with self._tracer.span("aggregate", aggregation=agg_i,
-                                           num_clients=len(updates)):
-                        self.params, stats = fedbuff_combine(
-                            self.params, updates, self.server.published_versions,
-                            version,
-                            staleness_exponent=self.config.staleness_exponent,
-                            server_lr=self.config.async_server_lr,
+                    try:
+                        with self._tracer.span("aggregate", aggregation=agg_i,
+                                               num_clients=len(updates)):
+                            new_params, stats = fedbuff_combine(
+                                self.params, updates, self.server.published_versions,
+                                version,
+                                staleness_exponent=self.config.staleness_exponent,
+                                server_lr=self.config.async_server_lr,
+                            )
+                    except ValueError as e:
+                        record = self._async_stale_drain_record(agg_i, version, e)
+                    else:
+                        self.params = new_params
+                        version += 1
+                        losses = [_metric(u.metrics, "loss", float("nan")) for u in updates]
+                        finite = [v for v in losses if math.isfinite(v)]
+                        record = {
+                            "aggregation": agg_i, "version": version,
+                            "status": "COMPLETED",
+                            "num_clients": stats["num_aggregated"],
+                            "buffered_at_drain": got,
+                            "metrics": {"loss": float(np.mean(finite)) if finite else None},
+                            **stats,
+                        }
+                        self._log.info(
+                            "aggregation %d -> version %d: %d updates, staleness %s",
+                            agg_i, version, stats["num_aggregated"], stats["staleness"],
                         )
-                    version += 1
-                    losses = [_metric(u.metrics, "loss", float("nan")) for u in updates]
-                    finite = [v for v in losses if math.isfinite(v)]
-                    record = {
-                        "aggregation": agg_i, "version": version,
-                        "status": "COMPLETED",
-                        "num_clients": stats["num_aggregated"],
-                        "buffered_at_drain": got,
-                        "metrics": {"loss": float(np.mean(finite)) if finite else None},
-                        **stats,
-                    }
-                    self._log.info(
-                        "aggregation %d -> version %d: %d updates, staleness %s",
-                        agg_i, version, stats["num_aggregated"], stats["staleness"],
-                    )
             self.history.append(record)
             duration = time.perf_counter() - t0
             self._m_rounds.inc(status=record["status"].lower())
@@ -877,6 +996,19 @@ class NetworkCoordinator:
         await self.server.publish_model(self.params, version)
         self.server.stop_training()
         return self.history
+
+    def _async_stale_drain_record(
+        self, agg_i: int, version: int, e: ValueError
+    ) -> dict[str, Any]:
+        """A drain whose every update's base left the version window (the
+        engine outran its clients) is a FAILED AGGREGATION, not a crashed
+        federation: the drained slots were consumed, the version does not
+        advance, and the next drain sees strictly newer arrivals — under
+        sustained overload this degrades to dropped stale work instead of
+        killing the round loop (the load harness routinely provokes it)."""
+        self._log.warning("aggregation %d FAILED: %s", agg_i, e)
+        return {"aggregation": agg_i, "version": version, "status": "FAILED",
+                "num_clients": 0, "reason": str(e)}
 
     async def run(self) -> list[dict[str, Any]]:
         """All rounds, then signal termination to polling clients.

@@ -1,0 +1,38 @@
+"""Batched device-resident ingest (ROADMAP item 2: serving-path throughput).
+
+The per-submit path (``communication.http_server``) buffers one decoded
+``ModelUpdate`` per client and aggregates them with a host-side stack + reduce
+per round — per-client Python tree work on the hot path, and the full decoded
+params of every buffered client resident in host memory.  At millions of
+clients the server tier, not the algorithm, is the bottleneck (the
+communication-perspective survey, arXiv:2405.20431, names buffered/batched
+ingestion as THE production pattern for that population).
+
+This package replaces that path with a FedBuff-style device-resident buffer:
+
+* :class:`DeviceIngestBuffer` — a preallocated ``[capacity, P]`` on-device
+  array of flattened client DELTAS with a slot bitmap and per-slot
+  weight/staleness, written one slot at a time by a donated
+  ``dynamic_update_slice`` jit and drained by ONE jit-compiled batched reduce
+  (``base + coefs @ buffer``) per aggregation — never one reduce per client.
+* :class:`IngestPipeline` — the asyncio-facing wrapper: a BOUNDED decode
+  worker pool (npz decompress + structure checks off the event loop), a
+  base-params flat cache per published version (delta computation and FedBuff
+  staleness both key off it), and the FedAvg / FedBuff drain policies as
+  coefficient vectors feeding the same reduce.
+
+Buffer-full converts to the existing 429 + Retry-After backpressure at the
+HTTP layer instead of unbounded queueing; ``nanofed_ingest_*`` metrics and the
+``docs/robustness.md`` admission semantics cover the operational surface.
+"""
+
+from nanofed_tpu.ingest.buffer import DeviceIngestBuffer, IngestConfig, SlotMeta
+from nanofed_tpu.ingest.pipeline import IngestPipeline, weight_from_metrics
+
+__all__ = [
+    "DeviceIngestBuffer",
+    "IngestConfig",
+    "IngestPipeline",
+    "SlotMeta",
+    "weight_from_metrics",
+]

@@ -1,0 +1,262 @@
+"""The asyncio-facing half of batched ingest: bounded decode pool + drains.
+
+The event loop must never decompress an npz body, verify an RSA signature, or
+walk a 100 MB pytree — and ``asyncio.to_thread``'s default executor is NOT a
+bound (its pool grows with concurrency).  :class:`IngestPipeline` owns a
+fixed-size worker pool sized by ``IngestConfig.decode_workers``; every
+CPU-bound submit stage (decode, reconstruct, signature verify, delta
+flattening) runs there, queue depth is observable
+(``nanofed_ingest_decode_queue_depth``), and the queue itself is bounded
+upstream by the server's ``max_inflight`` admission control.
+
+It also owns the per-version flat base cache: decoding a delta and computing a
+FedBuff staleness discount both need "the flat float32 params of version v",
+so ``note_version`` keeps exactly the published window the HTTP server keeps
+(sync mode: the current round only), and the two can never disagree about
+which bases are reconstructable.
+
+Every mutation of the buffer/bookkeeping goes through the owning server's
+asyncio lock — this class adds no second lock to reason about.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.ingest.buffer import DeviceIngestBuffer, IngestConfig, SlotMeta
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+
+__all__ = ["IngestPipeline", "weight_from_metrics"]
+
+
+def weight_from_metrics(metrics: Mapping[str, Any] | None) -> float:
+    """A client-supplied sample count as a safe FedAvg weight: same defensive
+    coercion as the round engine's ``_metric`` (clients control the metrics
+    JSON — a non-numeric, non-finite, or non-positive count falls back to 1.0
+    so one malicious client cannot zero the cohort's weight mass)."""
+    for key in ("num_samples", "samples_processed"):
+        if metrics and key in metrics:
+            try:
+                v = float(metrics[key])
+            except (TypeError, ValueError):
+                continue
+            if math.isfinite(v) and v > 0:
+                return v
+    return 1.0
+
+
+def flatten_params(params: Params) -> np.ndarray:
+    """Host-side flatten in EXACTLY ``tree_ravel``'s layout (leaves in tree
+    order, each raveled C-order, concatenated) — what makes a worker-thread
+    ``flat_params - flat_base`` subtraction land in the right buffer slots
+    without a host→device→host round trip per submit."""
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(
+        [np.asarray(leaf, np.float32).ravel() for leaf in leaves]
+    )
+
+
+class IngestPipeline:
+    """Bounded decode pool + device buffer + version base cache, as one unit.
+
+    Construction allocates the ``[capacity, P]`` device buffer and spawns the
+    worker pool; ``close()`` releases the pool.  The owning ``HTTPServer``
+    builds one lazily at the first ``publish_model`` (the params template
+    fixes P) and serializes every ``offer``/``drain_*``/``note_version`` under
+    its buffer lock."""
+
+    def __init__(
+        self,
+        template: Params,
+        config: IngestConfig,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self.config = config
+        self.buffer = DeviceIngestBuffer(
+            template, config.capacity, warm_batch=config.drain_batch
+        )
+        self._executor = ThreadPoolExecutor(
+            max_workers=config.decode_workers,
+            thread_name_prefix="nanofed-ingest-decode",
+        )
+        self._version_flat: dict[int, np.ndarray] = {}
+        self._queue_depth = 0
+        self._busy_s = 0.0
+        self._busy_lock = threading.Lock()  # += from concurrent pool workers
+        reg = registry or get_registry()
+        self._m_fill = reg.gauge(
+            "nanofed_ingest_buffer_fill",
+            "Occupied slots in the device-resident ingest buffer",
+        )
+        self._m_offers = reg.counter(
+            "nanofed_ingest_offers_total",
+            "Buffer offers by result (accepted / replaced / buffer_full)",
+            labels=("result",),
+        )
+        self._m_drains = reg.counter(
+            "nanofed_ingest_drains_total",
+            "Batched-reduce drains by policy (fedavg / fedbuff)",
+            labels=("policy",),
+        )
+        self._m_batch = reg.histogram(
+            "nanofed_ingest_drain_batch_size",
+            "Client deltas folded per batched-reduce drain",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._m_decode_s = reg.histogram(
+            "nanofed_ingest_decode_seconds",
+            "Wall time per decode-pool job (decode/verify/flatten)",
+        )
+        self._m_queue = reg.gauge(
+            "nanofed_ingest_decode_queue_depth",
+            "Submit-pipeline jobs queued or running in the bounded decode pool",
+        )
+        self._m_bytes = reg.gauge(
+            "nanofed_ingest_device_bytes",
+            "Bytes preallocated for the device-resident ingest buffer",
+        )
+        self._m_bytes.set(self.buffer.device_bytes)
+
+    # ------------------------------------------------------------------
+    # Bounded decode pool
+    # ------------------------------------------------------------------
+
+    async def run_decode(
+        self, fn: Callable[..., Any], *args: Any, **kwargs: Any
+    ) -> Any:
+        """Run one CPU-bound submit stage on the bounded pool, off the event
+        loop.  Worker wall time lands in ``nanofed_ingest_decode_seconds``
+        (its sum over the pool size is the utilization the load harness
+        reports); exceptions propagate to the caller unchanged."""
+        import asyncio
+
+        def timed() -> Any:
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                dt = time.perf_counter() - t0
+                with self._busy_lock:
+                    self._busy_s += dt
+                self._m_decode_s.observe(dt)
+
+        loop = asyncio.get_running_loop()
+        self._queue_depth += 1
+        self._m_queue.set(self._queue_depth)
+        try:
+            return await loop.run_in_executor(self._executor, timed)
+        finally:
+            self._queue_depth -= 1
+            self._m_queue.set(self._queue_depth)
+
+    def decode_busy_seconds(self) -> float:
+        """Total worker-busy wall seconds since construction (utilization =
+        busy / (decode_workers * elapsed))."""
+        return self._busy_s
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------
+    # Version base cache (delta computation + FedBuff window)
+    # ------------------------------------------------------------------
+
+    def note_version(
+        self, round_number: int, params: Params, window: int = 0
+    ) -> None:
+        """Record version ``round_number``'s flat base and prune to the
+        staleness ``window`` (0 = sync: only the current round's base is
+        reconstructable, matching the server's acceptance rule)."""
+        self._version_flat[int(round_number)] = flatten_params(params)
+        floor = int(round_number) - max(0, int(window))
+        for old in [v for v in self._version_flat if v < floor]:
+            del self._version_flat[old]
+
+    def base_flat(self, round_number: int) -> np.ndarray | None:
+        return self._version_flat.get(int(round_number))
+
+    # ------------------------------------------------------------------
+    # Buffer facade (called under the server's lock)
+    # ------------------------------------------------------------------
+
+    @property
+    def fill(self) -> int:
+        return self.buffer.fill
+
+    def offer(
+        self,
+        flat_delta: Any,
+        *,
+        client_id: str,
+        round_number: int,
+        metrics: Mapping[str, Any] | None = None,
+    ) -> int | None:
+        replaced = self.buffer.has_client(client_id)
+        slot = self.buffer.offer(
+            flat_delta,
+            client_id=client_id,
+            round_number=round_number,
+            weight=weight_from_metrics(metrics),
+            metrics=metrics or {},
+        )
+        if slot is None:
+            self._m_offers.inc(result="buffer_full")
+        else:
+            self._m_offers.inc(result="replaced" if replaced else "accepted")
+        self._m_fill.set(self.buffer.fill)
+        return slot
+
+    def clear(self) -> int:
+        dropped = self.buffer.clear()
+        self._m_fill.set(0)
+        return dropped
+
+    def drain_fedavg(
+        self, base_round: int
+    ) -> tuple[jax.Array | None, list[SlotMeta]]:
+        """One batched-reduce FedAvg drain against version ``base_round``'s
+        cached flat base; returns ``(new_flat_params, metas)`` or
+        ``(None, [])`` on an empty buffer."""
+        base = self.base_flat(base_round)
+        if base is None:
+            raise ValueError(f"no cached base for round {base_round}")
+        out, metas = self.buffer.drain_fedavg(base)
+        if metas:
+            self._m_drains.inc(policy="fedavg")
+            self._m_batch.observe(len(metas))
+        self._m_fill.set(self.buffer.fill)
+        return out, metas
+
+    def drain_fedbuff(
+        self,
+        k: int,
+        current_version: int,
+        staleness_exponent: float = 0.5,
+        server_lr: float = 1.0,
+    ) -> tuple[jax.Array, list[SlotMeta], dict[str, Any]]:
+        """One batched-reduce FedBuff drain of the K oldest slots applied to
+        the CURRENT version's params; the cached version window is the
+        in-window authority (the same map the server's acceptance uses)."""
+        base = self.base_flat(current_version)
+        if base is None:
+            raise ValueError(f"no cached base for version {current_version}")
+        try:
+            out, metas, stats = self.buffer.drain_fedbuff(
+                k, current_version, self._version_flat, base,
+                staleness_exponent=staleness_exponent, server_lr=server_lr,
+            )
+        finally:
+            self._m_fill.set(self.buffer.fill)
+        self._m_drains.inc(policy="fedbuff")
+        self._m_batch.observe(len(metas))
+        return out, metas, stats

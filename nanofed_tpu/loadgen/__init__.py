@@ -1,0 +1,38 @@
+"""Synthetic client swarm load harness (ROADMAP item 2's measurement half).
+
+``nanofed_tpu.ingest`` makes the serving path batched; this package proves —
+with numbers — what the server tier sustains.  No real training happens: a
+:class:`SwarmConfig` describes a population of synthetic clients (canned,
+pre-encoded delta payloads of configurable skew; Poisson / uniform / burst
+arrival processes riding the injectable ``utils.clock.Clock``), and
+:func:`run_swarm` drives tens of thousands of concurrent submits against a
+LIVE ``HTTPServer`` with the production client retry semantics (exponential
+backoff + jitter, 429 ``Retry-After`` honored, idempotency keys).
+
+:func:`~nanofed_tpu.loadgen.harness.run_loadtest` packages the whole
+experiment — server + FedBuff round engine + swarm — and records p50/p99
+submit latency, server rounds/sec, decode-pool utilization, and 429/retry
+counts into a ``runs/loadtest_*.json`` artifact (plus a ``loadtest``
+telemetry record the ``metrics-summary`` CLI digests);
+:func:`~nanofed_tpu.loadgen.harness.run_loadtest_comparison` runs the
+per-submit and batched-ingest paths back to back on identical traffic.
+"""
+
+from nanofed_tpu.loadgen.harness import run_loadtest, run_loadtest_comparison
+from nanofed_tpu.loadgen.swarm import (
+    SwarmConfig,
+    SwarmResult,
+    latency_digest,
+    make_canned_payloads,
+    run_swarm,
+)
+
+__all__ = [
+    "SwarmConfig",
+    "SwarmResult",
+    "latency_digest",
+    "make_canned_payloads",
+    "run_loadtest",
+    "run_loadtest_comparison",
+    "run_swarm",
+]

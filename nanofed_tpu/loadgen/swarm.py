@@ -1,0 +1,421 @@
+"""The swarm itself: canned payloads, arrival processes, and the submit loop.
+
+Design constraints that shaped this module:
+
+* **No real training.**  A load test measures the SERVER tier; a swarm client
+  is a coroutine + a pre-encoded npz body.  Payload VALIDITY matters (the
+  server's decode/structure checks must run for real), payload CONTENT does
+  not — so a small pool of canned bodies (base params + seeded noise) is
+  shared across the whole population, and ten thousand clients cost ten
+  thousand coroutines, not ten thousand model copies.
+* **One logical submit = the production client contract.**  Each submit
+  carries a fresh idempotency key, re-sends the SAME bytes through retries,
+  honors 429 ``Retry-After`` as a backoff floor via the real ``RetryPolicy``
+  arithmetic, and treats protocol 400s as final for that round (a stale-round
+  400 refreshes the round and starts a NEW logical submit, exactly like a
+  straggler re-syncing).
+* **Time is injectable.**  Arrival offsets and backoff sleeps ride the
+  ``Clock``, so the smoke test runs the whole schedule on a ``VirtualClock``
+  in milliseconds of real time; LATENCY is always measured on the real
+  monotonic clock (it is a property of the server, not of the schedule).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import aiohttp
+import numpy as np
+
+from nanofed_tpu.communication.http_server import (
+    HEADER_CLIENT,
+    HEADER_METRICS,
+    HEADER_ROUND,
+    HEADER_SUBMIT,
+)
+from nanofed_tpu.communication.retry import RetryPolicy, parse_retry_after
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+__all__ = [
+    "SwarmConfig",
+    "SwarmResult",
+    "latency_digest",
+    "make_canned_payloads",
+    "run_swarm",
+]
+
+
+@dataclass(frozen=True)
+class SwarmConfig:
+    """One synthetic population.
+
+    ``arrival`` draws each client's first-submit offset: ``poisson`` (a
+    homogeneous process at ``arrival_rate`` submits/sec — exponential gaps),
+    ``uniform`` (the population spread evenly over ``num_clients /
+    arrival_rate`` seconds), or ``burst`` (everyone at t=0 — the thundering
+    herd admission control exists for).  ``weight_skew`` is the sigma of a
+    lognormal over the reported ``num_samples`` (0 = homogeneous clients);
+    ``canned_payloads`` sizes the shared pre-encoded body pool."""
+
+    num_clients: int = 1000
+    submits_per_client: int = 1
+    arrival: str = "poisson"
+    arrival_rate: float = 2000.0
+    weight_skew: float = 0.0
+    canned_payloads: int = 8
+    delta_scale: float = 1e-3
+    seed: int = 0
+    retry: RetryPolicy | None = field(
+        default_factory=lambda: RetryPolicy(
+            max_attempts=8, base_backoff_s=0.05, max_backoff_s=2.0,
+            budget_s=60.0, seed=0,
+        )
+    )
+    #: Max stale-round refreshes per client submit (each is a NEW logical
+    #: submit, so a bound keeps a terminating server from spinning clients).
+    max_stale_refreshes: int = 4
+    #: Sockets the shared connector may hold open; submits beyond it queue in
+    #: the connector (part of measured latency, as in production).  Bounded
+    #: well under typical fd ulimits so a 10k swarm runs on a laptop.
+    connector_limit: int = 512
+
+    def __post_init__(self) -> None:
+        if self.num_clients < 1:
+            raise ValueError("num_clients must be >= 1")
+        if self.submits_per_client < 1:
+            raise ValueError("submits_per_client must be >= 1")
+        if self.arrival not in ("poisson", "uniform", "burst"):
+            raise ValueError(f"unknown arrival process {self.arrival!r}")
+        if self.arrival_rate <= 0:
+            raise ValueError("arrival_rate must be > 0")
+        if self.canned_payloads < 1:
+            raise ValueError("canned_payloads must be >= 1")
+
+
+@dataclass
+class SwarmResult:
+    """Raw swarm outcome; :func:`latency_digest` turns it into the artifact's
+    latency block."""
+
+    latencies_s: list[float]
+    accepted: int = 0
+    duplicates: int = 0
+    rejected_429: int = 0  # 429 answers OBSERVED (each may be retried past)
+    retries: int = 0  # re-sent attempts across all submits
+    stale_refreshes: int = 0
+    failed: int = 0  # logical submits that never got a 200
+    terminated_early: int = 0  # submits abandoned because training ended
+    wall_s: float = 0.0
+
+
+def latency_digest(latencies_s: list[float]) -> dict[str, Any]:
+    """p50/p99/mean/max over the measured submit latencies (empty-safe)."""
+    if not latencies_s:
+        return {"count": 0, "p50_s": None, "p99_s": None, "mean_s": None,
+                "max_s": None}
+    xs = sorted(latencies_s)
+    n = len(xs)
+
+    def pct(p: float) -> float:
+        return xs[min(n - 1, int(math.ceil(p * n)) - 1)]
+
+    return {
+        "count": n,
+        "p50_s": round(pct(0.50), 6),
+        "p99_s": round(pct(0.99), 6),
+        "mean_s": round(math.fsum(xs) / n, 6),
+        "max_s": round(xs[-1], 6),
+    }
+
+
+def make_canned_payloads(
+    base_params: Params, config: SwarmConfig
+) -> list[bytes]:
+    """Pre-encode the shared body pool: ``canned_payloads`` variants of
+    ``base + N(0, delta_scale)``, npz-encoded once.  Structure/shape/dtype
+    match the template exactly, so every server-side validation barrier runs
+    for real on every submit — only the float content repeats."""
+    import jax
+
+    from nanofed_tpu.communication.codec import encode_params
+
+    rng = np.random.default_rng(config.seed)
+    bodies = []
+    for _ in range(config.canned_payloads):
+        noisy = jax.tree.map(
+            lambda leaf: np.asarray(leaf, np.float32)
+            + rng.normal(scale=config.delta_scale,
+                         size=np.shape(leaf)).astype(np.float32),
+            base_params,
+        )
+        bodies.append(encode_params(noisy))
+    return bodies
+
+
+def arrival_offsets(config: SwarmConfig) -> np.ndarray:
+    """Per-client first-submit offsets (seconds, sorted for poisson/uniform)."""
+    n = config.num_clients
+    rng = np.random.default_rng(config.seed + 1)
+    if config.arrival == "burst":
+        return np.zeros(n)
+    if config.arrival == "uniform":
+        return np.linspace(0.0, n / config.arrival_rate, n, endpoint=False)
+    gaps = rng.exponential(1.0 / config.arrival_rate, size=n)
+    return np.cumsum(gaps)
+
+
+class _RoundTracker:
+    """One status poller shared by the whole swarm: the server's current round
+    and liveness, refreshed every ``poll_s`` — ten thousand clients must not
+    mean ten thousand /status pollers."""
+
+    def __init__(self, session: aiohttp.ClientSession, url: str, clock: Clock,
+                 poll_s: float = 0.05) -> None:
+        self._session = session
+        self._url = url
+        self._clock = clock
+        self._poll_s = poll_s
+        self.round = 0
+        self.training_active = True
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        await self._refresh()
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                # A poller that died on its own exception must not re-raise
+                # out of run_swarm's cleanup and eat the measurement.
+                pass
+
+    async def _refresh(self) -> None:
+        try:
+            async with self._session.get(self._url) as resp:
+                if resp.status == 200:
+                    payload = await resp.json()
+                    self.round = int(payload.get("round", self.round))
+                    self.training_active = bool(
+                        payload.get("training_active", True)
+                    )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # Transient (timeout, disconnect, malformed body under overload);
+            # the next poll re-checks.  ANY escape would permanently kill the
+            # swarm's single shared poller — round would freeze and every
+            # later submit would stamp stale headers.
+            pass
+
+    async def _loop(self) -> None:
+        while self.training_active:
+            await self._clock.sleep(self._poll_s)
+            await self._refresh()
+
+
+async def _submit_once(
+    session: aiohttp.ClientSession,
+    update_url: str,
+    tracker: _RoundTracker,
+    body: bytes,
+    client_id: str,
+    seq: int,
+    weight: float,
+    config: SwarmConfig,
+    clock: Clock,
+    result: SwarmResult,
+    sem: asyncio.Semaphore,
+) -> None:
+    """One LOGICAL submit: same bytes + idempotency key through every retry,
+    a fresh key (and refreshed round) after a stale-round 400.
+
+    The round header is stamped when the request actually reaches the wire
+    (inside ``sem``, which caps in-flight submits at the connector limit) —
+    a real client builds its request when it sends it.  Stamping at
+    task-creation time instead would let ten thousand queued requests age
+    behind the connector and arrive carrying a round the server left long
+    ago: a self-inflicted stale-refresh storm that measures the QUEUE, not
+    the server."""
+    policy = config.retry
+    rng = policy.rng_for(client_id) if policy is not None else None
+    metrics_header = json.dumps(
+        {"num_samples": weight, "loss": 0.5, "accuracy": 0.5}
+    )
+    t0 = time.perf_counter()
+    for refresh in range(config.max_stale_refreshes + 1):
+        if not tracker.training_active:
+            result.terminated_early += 1
+            return
+        headers: dict[str, str] | None = None
+        submitted_round = tracker.round
+        deadline = (
+            clock.time() + policy.budget_s
+            if policy is not None and policy.budget_s is not None
+            else None
+        )
+        attempt = 1
+        while True:
+            retry_after = None
+            status = -1
+            duplicate = False
+            try:
+                async with sem:
+                    if headers is None:
+                        # First wire entry for this logical submit: stamp the
+                        # CURRENT round + key.  Retries re-send these exact
+                        # headers (the idempotency contract).
+                        submitted_round = tracker.round
+                        headers = {
+                            HEADER_CLIENT: client_id,
+                            HEADER_ROUND: str(submitted_round),
+                            HEADER_METRICS: metrics_header,
+                            HEADER_SUBMIT:
+                                f"{client_id}:{submitted_round}:{seq}:{refresh}",
+                        }
+                    async with session.post(
+                        update_url, data=body, headers=headers
+                    ) as resp:
+                        status = resp.status
+                        if status == 200:
+                            try:
+                                duplicate = bool(
+                                    (await resp.json()).get("duplicate")
+                                )
+                            except Exception:
+                                duplicate = False
+                        elif status == 429:
+                            result.rejected_429 += 1
+                            retry_after = parse_retry_after(
+                                resp.headers.get("Retry-After")
+                            )
+                        else:
+                            await resp.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                status = -1
+            if status == 200:
+                result.latencies_s.append(time.perf_counter() - t0)
+                if duplicate:
+                    result.duplicates += 1
+                else:
+                    result.accepted += 1
+                return
+            if status == 400:
+                # Protocol-final for THIS round: refresh and re-submit as a
+                # new logical submit (the straggler re-sync path).
+                break
+            retryable = status in (429, 502, 503, 504) or status == -1
+            if policy is None or not retryable or attempt >= policy.max_attempts:
+                result.failed += 1
+                return
+            delay = policy.backoff_s(attempt, rng, retry_after)
+            if deadline is not None and clock.time() + delay > deadline:
+                result.failed += 1
+                return
+            result.retries += 1
+            await clock.sleep(delay)
+            attempt += 1
+        # stale-round fallthrough: re-read the round before the next try
+        result.stale_refreshes += 1
+        if tracker.round == submitted_round:
+            await clock.sleep(0.05)
+    result.failed += 1
+
+
+def _record_swarm_metrics(result: SwarmResult, registry: Any) -> None:
+    """Publish the swarm's client-side numbers as ``nanofed_loadtest_*``
+    instruments, so one ``/metrics`` scrape (or registry snapshot) holds the
+    server wire counters NEXT TO the load they were measured under."""
+    lat = registry.histogram(
+        "nanofed_loadtest_submit_seconds",
+        "End-to-end latency per logical swarm submit (retries included)",
+        buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2, 5, 10, 30, 60),
+    )
+    for v in result.latencies_s:
+        lat.observe(v)
+    submits = registry.counter(
+        "nanofed_loadtest_submits_total",
+        "Swarm logical submits by outcome",
+        labels=("result",),
+    )
+    for result_name, count in (
+        ("accepted", result.accepted), ("duplicate", result.duplicates),
+        ("failed", result.failed), ("terminated", result.terminated_early),
+    ):
+        if count:
+            submits.inc(count, result=result_name)
+    retries = registry.counter(
+        "nanofed_loadtest_retries_total",
+        "Swarm submit attempts re-sent after a retryable failure",
+    )
+    if result.retries:
+        retries.inc(result.retries)
+
+
+async def run_swarm(
+    server_url: str,
+    base_params: Params,
+    config: SwarmConfig,
+    clock: Clock | None = None,
+    registry: Any | None = None,
+) -> SwarmResult:
+    """Drive the whole population against a live server; returns the raw
+    counts + latencies (published to ``registry`` as ``nanofed_loadtest_*``
+    when given).  Every client is one coroutine: sleep to its arrival offset,
+    then issue ``submits_per_client`` logical submits back to back."""
+    clock = clock or SYSTEM_CLOCK
+    bodies = make_canned_payloads(base_params, config)
+    offsets = arrival_offsets(config)
+    rng = np.random.default_rng(config.seed + 2)
+    weights = (
+        np.exp(rng.normal(0.0, config.weight_skew, config.num_clients)) * 10.0
+        if config.weight_skew > 0
+        else np.full(config.num_clients, 10.0)
+    )
+    result = SwarmResult(latencies_s=[])
+    connector = aiohttp.TCPConnector(limit=config.connector_limit)
+    timeout = aiohttp.ClientTimeout(total=300.0)
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession(
+        connector=connector, timeout=timeout
+    ) as session:
+        tracker = _RoundTracker(
+            session, server_url.rstrip("/") + "/status", clock
+        )
+        await tracker.start()
+        update_url = server_url.rstrip("/") + "/update"
+        # In-flight cap = the connector limit: requests are stamped (round,
+        # key) only once a slot frees, so headers are fresh at wire time.
+        sem = asyncio.Semaphore(config.connector_limit)
+
+        async def one_client(i: int) -> None:
+            await clock.sleep(float(offsets[i]))
+            for s in range(config.submits_per_client):
+                if not tracker.training_active:
+                    result.terminated_early += 1
+                    continue
+                await _submit_once(
+                    session, update_url, tracker, bodies[i % len(bodies)],
+                    f"swarm_{i}", s, float(weights[i]), config, clock, result,
+                    sem,
+                )
+
+        try:
+            await asyncio.gather(
+                *(one_client(i) for i in range(config.num_clients))
+            )
+        finally:
+            await tracker.stop()
+    result.wall_s = time.perf_counter() - t0
+    if registry is not None:
+        _record_swarm_metrics(result, registry)
+    return result

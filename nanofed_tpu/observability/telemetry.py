@@ -179,6 +179,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     round_durations: list[float] = []
     snapshot: dict[str, Any] | None = None
     program_profiles: dict[str, dict[str, Any]] = {}
+    loadtests: dict[str, dict[str, Any]] = {}
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -214,6 +215,19 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 }
+            elif rtype == "loadtest":
+                # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
+                # by serving path; last record per mode wins (a re-run
+                # supersedes) — same policy as program_profile above.
+                loadtests[str(rec.get("mode", "?"))] = {
+                    k: rec[k]
+                    for k in (
+                        "clients", "total_submits", "p50_s", "p99_s",
+                        "rounds_per_sec", "aggregations_completed",
+                        "http_429_total", "retries_total", "accepted",
+                    )
+                    if k in rec
+                }
 
     def _digest(durs: list[float]) -> dict[str, float]:
         durs = sorted(durs)
@@ -237,6 +251,10 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Compiled-program cost layer (observability.profiling): per-program
         # compiler FLOPs, peak device bytes, and the roofline verdict.
         out["program_profiles"] = dict(sorted(program_profiles.items()))
+    if loadtests:
+        # Load-harness layer (nanofed_tpu.loadgen): per-serving-path submit
+        # latency percentiles and server rounds/sec.
+        out["loadtests"] = dict(sorted(loadtests.items()))
     if snapshot is not None:
         headline = {}
         for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",

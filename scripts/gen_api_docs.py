@@ -55,6 +55,10 @@ MODULES = [
                        "nanofed_tpu.communication.network_coordinator"]),
     ("faults", ["nanofed_tpu.faults.plan",
                 "nanofed_tpu.faults.injector"]),
+    ("ingest", ["nanofed_tpu.ingest.buffer",
+                "nanofed_tpu.ingest.pipeline"]),
+    ("loadgen", ["nanofed_tpu.loadgen.swarm",
+                 "nanofed_tpu.loadgen.harness"]),
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry",

@@ -1,0 +1,65 @@
+"""Loadtest smoke (ISSUE 7 satellite): the swarm harness end to end, tier-1.
+
+A ~200-client swarm on a ``VirtualClock`` (arrival offsets and retry backoffs
+in virtual time — milliseconds of real time, deterministic seeds) drives BOTH
+serving paths; the artifact must parse, every latency percentile must be
+finite, no submit may be lost outright, and ``metrics-summary`` must digest
+the ``loadtest`` telemetry records.  This is what ``make loadtest-smoke`` and
+the CI job run."""
+
+import json
+import math
+from pathlib import Path
+
+from nanofed_tpu.loadgen import run_loadtest_comparison
+from nanofed_tpu.observability.telemetry import summarize_telemetry
+
+SWARM_CLIENTS = 200
+
+
+def test_loadtest_smoke(tmp_path):
+    artifact = run_loadtest_comparison(
+        modes=("per-submit", "ingest"),
+        out_dir=tmp_path,
+        telemetry_dir=tmp_path,
+        tag="smoke",
+        clients=SWARM_CLIENTS,
+        async_buffer_k=25,
+        arrival="poisson",
+        arrival_rate=5000.0,
+        max_inflight=128,
+        ingest_capacity=128,
+        round_timeout_s=60.0,
+        virtual_clock=True,
+        seed=0,
+    )
+    # The artifact on disk parses and is the same document we got back.
+    path = Path(artifact["artifact_path"])
+    assert path.name.startswith("loadtest_")
+    parsed = json.loads(path.read_text())
+    assert parsed["record_type"] == "loadtest"
+    assert set(parsed["modes"]) == {"per-submit", "ingest"}
+
+    for mode, rec in parsed["modes"].items():
+        lat = rec["submit_latency_s"]
+        assert lat["count"] > 0, mode
+        assert lat["p99_s"] is not None and math.isfinite(lat["p99_s"]), mode
+        assert lat["p50_s"] <= lat["p99_s"] <= lat["max_s"], mode
+        # Every logical submit resolved: accepted (or deduped) — 429s were
+        # retried through, nothing was lost outright.
+        assert rec["failed_submits"] == 0, mode
+        assert rec["accepted"] + rec["duplicates"] >= SWARM_CLIENTS, mode
+        assert rec["aggregations_completed"] > 0, mode
+        assert rec["rounds_per_sec"] is not None and rec["rounds_per_sec"] > 0
+        assert rec["clock"] == "virtual"
+    # The batched path's extra surfaces are recorded.
+    ingest_rec = parsed["modes"]["ingest"]
+    assert ingest_rec["decode_pool"] is not None
+    assert ingest_rec["ingest"]["capacity"] == 128
+
+    # metrics-summary digests the loadtest records like program_profile ones.
+    summary = summarize_telemetry(tmp_path / "telemetry.jsonl")
+    assert set(summary["loadtests"]) == {"per-submit", "ingest"}
+    for mode, digest in summary["loadtests"].items():
+        assert math.isfinite(digest["p99_s"]), mode
+        assert digest["clients"] == SWARM_CLIENTS

@@ -396,3 +396,134 @@ def test_signed_server_duplicate_fast_path_stays_authenticated():
     text = registry.render_prometheus()
     assert 'result="duplicate"' in text
     assert 'result="bad_signature"' in text
+
+
+# ---------------------------------------------------------------------------
+# Retry storms x batched device-resident ingest (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_dedupe_within_batch_and_across_drain_boundary():
+    """Batched ingest must preserve the idempotent-submit contract exactly:
+    a lost-ACK retry storm folds into the DEVICE buffer at most once (one
+    slot, not N), and duplicates straggling in AFTER a batched drain are
+    answered duplicate-200 without re-entering the next batch."""
+    from nanofed_tpu.ingest import IngestConfig
+    from nanofed_tpu.ingest.pipeline import flatten_params
+
+    params = _linear_params()
+    trained = jax.tree.map(lambda p: p + 1.0, params)
+    registry = MetricsRegistry()
+    schedule = ChaosSchedule(
+        FaultPlan(seed=7, events=(
+            FaultEvent(kind="ack_drop", round=0, client="c1", count=1),
+        )),
+        registry=registry,
+    )
+    port = PORT + 7
+
+    async def main():
+        server = HTTPServer(port=port, chaos=schedule, registry=registry,
+                            ingest=IngestConfig(capacity=4, batch_size=2))
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            async with HTTPClient(
+                f"http://127.0.0.1:{port}", "c1", timeout_s=10,
+                registry=registry,
+                retry=RetryPolicy(max_attempts=4, base_backoff_s=0.01, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                # Attempt 1 lands in the buffer, its ACK is severed; the
+                # retry (same key) must dedupe WITHIN the batch: one slot.
+                assert await c.submit_update(trained, {"num_samples": 4.0})
+                assert server.num_updates() == 1
+                # Batched drain consumes the slot; the aggregate is exactly
+                # base + delta (one fold of the single client's update).
+                new_flat, metas = await server.drain_ingest_fedavg()
+                assert [m.client_id for m in metas] == ["c1"]
+                np.testing.assert_allclose(
+                    np.asarray(new_flat), flatten_params(trained),
+                    rtol=1e-5, atol=1e-5,
+                )
+                assert server.num_updates() == 0
+                # ACROSS the drain boundary: the storm's stragglers are still
+                # deduped against the submit-key window — never re-buffered.
+                for _ in range(3):
+                    assert await c.resend_last_update()
+                assert server.num_updates() == 0
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    assert 'nanofed_faults_injected_total{kind="ack_drop"} 1' in text
+    assert 'result="duplicate"' in text
+    # Exactly one slot was ever written for the whole storm.
+    assert 'nanofed_ingest_offers_total{result="accepted"} 1' in text
+
+
+def test_topk8_buffer_full_429_folds_delta_exactly_once():
+    """Buffer-full backpressure composes with topk8 error feedback: a client
+    whose retries ALL bounce off a full ingest buffer (429s — the key is
+    never recorded) folds its whole delta into the residual EXACTLY once,
+    and the post-drain re-submit carries that mass once — no loss, no
+    double-count."""
+    from nanofed_tpu.ingest import IngestConfig
+    from nanofed_tpu.ingest.pipeline import flatten_params
+
+    params = _linear_params()
+    delta = jax.tree.map(lambda p: 0.02 * jnp.ones_like(p), params)
+    trained = jax.tree.map(jnp.add, params, delta)
+    filler = jax.tree.map(lambda p: p + 0.5, params)
+    registry = MetricsRegistry()
+    port = PORT + 8
+
+    async def main():
+        server = HTTPServer(port=port, registry=registry, retry_after_s=0.01,
+                            ingest=IngestConfig(capacity=1))
+        await server.start()
+        try:
+            await server.publish_model(params, round_number=0)
+            url = f"http://127.0.0.1:{port}"
+            async with HTTPClient(url, "filler", timeout_s=10,
+                                  registry=registry) as f:
+                await f.fetch_global_model(like=params)
+                assert await f.submit_update(filler, {"num_samples": 1.0})
+            assert server.num_updates() == 1  # buffer now FULL
+            async with HTTPClient(
+                url, "c1", timeout_s=10, registry=registry,
+                update_encoding="topk8-delta", topk_fraction=1.0,
+                retry=RetryPolicy(max_attempts=3, base_backoff_s=0.01, seed=0),
+            ) as c:
+                await c.fetch_global_model(like=params)
+                # Every attempt answers 429 (full): the LOGICAL submit fails,
+                # and the whole delta folds into the residual exactly once.
+                assert not await c.submit_update(trained, {"num_samples": 1.0})
+                assert c._pending_base is not None
+                for r, d in zip(jax.tree.leaves(c._residual),
+                                jax.tree.leaves(delta)):
+                    np.testing.assert_allclose(np.asarray(r), np.asarray(d),
+                                               atol=1e-3)
+                # The buffer still holds ONLY the filler (the key was never
+                # recorded, nothing was half-buffered).
+                assert server.num_updates() == 1
+                # Drain frees capacity; the re-submit measures zero post-fold
+                # training + the residual = the same mass, carried ONCE.
+                await server.drain_ingest_fedavg()
+                assert await c.submit_update(trained, {"num_samples": 1.0})
+                assert c._pending_base is None
+                new_flat, metas = await server.drain_ingest_fedavg()
+                assert [m.client_id for m in metas] == ["c1"]
+                np.testing.assert_allclose(
+                    np.asarray(new_flat), flatten_params(trained),
+                    rtol=1e-3, atol=1e-3,
+                )
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+    text = registry.render_prometheus()
+    # The full-buffer shed rode the admission-control surface: 429 + counter.
+    assert 'nanofed_http_429_total{endpoint="update"}' in text
+    assert 'result="ingest_full"' in text
