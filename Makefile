@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke autotune-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -39,6 +39,15 @@ chaos-smoke:
 # Tier-1-safe: virtual time, seconds of real time, seeded determinism.
 loadtest-smoke:
 	python -m pytest tests/integration/test_loadtest_smoke.py -q
+
+# Autotune smoke (nanofed_tpu.tuning): sweep a tiny MLP config space on CPU
+# with the compiler's cost model — a winner must be chosen via AOT analysis
+# alone (zero round executions), the ranked runs/autotune_*.json artifact must
+# parse with its scoring basis stated, the fused q8 aggregation epilogue must
+# show a measured bytes-accessed reduction in the catalog's cost table, and a
+# repeat sweep must hit the result cache with ZERO compiles.  Tier-1-safe.
+autotune-smoke:
+	python -m pytest tests/integration/test_autotune_smoke.py -q
 
 # Compile-only cost profile on CPU (observability.profiling): the `profile`
 # subcommand must produce a non-empty roofline table — single step, fused

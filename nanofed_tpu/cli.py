@@ -97,6 +97,23 @@ def _cmd_run(args: argparse.Namespace) -> int:
               f"(eps={args.dp_epsilon}, delta={args.dp_delta}) over {args.rounds} "
               "rounds (tight RDP accounting)", file=sys.stderr)
 
+    if args.autotune:
+        pinned = [
+            flag for flag, engaged in (
+                ("--client-chunk", args.client_chunk is not None),
+                ("--rounds-per-block", args.rounds_per_block != 1),
+                ("--model-shards", args.model_shards != 1),
+            ) if engaged
+        ]
+        if pinned:
+            # The tuner owns the swept knobs; a half-pinned sweep would silently
+            # override the operator's explicit choice (or vice versa).
+            print(f"error: --autotune cannot be combined with "
+                  f"{', '.join(pinned)} — the cost-model sweep picks those "
+                  "knobs; drop --autotune to set them by hand",
+                  file=sys.stderr)
+            return 2
+
     if args.model_shards != 1:
         # Same up-front courtesy as the other invalid combinations: validate
         # against the device count HERE (the one place that forces backend
@@ -141,8 +158,103 @@ def _cmd_run(args: argparse.Namespace) -> int:
         model_shards=args.model_shards,
         strict=args.strict,
         profile_programs=args.profile_programs,
+        autotune=args.autotune,
     )
     print(json.dumps(metrics, indent=2, default=str))
+    return 0
+
+
+def _cmd_sweep(args: argparse.Namespace) -> int:
+    """``profile --sweep``: run the compile-only autotune sweep (nanofed_tpu.
+    tuning) — lower every candidate round-program configuration, score it with
+    the compiler's cost model, and print the ranked table plus the fused-
+    epilogue bytes-accessed comparison.  Zero round executions; the full table
+    lands as ``<out-dir>/autotune_*.json`` and the sweep result is cached under
+    ``.jax_cache/`` so a repeat sweep compiles nothing."""
+    from nanofed_tpu.data import federate
+    from nanofed_tpu.experiments import load_datasets_for
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.trainer import TrainingConfig
+    from nanofed_tpu.tuning import (
+        AutotuneError,
+        PopulationSpec,
+        TuningSpace,
+        autotune,
+        format_candidate_table,
+    )
+
+    mdl = get_model(args.model)
+    train, _ = load_datasets_for(mdl, args.data_dir, args.train_size, args.seed)
+    client_data = federate(
+        train, num_clients=args.clients, scheme="iid",
+        batch_size=args.batch_size, seed=args.seed,
+    )
+    training = TrainingConfig(
+        batch_size=args.batch_size, local_epochs=args.epochs,
+        learning_rate=args.lr, compute_dtype=args.dtype,
+    )
+    pop = PopulationSpec.from_client_data(client_data)
+    num_rounds = max(args.rounds_per_block, 8)
+    # Explicit --client-chunk / --model-shards pin that axis of the sweep to a
+    # single value (the same "pin via a single-valued space" mechanism
+    # Coordinator.from_autotune documents) — never silently ignored.
+    pins = {}
+    if args.client_chunk is not None:
+        pins["client_chunks"] = (args.client_chunk,)
+    if args.model_shards != 1:
+        pins["model_shards"] = (args.model_shards,)
+    space = None
+    if pins:
+        import dataclasses
+
+        import jax
+
+        space = dataclasses.replace(
+            TuningSpace.default(
+                pop, len(jax.devices()), training.batch_size, num_rounds
+            ),
+            **pins,
+        )
+    telemetry = None
+    if args.telemetry_dir is not None:
+        from nanofed_tpu.observability import RunTelemetry
+
+        telemetry = RunTelemetry(args.telemetry_dir)
+    try:
+        result = autotune(
+            mdl, pop, training,
+            participation=args.participation,
+            num_rounds=num_rounds,
+            space=space,
+            telemetry=telemetry,
+            force=args.force_sweep,
+        )
+    except AutotuneError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+        return 0
+    print(format_candidate_table(result))
+    epi = result.epilogues
+    if epi and "error" not in epi:
+        print()
+        for path in ("q8", "validated"):
+            cmp = epi[path]
+            pct = cmp.get("bytes_accessed_reduction_pct")
+            print(
+                f"{path} epilogue: fused {cmp['fused_bytes_accessed']:,.0f} "
+                f"bytes vs unfused {cmp['unfused_bytes_accessed']:,.0f} bytes"
+                + (f" ({pct:+.1f}% reduction)" if pct is not None else "")
+            )
+        print(f"epilogue basis: {epi['basis']}")
+    if result.cache_hit:
+        print("\n(cache hit: zero compiles this invocation)")
+    if result.artifact_path:
+        print(f"ranked table written to {result.artifact_path}")
     return 0
 
 
@@ -152,6 +264,9 @@ def _cmd_profile(args: argparse.Namespace) -> int:
     ``cost_analysis`` FLOPs, peak device bytes, arithmetic intensity, and the
     roofline verdict against the platform's peaks table (see
     ``observability.profiling`` and docs/performance.md)."""
+    if args.sweep:
+        return _cmd_sweep(args)
+
     import jax
 
     from nanofed_tpu.data import federate
@@ -620,6 +735,19 @@ def main(argv: list[str] | None = None) -> int:
         "transfer in the hot path raises instead of silently serializing it",
     )
     run.add_argument(
+        "--autotune", action="store_true",
+        help="let the COMPILER's cost model pick client_chunk / "
+        "rounds-per-block / mesh shape / batch size (nanofed_tpu.tuning): a "
+        "compile-only sweep lowers every candidate round program via AOT "
+        "cost_analysis/memory_analysis — ZERO round executions before the "
+        "first real round — scores by achievable roofline walltime on TPU "
+        "(bytes-accessed ordering on CPU, basis stated), rejects candidates "
+        "over the device HBM budget, writes the ranked table as "
+        "<out-dir>/autotune_*.json, and caches the result under .jax_cache/ "
+        "so repeat runs compile nothing. Incompatible with explicit "
+        "--client-chunk/--rounds-per-block/--model-shards",
+    )
+    run.add_argument(
         "--profile-programs", action="store_true",
         help="profile every built round program at construction (XLA "
         "cost_analysis/memory_analysis + roofline verdict): reports land in "
@@ -784,6 +912,20 @@ def main(argv: list[str] | None = None) -> int:
     profile.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     profile.add_argument("--no-scaffold", action="store_true",
                          help="skip the SCAFFOLD round program")
+    profile.add_argument(
+        "--sweep", action="store_true",
+        help="run the compile-only autotune sweep instead (nanofed_tpu."
+        "tuning): rank every candidate (client_chunk x rounds_per_block x "
+        "mesh shape x batch size) by the compiler's cost model, print the "
+        "ranked table + the fused-epilogue bytes-accessed comparison, and "
+        "write <out-dir>/autotune_*.json; zero round executions. Explicit "
+        "--client-chunk/--model-shards pin that axis to the given value",
+    )
+    profile.add_argument(
+        "--force-sweep", action="store_true",
+        help="with --sweep: ignore the cached sweep result and re-compile "
+        "every candidate",
+    )
     profile.add_argument("--json", action="store_true",
                          help="full report dicts as JSON instead of the table")
     profile.add_argument(

@@ -76,6 +76,7 @@ def run_experiment(
     model_shards: int = 1,
     strict: bool = False,
     profile_programs: bool = False,
+    autotune: bool = False,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -111,6 +112,13 @@ def run_experiment(
     ``cost_analysis``/``memory_analysis`` lands as ``nanofed_program_*`` gauges
     and telemetry ``program_profile`` records, and the summary carries the
     per-program roofline digest (see ``observability.profiling``).
+
+    ``autotune=True`` (CLI ``--autotune``) lets the COMPILER's cost model pick
+    ``client_chunk`` / ``rounds_per_block`` / ``mesh_shape`` / batch size via a
+    compile-only sweep (``nanofed_tpu.tuning``; zero round executions before the
+    first real round) — the ranked table lands as ``<out_dir>/autotune_*.json``
+    and the summary carries ``tuned_config``.  Refuses explicit values for the
+    swept knobs: the tuner owns them.
     """
     log = Logger()
     robust = None
@@ -133,39 +141,64 @@ def run_experiment(
         train, num_clients=num_clients, scheme=scheme, batch_size=batch_size,
         seed=seed, **scheme_kwargs,
     )
-    coordinator = Coordinator(
-        model=mdl,
-        train_data=client_data,
-        config=CoordinatorConfig(
-            num_rounds=num_rounds,
-            participation_rate=participation,
-            seed=seed,
-            base_dir=out_dir,
-            eval_every=eval_every,
-            lr_schedule=lr_schedule,
-            lr_min_factor=lr_min_factor,
-            lr_decay_every=lr_decay_every,
-            lr_decay_gamma=lr_decay_gamma,
-            rounds_per_block=rounds_per_block,
-            client_metrics_every=client_metrics_every,
-            profile_programs=profile_programs,
-        ),
-        training=TrainingConfig(
-            batch_size=batch_size,
-            local_epochs=local_epochs,
-            learning_rate=learning_rate,
-            prox_mu=prox_mu,
-            compute_dtype=compute_dtype,
-        ),
+    coordinator_config = CoordinatorConfig(
+        num_rounds=num_rounds,
+        participation_rate=participation,
+        seed=seed,
+        base_dir=out_dir,
+        eval_every=eval_every,
+        lr_schedule=lr_schedule,
+        lr_min_factor=lr_min_factor,
+        lr_decay_every=lr_decay_every,
+        lr_decay_gamma=lr_decay_gamma,
+        rounds_per_block=rounds_per_block,
+        client_metrics_every=client_metrics_every,
+        profile_programs=profile_programs,
+    )
+    training_config = TrainingConfig(
+        batch_size=batch_size,
+        local_epochs=local_epochs,
+        learning_rate=learning_rate,
+        prox_mu=prox_mu,
+        compute_dtype=compute_dtype,
+    )
+    shared_kwargs: dict[str, Any] = dict(
         eval_data=pack_eval(test, batch_size=256),
         central_privacy=central_privacy,
-        client_chunk=client_chunk,
         robust=robust,
         scaffold=scaffold,
         telemetry_dir=telemetry_dir,
-        mesh_shape=mesh_shape,
         strict=strict,
     )
+    if autotune:
+        pinned = [
+            name for name, engaged in (
+                ("client_chunk", client_chunk is not None),
+                ("rounds_per_block", rounds_per_block != 1),
+                ("model_shards", model_shards != 1),
+            ) if engaged
+        ]
+        if pinned:
+            from nanofed_tpu.core.exceptions import NanoFedError
+
+            raise NanoFedError(
+                f"autotune=True owns {', '.join(pinned)} — drop the explicit "
+                "value(s) or tune by hand without --autotune"
+            )
+        coordinator = Coordinator.from_autotune(
+            mdl, client_data, coordinator_config, training=training_config,
+            **shared_kwargs,
+        )
+    else:
+        coordinator = Coordinator(
+            model=mdl,
+            train_data=client_data,
+            config=coordinator_config,
+            training=training_config,
+            client_chunk=client_chunk,
+            mesh_shape=mesh_shape,
+            **shared_kwargs,
+        )
     rounds = coordinator.run()
     final_eval = coordinator.evaluate()
     completed = [r for r in rounds if r.status == RoundStatus.COMPLETED]
@@ -181,6 +214,8 @@ def run_experiment(
     return {
         **({"privacy_spent": privacy_summary} if privacy_summary else {}),
         **({"program_profiles": program_profiles} if program_profiles else {}),
+        **({"tuned_config": coordinator.tuned_config}
+           if coordinator.tuned_config is not None else {}),
         "model": model,
         "num_clients": num_clients,
         "rounds_completed": len(completed),
@@ -189,6 +224,13 @@ def run_experiment(
         "final_eval_metrics": final_eval,
         "round_durations_s": [r.duration_s for r in rounds],
         "devices": [str(d) for d in jax.devices()],
-        **({"mesh_shape": list(mesh_shape)} if mesh_shape is not None else {}),
+        # The REALIZED mesh (the tuner may have picked a 2-D layout).
+        **(
+            {"mesh_shape": [
+                int(coordinator.mesh.shape[n])
+                for n in coordinator.mesh.axis_names
+            ]}
+            if len(coordinator.mesh.axis_names) > 1 else {}
+        ),
         **({"strict": True} if strict else {}),
     }

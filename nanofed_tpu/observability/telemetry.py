@@ -180,6 +180,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     snapshot: dict[str, Any] | None = None
     program_profiles: dict[str, dict[str, Any]] = {}
     loadtests: dict[str, dict[str, Any]] = {}
+    autotunes: dict[str, dict[str, Any]] = {}
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -212,6 +213,20 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                         "rounds", "flops", "flops_per_round", "bytes_accessed",
                         "peak_bytes", "arithmetic_intensity", "verdict",
                         "lower_bound_s", "compile_seconds", "platform",
+                    )
+                    if k in rec
+                }
+            elif rtype == "autotune":
+                # Cost-model sweep outcome (nanofed_tpu.tuning), keyed by the
+                # sweep's cache key so re-sweeps of the same configuration
+                # supersede — same last-wins policy as program_profile.
+                autotunes[str(rec.get("cache_key", "?"))[:16]] = {
+                    k: rec[k]
+                    for k in (
+                        "winner", "scoring_basis", "platform", "device_kind",
+                        "num_devices", "candidates_total",
+                        "candidates_feasible", "cache_hit", "compiles",
+                        "compile_seconds_total", "best_score",
                     )
                     if k in rec
                 }
@@ -255,6 +270,10 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Load-harness layer (nanofed_tpu.loadgen): per-serving-path submit
         # latency percentiles and server rounds/sec.
         out["loadtests"] = dict(sorted(loadtests.items()))
+    if autotunes:
+        # Autotuner layer (nanofed_tpu.tuning): the winner config, scoring
+        # basis, and sweep economics per swept configuration.
+        out["autotunes"] = dict(sorted(autotunes.items()))
     if snapshot is not None:
         headline = {}
         for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",

@@ -11,7 +11,13 @@ hardware PRNG buys something XLA's pattern library doesn't express:
                       the clipped [C, P] intermediate never exists.
 * ``ops.quantize``  — fixed-point uint32 quantize / dequantize and seeded additive
                       masking (the SecAgg inner loop) with the on-core PRNG, so masking
-                      never round-trips to the host.
+                      never round-trips to the host; plus the fused q8/topk aggregation
+                      epilogue (``dequant_accumulate_flat``: the per-client dequant
+                      scale folds into the reduce coefficients, so the int8 stack is
+                      read once and the dequantized [C, P] float never exists).
+* ``ops.reduce`` also carries the fused validated-aggregation epilogue
+  (``masked_weighted_mean_flat``): non-finite sanitization + validity mask +
+  weighted reduce in one read pass instead of sanitize-write-reduce.
 
 Every op takes ``interpret=None`` (auto: real kernels on TPU, interpreter elsewhere) so
 the same code paths are exercised by the CPU-mesh test suite.
@@ -24,16 +30,23 @@ from nanofed_tpu.ops.dp_reduce import (
 )
 from nanofed_tpu.ops.quantize import (
     add_mask,
+    dequant_accumulate_flat,
     dequantize_u32,
     quantize_u32,
 )
-from nanofed_tpu.ops.reduce import weighted_mean_flat, weighted_mean_tree
+from nanofed_tpu.ops.reduce import (
+    masked_weighted_mean_flat,
+    weighted_mean_flat,
+    weighted_mean_tree,
+)
 
 __all__ = [
     "add_mask",
     "central_dp_reduce_stacked",
+    "dequant_accumulate_flat",
     "dequantize_u32",
     "dp_clipped_mean_flat",
+    "masked_weighted_mean_flat",
     "quantize_u32",
     "row_sq_norms",
     "weighted_mean_flat",

@@ -92,6 +92,96 @@ def dequantize_u32(
     return out.reshape(-1)[:n]
 
 
+# ---------------------------------------------------------------------------
+# Fused dequant + weighted accumulate (the q8/topk aggregation epilogue)
+# ---------------------------------------------------------------------------
+#
+# The compressed-aggregation path dequantizes int8 client deltas to float32 in one
+# program and reduces them in another (codec ``decode_delta_q8`` then the weighted
+# mean): the [C, P] float32 intermediate is written to and re-read from memory just
+# to be summed — at int8 payload q, that is 1 byte read + 4 written + 4 re-read per
+# element where 1 read suffices.  The fusion is algebraic, the same trick
+# ``ops.dp_reduce`` plays with clip coefficients: the per-client dequant scale is a
+# per-ROW multiplier, so it folds into the reduce weights exactly —
+#
+#     out[p] = base[p] + sum_c (w_c / denom) * s_c * q[c, p]
+#            = base[p] + coefs @ q,      coefs_c = w_c * s_c / denom  (an O(C) vector)
+#
+# — and the kernel reads the int8 stack ONCE, converts in VMEM, and contracts on the
+# MXU.  The dequantized [C, P] float32 array never exists in HBM.  The same kernel
+# serves the topk8 path (decoded dense int8 rows, zeros off the shipped
+# coordinates).  Registered next to its unfused counterpart in the autotuner's
+# program catalog (``tuning.epilogues``) so the bytes-accessed drop is a measured
+# row in the cost table, not a claim.
+
+_Q8_SUBLANES = 32  # int8 min tile is (32, 128): pad the client axis to full sublanes
+
+
+def _dequant_acc_kernel(coefs_ref, q_ref, base_ref, out_ref):
+    # q block: [C_pad, TILE] int8; coefs: [1, C_pad] (dequant scale folded in);
+    # base/out: [1, TILE].  One int8 read -> f32 convert in VMEM -> MXU contraction.
+    # HIGHEST precision for the same reason as ops.reduce._wmean_kernel: bf16 MXU
+    # passes would cost ~3 decimal digits on the aggregate.
+    x = q_ref[:].astype(jnp.float32)
+    acc = jax.lax.dot_general(
+        coefs_ref[:], x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out_ref[:] = base_ref[:] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequant_accumulate_flat(
+    q: jax.Array,
+    scales: jax.Array,
+    weights: jax.Array,
+    base: jax.Array,
+    denom: jax.Array | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused q8/topk aggregation epilogue: ``[C, P] int8 x [C] scales x [C] weights
+    + [P] base -> [P]`` in ONE pass over the quantized stack.
+
+    Computes ``base + Σ_c (w_c / denom) · s_c · q[c, :]`` — the weighted FedAvg
+    mean of dequantized client deltas applied to the published base — without ever
+    materializing the dequantized ``[C, P]`` float32 stack (the per-client scale
+    is a row multiplier, so it folds into the reduce coefficients).  ``denom``
+    defaults to ``Σ w`` (the weighted mean); pass an explicit denominator to reuse
+    pre-normalized coefficient vectors (e.g. FedBuff staleness discounts).
+
+    All-zero weights degenerate safely (denominator floored at 1e-12): the result
+    is ``base`` unchanged, matching the round engine's empty-round identity.
+    """
+    c, p = q.shape
+    if q.dtype != jnp.int8:
+        raise TypeError(f"q must be int8 (the wire dtype), got {q.dtype}")
+    w = weights.astype(jnp.float32)
+    denom = jnp.maximum(w.sum() if denom is None else denom, 1e-12)
+    coefs = w * scales.astype(jnp.float32) / denom
+    # Pad clients to full int8 sublanes (zero coef rows are exact no-ops) and
+    # columns to the lane tile.
+    c_pad = (-c) % _Q8_SUBLANES
+    lane_pad = (-p) % _LANES
+    qp = jnp.pad(q, ((0, c_pad), (0, lane_pad)))
+    basep = jnp.pad(base.astype(jnp.float32), (0, lane_pad))
+    coefsp = jnp.pad(coefs, (0, c_pad))
+    cp = c + c_pad
+    out = pl.pallas_call(
+        _dequant_acc_kernel,
+        grid=((p + lane_pad) // _LANES,),
+        in_specs=[
+            pl.BlockSpec((1, cp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((cp, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, p + lane_pad), jnp.float32),
+        interpret=auto_interpret(interpret),
+    )(coefsp[None, :], qp, basep[None, :])
+    return out[0, :p]
+
+
 def _mask_kernel(seed_ref, sign_ref, q_ref, out_ref):
     # Per-block stream: seed with (128-bit caller seed, block index) so every block
     # draws an independent deterministic stream — identical for both parties of a pair.

@@ -72,6 +72,71 @@ def weighted_mean_flat(
     return out[0, :p]
 
 
+# ---------------------------------------------------------------------------
+# Fused validation-mask + weighted-reduce epilogue
+# ---------------------------------------------------------------------------
+#
+# The validated aggregation path (``security.validation.stacked_leaf_stats`` +
+# weighted mean) touches the stacked [C, P] deltas twice: once to SANITIZE them
+# (non-finite -> 0, a [C, P] read + [C, P] write) and once to reduce the sanitized
+# stack.  The validity decision itself is O(C) — finiteness, norm bound, z-score
+# all collapse to a per-client mask — so the only [C, P]-sized work is sanitize +
+# reduce, and those fuse: sanitize in VMEM on the tile just read, contract on the
+# MXU, never write the sanitized stack back.  One read pass instead of
+# read + write + read.
+
+
+def _masked_wmean_kernel(coefs_ref, x_ref, out_ref):
+    # x block: [C, TILE] f32; coefs: [1, C] (validity mask folded into the
+    # normalized weights).  Sanitize IN VMEM (a rejected client's NaN/inf delta
+    # must not poison the contraction: 0 * inf = nan, so zero the VALUE, not just
+    # the weight), then one MXU pass.
+    x = x_ref[:]
+    y = jnp.where(jnp.isfinite(x), x, jnp.zeros_like(x))
+    out_ref[:] = jax.lax.dot_general(
+        coefs_ref[:], y, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_weighted_mean_flat(
+    x: jax.Array,
+    weights: jax.Array,
+    valid: jax.Array,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused validated-aggregation epilogue: ``[C, P] x [C] weights x [C] validity
+    -> [P]`` weighted mean over the VALID clients, with non-finite values
+    sanitized to zero inside the same pass.
+
+    Equivalent to ``weighted_mean_flat(sanitize(x), weights * valid)`` where
+    ``sanitize`` zeroes NaN/inf coordinates — but the sanitized ``[C, P]`` stack
+    is never materialized.  ``valid`` is any 0/1 (or boolean) per-client mask;
+    an all-invalid cohort degenerates to zeros (denominator floored), matching
+    the unfused path's empty-round behavior.
+    """
+    c, p = x.shape
+    w = weights.astype(jnp.float32) * valid.astype(jnp.float32)
+    denom = jnp.maximum(w.sum(), 1e-12)
+    coefs = w / denom
+    pad = (-p) % _TILE
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, pad)))
+    out = pl.pallas_call(
+        _masked_wmean_kernel,
+        grid=((p + pad) // _TILE,),
+        in_specs=[
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, p + pad), jnp.float32),
+        interpret=auto_interpret(interpret),
+    )(coefs[None, :], xp)
+    return out[0, :p]
+
+
 def weighted_mean_tree(
     stacked: Params, weights: jax.Array, interpret: bool | None = None
 ) -> Params:

@@ -147,6 +147,95 @@ class CoordinatorConfig:
 class Coordinator:
     """Drives federated training over a device mesh."""
 
+    @classmethod
+    def from_autotune(
+        cls,
+        model: Model,
+        train_data: ClientData,
+        config: CoordinatorConfig,
+        training: TrainingConfig | None = None,
+        *,
+        tuning_space=None,
+        hbm_budget_bytes: int | None = None,
+        autotune_cache_dir: str | Path | None = ".jax_cache",
+        autotune_force: bool = False,
+        **kwargs: Any,
+    ) -> "Coordinator":
+        """Build a coordinator with the configuration the COMPILER's cost model
+        picks (``nanofed_tpu.tuning``): the sweep lowers every candidate's round
+        program AOT — zero round executions — scores it by achievable roofline
+        walltime (TPU) or bytes-accessed ordering (CPU, basis stated), rejects
+        candidates over the device HBM budget, and the winner's ``client_chunk``
+        / ``rounds_per_block`` / ``mesh_shape`` / batch size replace the
+        defaults.  The ranked candidate table lands under ``config.base_dir`` as
+        ``autotune_*.json``; sweep results are cached (keyed by model
+        fingerprint, population, device kind/count), so repeat constructions
+        compile nothing.
+
+        The built coordinator carries ``tuned_config`` (the winner + provenance)
+        and ``autotune_result`` (the full :class:`~nanofed_tpu.tuning.
+        AutotuneResult`); an ``autotune`` record is appended to the run's
+        telemetry when telemetry is on.  Explicit ``client_chunk`` /
+        ``mesh_shape`` / ``mesh`` kwargs are refused — the tuner owns those
+        knobs here; pin an axis by passing a single-valued ``tuning_space``.
+        """
+        import dataclasses
+
+        from nanofed_tpu.parallel.mesh import mesh_shape_for_model_shards
+        from nanofed_tpu.trainer.config import TrainingConfig as _TC
+        from nanofed_tpu.tuning import PopulationSpec, autotune
+
+        clashing = [
+            k for k in ("client_chunk", "mesh_shape", "mesh") if k in kwargs
+        ]
+        if clashing:
+            raise NanoFedError(
+                f"from_autotune owns {', '.join(clashing)} — the tuner picks "
+                "them; pin an axis with a single-valued tuning_space instead"
+            )
+        training = training or _TC()
+        result = autotune(
+            model, PopulationSpec.from_client_data(train_data), training,
+            participation=config.participation_rate,
+            num_rounds=config.num_rounds,
+            eval_every=config.eval_every,
+            space=tuning_space,
+            hbm_budget_bytes=hbm_budget_bytes,
+            cache_dir=autotune_cache_dir,
+            out_dir=config.base_dir,
+            force=autotune_force,
+        )
+        winner = result.winner
+        import jax as _jax
+
+        coord = cls(
+            model,
+            train_data,
+            dataclasses.replace(
+                config, rounds_per_block=winner.rounds_per_block
+            ),
+            training=dataclasses.replace(
+                training, batch_size=winner.batch_size
+            ),
+            client_chunk=winner.client_chunk,
+            mesh_shape=mesh_shape_for_model_shards(
+                winner.model_shards, len(_jax.devices())
+            ),
+            **kwargs,
+        )
+        coord.autotune_result = result
+        coord.tuned_config = {
+            **winner.to_dict(),
+            "used": "tuned",
+            "scoring_basis": result.scoring_basis,
+            "cache_hit": result.cache_hit,
+            **({"artifact": result.artifact_path}
+               if result.artifact_path else {}),
+        }
+        if coord.telemetry is not None:
+            coord.telemetry.record("autotune", **result.telemetry_payload())
+        return coord
+
     def __init__(
         self,
         model: Model,
@@ -460,6 +549,10 @@ class Coordinator:
                 )
         self.current_round = 0
         self.history: list[RoundMetrics] = []
+        # Populated by from_autotune: the winner config + provenance, and the
+        # full sweep result.  None on hand-configured coordinators.
+        self.tuned_config: dict[str, Any] | None = None
+        self.autotune_result = None
 
         if self.strict:
             if self.scaffold:

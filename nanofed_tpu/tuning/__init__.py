@@ -1,0 +1,36 @@
+"""Compile-only autotuning: the compiler's cost model picks the round-program
+configuration (``client_chunk`` x ``rounds_per_block`` x ``mesh_shape`` x batch
+size) with ZERO round executions — see ``tuning.autotuner`` for the scoring
+bases and ``tuning.epilogues`` for the fused-aggregation cost comparison."""
+
+from nanofed_tpu.tuning.autotuner import (
+    AutotuneError,
+    AutotuneResult,
+    CandidateConfig,
+    CandidateOutcome,
+    PopulationSpec,
+    TuningSpace,
+    autotune,
+    format_candidate_table,
+    rank_candidates,
+    resolve_hbm_budget,
+)
+from nanofed_tpu.tuning.epilogues import (
+    profile_aggregation_epilogues,
+    register_epilogue_programs,
+)
+
+__all__ = [
+    "AutotuneError",
+    "AutotuneResult",
+    "CandidateConfig",
+    "CandidateOutcome",
+    "PopulationSpec",
+    "TuningSpace",
+    "autotune",
+    "format_candidate_table",
+    "profile_aggregation_epilogues",
+    "rank_candidates",
+    "register_epilogue_programs",
+    "resolve_hbm_budget",
+]

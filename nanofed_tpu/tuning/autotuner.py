@@ -1,0 +1,907 @@
+"""Cost-model-driven autotuning of the round-program configuration.
+
+PR 5 built the instrument — AOT ``cost_analysis``/``memory_analysis``, roofline
+verdicts, achievable lower-bound walltimes per :class:`~nanofed_tpu.observability.
+profiling.ProgramCostReport` — and until now nothing used it: ``client_chunk``,
+``rounds_per_block``, ``mesh_shape`` and the per-client batch size were hand-picked
+knobs.  FedJAX (arXiv:2108.02117) leaves them to the experimenter; FL_PyTorch
+(arXiv:2202.03099) treats simulator configuration as a first-class research knob.
+This module closes the instrument-to-actuator loop: the COMPILER's own cost model
+chooses the configuration, with zero round executions.
+
+The sweep lowers every candidate through the same ``build_round_step`` /
+``build_round_block`` builders the ``Coordinator`` dispatches — arguments are
+``jax.ShapeDtypeStruct``s carrying the dispatch shardings, so nothing
+materializes and nothing runs; the only cost is one XLA compile per candidate
+(cheap under the persistent compilation cache, and the sweep result itself is
+cached under ``.jax_cache/autotune_*.json`` keyed by model fingerprint,
+population, and device kind/count, so repeat runs compile NOTHING).
+
+Scoring is honest about its basis and never fabricates a peak:
+
+* **TPU** (a published peaks row exists): candidates are ranked by the roofline
+  **achievable walltime per round** — ``max(flops/peak_flops,
+  bytes/peak_bandwidth)`` of the per-device program, divided by the rounds the
+  program covers.
+* **CPU / unknown chips** (no peaks basis): candidates are ranked by **compiler
+  bytes accessed per round** — a relative ordering, NOT a walltime; the artifact
+  says so in its ``scoring_basis`` field.
+
+Candidates whose ``memory_analysis`` peak exceeds the device HBM budget are
+rejected (never ranked), with the budget's provenance stated.  The AOT cost model
+cannot see the per-round HOST tax (dispatch, ``block_until_ready``, metrics
+transfer) that ``rounds_per_block`` exists to amortize, so exact score ties break
+toward the larger block — the tie-break is stated in the artifact, deterministic,
+and last-resorts to the candidate key so equal sweeps rank identically.
+
+Every sweep emits a ranked candidate table as ``runs/autotune_*.json`` (the full
+table, rejected candidates included with their reasons) and, when telemetry is
+wired, an ``autotune`` record that ``nanofed-tpu metrics-summary`` digests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable
+
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = [
+    "AutotuneError",
+    "AutotuneResult",
+    "CandidateConfig",
+    "CandidateOutcome",
+    "PopulationSpec",
+    "TuningSpace",
+    "autotune",
+    "rank_candidates",
+    "resolve_hbm_budget",
+]
+
+_log = Logger()
+
+#: Published per-chip HBM capacities, matched like ``profiling.TPU_PEAKS`` (most
+#: specific substring first).  Used only when the runtime does not report a
+#: ``bytes_limit`` — CPU and unknown chips get NO budget rather than a made-up one.
+TPU_HBM_BYTES: tuple[tuple[str, int, str], ...] = (
+    ("v5 lite", 16 * 1024**3, "TPU v5e: 16 GiB HBM"),
+    ("v5e", 16 * 1024**3, "TPU v5e: 16 GiB HBM"),
+    ("v6 lite", 32 * 1024**3, "TPU v6e: 32 GiB HBM"),
+    ("v6e", 32 * 1024**3, "TPU v6e: 32 GiB HBM"),
+    ("v5p", 95 * 1024**3, "TPU v5p: 95 GiB HBM"),
+    ("v4", 32 * 1024**3, "TPU v4: 32 GiB HBM"),
+)
+
+
+class AutotuneError(NanoFedError):
+    """No feasible candidate survived the sweep (every configuration was
+    rejected); the artifact still records the full table with reasons."""
+
+
+@dataclass(frozen=True)
+class PopulationSpec:
+    """The client population's SHAPES — all the tuner needs to lower programs.
+
+    ``capacity`` is the packed per-client sample capacity (the ``[C, N, ...]``
+    second dim of ``ClientData``); candidate batch sizes must divide it, which is
+    exactly the constraint ``trainer.local`` enforces at dispatch."""
+
+    num_clients: int
+    capacity: int
+    sample_shape: tuple[int, ...]
+    x_dtype: str = "float32"
+    y_dtype: str = "int32"
+    mask_dtype: str = "float32"
+
+    @classmethod
+    def from_client_data(cls, data: Any) -> "PopulationSpec":
+        import numpy as np
+
+        x = data.x
+        return cls(
+            num_clients=int(x.shape[0]),
+            capacity=int(x.shape[1]),
+            sample_shape=tuple(int(d) for d in x.shape[2:]),
+            x_dtype=str(np.asarray(x[:1, :1]).dtype) if hasattr(x, "__getitem__")
+            else str(x.dtype),
+            y_dtype=str(np.asarray(data.y[:1, :1]).dtype)
+            if hasattr(data.y, "__getitem__") else str(data.y.dtype),
+            mask_dtype=str(np.asarray(data.mask[:1, :1]).dtype)
+            if hasattr(data.mask, "__getitem__") else str(data.mask.dtype),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True, order=True)
+class CandidateConfig:
+    """One point of the swept configuration space.  Ordered (field order) so the
+    deterministic last-resort tie-break is the dataclass ordering itself."""
+
+    client_chunk: int | None
+    rounds_per_block: int
+    model_shards: int
+    batch_size: int
+
+    @property
+    def key(self) -> tuple[int, int, int, int]:
+        """Stable sort key (``None`` chunk orders first as 0)."""
+        return (
+            self.client_chunk or 0, self.rounds_per_block,
+            self.model_shards, self.batch_size,
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "client_chunk": self.client_chunk,
+            "rounds_per_block": self.rounds_per_block,
+            "model_shards": self.model_shards,
+            "batch_size": self.batch_size,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "CandidateConfig":
+        return cls(
+            client_chunk=d.get("client_chunk"),
+            rounds_per_block=int(d["rounds_per_block"]),
+            model_shards=int(d["model_shards"]),
+            batch_size=int(d["batch_size"]),
+        )
+
+
+def _divisor_ladder(n: int, limit: int = 3) -> list[int]:
+    """Up to ``limit`` proper divisors of ``n``, spread across its range (small,
+    ~sqrt, large) — the interesting chunk sizes without a full divisor sweep."""
+    divs = [d for d in range(1, n) if n % d == 0]
+    if not divs:
+        return []
+    if len(divs) <= limit:
+        return divs
+    picks = {divs[0], divs[len(divs) // 2], divs[-1]}
+    return sorted(picks)[:limit]
+
+
+@dataclass(frozen=True)
+class TuningSpace:
+    """The candidate grid.  Build one explicitly, or derive a modest default from
+    the population/device geometry with :meth:`default` — the default keeps the
+    cross product small (a sweep pays one XLA compile per candidate)."""
+
+    client_chunks: tuple[int | None, ...]
+    rounds_per_blocks: tuple[int, ...]
+    model_shards: tuple[int, ...]
+    batch_sizes: tuple[int, ...]
+
+    @classmethod
+    def default(
+        cls,
+        population: PopulationSpec,
+        n_devices: int,
+        batch_size: int,
+        num_rounds: int,
+    ) -> "TuningSpace":
+        from nanofed_tpu.parallel.mesh import pad_client_count
+
+        per_dev = pad_client_count(population.num_clients, n_devices) // n_devices
+        chunks: list[int | None] = [None] + [
+            d for d in _divisor_ladder(per_dev, limit=2)
+        ]
+        rpbs = tuple(sorted({1, min(4, num_rounds), min(8, num_rounds)}))
+        shards = (1, 2) if n_devices % 2 == 0 and n_devices > 1 else (1,)
+        batches = tuple(sorted({
+            b for b in (batch_size // 2, batch_size, batch_size * 2)
+            if 1 <= b <= population.capacity and population.capacity % b == 0
+        })) or (batch_size,)
+        return cls(
+            client_chunks=tuple(chunks),
+            rounds_per_blocks=rpbs,
+            model_shards=shards,
+            batch_sizes=batches,
+        )
+
+    def candidates(self) -> list[CandidateConfig]:
+        out = []
+        for chunk in self.client_chunks:
+            for rpb in self.rounds_per_blocks:
+                for shards in self.model_shards:
+                    for b in self.batch_sizes:
+                        out.append(CandidateConfig(chunk, rpb, shards, b))
+        return sorted(set(out), key=lambda c: c.key)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "client_chunks": list(self.client_chunks),
+            "rounds_per_blocks": list(self.rounds_per_blocks),
+            "model_shards": list(self.model_shards),
+            "batch_sizes": list(self.batch_sizes),
+        }
+
+
+@dataclass
+class CandidateOutcome:
+    """One candidate's fate: a score (feasible) or a rejection reason, plus the
+    per-round cost summary the ranked table prints."""
+
+    config: CandidateConfig
+    feasible: bool
+    reject_reason: str | None = None
+    score: float | None = None
+    cost: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "config": self.config.to_dict(),
+            "feasible": self.feasible,
+            **({"reject_reason": self.reject_reason}
+               if self.reject_reason else {}),
+            **({"score": self.score} if self.score is not None else {}),
+            **({"cost": self.cost} if self.cost else {}),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "CandidateOutcome":
+        return cls(
+            config=CandidateConfig.from_dict(d["config"]),
+            feasible=bool(d["feasible"]),
+            reject_reason=d.get("reject_reason"),
+            score=d.get("score"),
+            cost=d.get("cost", {}),
+        )
+
+
+def rank_candidates(outcomes: Iterable[CandidateOutcome]) -> list[CandidateOutcome]:
+    """Deterministic ranking: feasible candidates by ascending score, exact ties
+    broken toward the LARGER ``rounds_per_block`` (the AOT cost model cannot see
+    the per-round host tax fused blocks amortize), then the smaller device-memory
+    peak, then the stable candidate key; rejected candidates follow in key order.
+
+    Pure — unit-testable without a single compile."""
+    outcomes = list(outcomes)
+    feasible = [o for o in outcomes if o.feasible]
+    rejected = [o for o in outcomes if not o.feasible]
+    feasible.sort(key=lambda o: (
+        o.score,
+        -o.config.rounds_per_block,
+        o.cost.get("peak_bytes", 0),
+        o.config.key,
+    ))
+    rejected.sort(key=lambda o: o.config.key)
+    return feasible + rejected
+
+
+def resolve_hbm_budget(
+    explicit: int | None = None, devices: list | None = None
+) -> tuple[int | None, str]:
+    """The per-device memory budget candidates must fit, with its provenance:
+    explicit argument > ``NANOFED_AUTOTUNE_HBM_BUDGET`` env > the runtime's
+    ``memory_stats()['bytes_limit']`` > the published per-chip HBM table > None
+    (no rejection — stated as unbounded, never a fabricated limit)."""
+    if explicit is not None:
+        return int(explicit), "explicit hbm_budget_bytes argument"
+    env = os.environ.get("NANOFED_AUTOTUNE_HBM_BUDGET")
+    if env:
+        return int(float(env)), "NANOFED_AUTOTUNE_HBM_BUDGET environment variable"
+    import jax
+
+    dev = (devices or jax.devices())[0]
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    limit = stats.get("bytes_limit")
+    if isinstance(limit, (int, float)) and limit > 0:
+        return int(limit), f"runtime memory_stats bytes_limit ({dev.device_kind})"
+    kind = str(getattr(dev, "device_kind", "")).lower()
+    for needle, cap, basis in TPU_HBM_BYTES:
+        if needle in kind:
+            return cap, basis
+    return None, (
+        f"unbounded — no device memory limit known for platform="
+        f"{dev.platform!r} ({dev.device_kind}); pass hbm_budget_bytes= or set "
+        "NANOFED_AUTOTUNE_HBM_BUDGET to enable rejection"
+    )
+
+
+@dataclass
+class AutotuneResult:
+    """The sweep's outcome: the winner, the full ranked table, and enough basis
+    fields that a reader of the artifact alone can audit the choice."""
+
+    winner: CandidateConfig | None
+    outcomes: list[CandidateOutcome]
+    scoring_basis: str
+    platform: str
+    device_kind: str
+    num_devices: int
+    hbm_budget_bytes: int | None
+    budget_basis: str
+    cache_key: str
+    cache_hit: bool = False
+    compiles: int = 0
+    compile_seconds_total: float = 0.0
+    space: dict[str, Any] = field(default_factory=dict)
+    population: dict[str, Any] = field(default_factory=dict)
+    epilogues: dict[str, Any] = field(default_factory=dict)
+    artifact_path: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "winner": self.winner.to_dict() if self.winner else None,
+            "candidates": [o.to_dict() for o in self.outcomes],
+            "scoring_basis": self.scoring_basis,
+            "tie_break": (
+                "exact score ties prefer larger rounds_per_block (AOT cost "
+                "cannot see the per-round host dispatch tax fused blocks "
+                "amortize), then smaller peak_bytes, then the candidate key"
+            ),
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "num_devices": self.num_devices,
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "budget_basis": self.budget_basis,
+            "cache_key": self.cache_key,
+            "cache_hit": self.cache_hit,
+            "compiles": self.compiles,
+            "compile_seconds_total": round(self.compile_seconds_total, 4),
+            "space": self.space,
+            "population": self.population,
+            **({"epilogues": self.epilogues} if self.epilogues else {}),
+        }
+
+    def telemetry_payload(self) -> dict[str, Any]:
+        """The ``autotune`` telemetry-record fields (what ``metrics-summary``
+        digests into its ``autotunes`` block)."""
+        feasible = [o for o in self.outcomes if o.feasible]
+        return {
+            "winner": self.winner.to_dict() if self.winner else None,
+            "scoring_basis": self.scoring_basis,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "num_devices": self.num_devices,
+            "candidates_total": len(self.outcomes),
+            "candidates_feasible": len(feasible),
+            "cache_key": self.cache_key,
+            "cache_hit": self.cache_hit,
+            "compiles": self.compiles,
+            "compile_seconds_total": round(self.compile_seconds_total, 4),
+            **({"best_score": feasible[0].score} if feasible else {}),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "AutotuneResult":
+        return cls(
+            winner=(
+                CandidateConfig.from_dict(d["winner"])
+                if d.get("winner") else None
+            ),
+            outcomes=[CandidateOutcome.from_dict(o) for o in d.get("candidates", [])],
+            scoring_basis=d.get("scoring_basis", "?"),
+            platform=d.get("platform", "?"),
+            device_kind=d.get("device_kind", "?"),
+            num_devices=int(d.get("num_devices", 0)),
+            hbm_budget_bytes=d.get("hbm_budget_bytes"),
+            budget_basis=d.get("budget_basis", "?"),
+            cache_key=d.get("cache_key", "?"),
+            cache_hit=bool(d.get("cache_hit", False)),
+            compiles=int(d.get("compiles", 0)),
+            compile_seconds_total=float(d.get("compile_seconds_total", 0.0)),
+            space=d.get("space", {}),
+            population=d.get("population", {}),
+            epilogues=d.get("epilogues", {}),
+        )
+
+
+def _model_fingerprint(model: Any) -> dict[str, Any]:
+    """Shape/dtype identity of the model's parameter tree (the cache-key
+    component): abstract init only, nothing materializes."""
+    import jax
+
+    from nanofed_tpu.persistence.serialization import tree_flatten_with_names
+
+    params_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    named, _ = tree_flatten_with_names(params_abs)
+    return {
+        "model": getattr(model, "name", type(model).__name__),
+        "leaves": [
+            [name, list(leaf.shape), str(leaf.dtype)] for name, leaf in named
+        ],
+    }
+
+
+def compute_cache_key(
+    model: Any,
+    population: PopulationSpec,
+    training: Any,
+    space: TuningSpace,
+    participation: float,
+    num_rounds: int,
+    eval_every: int,
+    device_kind: str,
+    num_devices: int,
+    hbm_budget: int | None = None,
+) -> str:
+    """SHA-256 over everything that changes a sweep's outcome: model fingerprint,
+    population shapes, the swept space, the non-swept training dims that shape
+    the program (epochs, dtype, prox), participation/rounds geometry, the device
+    kind/count, and the RESOLVED memory budget (the budget changes which
+    candidates are rejected, hence the winner).  Learning RATE is deliberately
+    excluded — it never changes the compiled program's cost."""
+    payload = {
+        "v": 2,
+        "hbm_budget": hbm_budget,
+        "model": _model_fingerprint(model),
+        "population": population.to_dict(),
+        "space": space.to_dict(),
+        "training": {
+            "local_epochs": getattr(training, "local_epochs", 1),
+            "compute_dtype": getattr(training, "compute_dtype", None),
+            "prox_mu": getattr(training, "prox_mu", 0.0),
+        },
+        "participation": participation,
+        "num_rounds": num_rounds,
+        "eval_every": eval_every,
+        "device_kind": device_kind,
+        "num_devices": num_devices,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _plan_layout(
+    num_clients: int,
+    n_client_shards: int,
+    participation: float,
+    client_chunk: int | None,
+) -> tuple[int, int, int, bool]:
+    """Mirror the ``Coordinator``'s step-layout rules exactly (padding, cohort
+    gathering, the chunk-divisibility fallback) so the lowered candidate IS the
+    program the coordinator would dispatch.  Returns ``(padded, step_clients,
+    cohort, cohort_mode)``."""
+    from nanofed_tpu.orchestration.types import cohort_size
+    from nanofed_tpu.parallel.mesh import pad_client_count
+
+    padded = pad_client_count(num_clients, n_client_shards)
+    cohort = cohort_size(num_clients, participation)
+    cohort_mode = cohort < num_clients
+    if cohort_mode and client_chunk is not None:
+        per_dev = pad_client_count(cohort, n_client_shards) // n_client_shards
+        if client_chunk < per_dev and per_dev % client_chunk != 0:
+            cohort_mode = False
+    step_clients = (
+        pad_client_count(cohort, n_client_shards) if cohort_mode else padded
+    )
+    return padded, step_clients, cohort, cohort_mode
+
+
+def _evaluate_candidate(
+    cand: CandidateConfig,
+    model: Any,
+    population: PopulationSpec,
+    training: Any,
+    participation: float,
+    num_rounds: int,
+    eval_every: int,
+    n_devices: int,
+    budget: int | None,
+) -> CandidateOutcome:
+    """Lower + compile ONE candidate's round program with fully abstract
+    (ShapeDtypeStruct) arguments in the dispatch shardings and score its cost
+    report.  Zero materialization, zero execution."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from nanofed_tpu.aggregation.base import fedavg_strategy
+    from nanofed_tpu.core.types import ClientData
+    from nanofed_tpu.observability.profiling import profile_program
+    from nanofed_tpu.parallel.mesh import (
+        client_sharding,
+        make_mesh,
+        param_sharding,
+    )
+    from nanofed_tpu.parallel.multi_round import (
+        build_round_block,
+        stack_round_keys,
+    )
+    from nanofed_tpu.parallel.round_step import build_round_step, init_server_state
+    from nanofed_tpu.trainer.local import stack_rngs
+
+    C, cap = population.num_clients, population.capacity
+
+    # --- Static feasibility (no compile) -------------------------------------
+    if cand.batch_size < 1 or cap % cand.batch_size != 0:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"batch_size {cand.batch_size} does not divide the packed "
+            f"per-client capacity {cap}"
+        ))
+    if cand.rounds_per_block > num_rounds:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"rounds_per_block {cand.rounds_per_block} exceeds num_rounds "
+            f"{num_rounds}"
+        ))
+    if (
+        cand.rounds_per_block > 1
+        and 0 < eval_every < cand.rounds_per_block
+    ):
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"rounds_per_block {cand.rounds_per_block} > eval_every "
+            f"{eval_every}: the coordinator would fall back to single rounds "
+            "(blocks are cut at eval boundaries)"
+        ))
+    if cand.model_shards < 1 or n_devices % cand.model_shards != 0:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"model_shards {cand.model_shards} does not divide the "
+            f"{n_devices} available devices"
+        ))
+    n_cs = n_devices // cand.model_shards
+    padded, step_clients, cohort, cohort_mode = _plan_layout(
+        C, n_cs, participation, cand.client_chunk
+    )
+    c_local = step_clients // n_cs
+    if (
+        cand.client_chunk is not None
+        and cand.client_chunk < c_local
+        and c_local % cand.client_chunk != 0
+    ):
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"client_chunk {cand.client_chunk} does not divide the "
+            f"per-device client count {c_local}"
+        ))
+
+    # --- Build + lower (compile; nothing executes) ---------------------------
+    training_c = dc.replace(training, batch_size=cand.batch_size)
+    mesh = make_mesh(
+        shape=(n_cs, cand.model_shards) if cand.model_shards > 1 else None
+    )
+    params_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    strategy = fedavg_strategy()
+    sos_abs = jax.eval_shape(lambda p: init_server_state(strategy, p), params_abs)
+
+    def _sharded_sds(tree, sharding_tree):
+        return jax.tree.map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh),
+            tree, sharding_tree,
+        )
+
+    params_sds = _sharded_sds(params_abs, param_sharding(mesh, params_abs))
+    sos_sds = _sharded_sds(sos_abs, param_sharding(mesh, sos_abs))
+    csh = client_sharding(mesh)
+
+    def _data_sds(rows: int) -> ClientData:
+        return ClientData(
+            x=jax.ShapeDtypeStruct(
+                (rows, cap, *population.sample_shape),
+                jnp.dtype(population.x_dtype), sharding=csh,
+            ),
+            y=jax.ShapeDtypeStruct(
+                (rows, cap), jnp.dtype(population.y_dtype), sharding=csh
+            ),
+            mask=jax.ShapeDtypeStruct(
+                (rows, cap), jnp.dtype(population.mask_dtype), sharding=csh
+            ),
+        )
+
+    name = (
+        f"cand_chunk{cand.client_chunk or 0}_rpb{cand.rounds_per_block}"
+        f"_m{cand.model_shards}_b{cand.batch_size}"
+    )
+    try:
+        if cand.rounds_per_block == 1:
+            fn = build_round_step(
+                model.apply, training_c, mesh, strategy,
+                client_chunk=cand.client_chunk, params_like=params_abs,
+                donate=True,
+            )
+            rngs_sds = jax.eval_shape(
+                lambda: stack_rngs(jax.random.key(0), step_clients)
+            )
+            args = (
+                params_sds, sos_sds, _data_sds(step_clients),
+                jax.ShapeDtypeStruct((step_clients,), jnp.float32),
+                rngs_sds, jax.ShapeDtypeStruct((), jnp.float32),
+            )
+        else:
+            rpb = cand.rounds_per_block
+            fn = build_round_block(
+                model.apply, training_c, mesh, strategy,
+                num_clients=C, padded_clients=padded,
+                step_clients=step_clients, cohort_size=cohort,
+                client_chunk=cand.client_chunk, params_like=params_abs,
+                collect_client_detail=False, cohort_mode=cohort_mode,
+                donate=True,
+            )
+            keys_sds = jax.eval_shape(
+                lambda: stack_round_keys(0, list(range(rpb)))
+            )
+            idx_sds = (
+                jax.ShapeDtypeStruct((rpb, step_clients), jnp.int32)
+                if cohort_mode else None
+            )
+            args = (
+                params_sds, sos_sds, _data_sds(padded),
+                jax.ShapeDtypeStruct((padded,), jnp.float32),
+                keys_sds, jax.ShapeDtypeStruct((rpb,), jnp.float32),
+                idx_sds,
+                jax.ShapeDtypeStruct((rpb, step_clients), jnp.float32),
+            )
+        report = profile_program(
+            name, fn, *args, rounds=cand.rounds_per_block,
+            attrs=cand.to_dict(),
+        )
+    except Exception as e:  # a candidate that cannot lower is rejected, not fatal
+        return CandidateOutcome(
+            cand, False, reject_reason=f"lowering/compile failed: {e}"
+        )
+
+    rounds = report.rounds
+    cost = {
+        "flops_per_round": report.flops / rounds,
+        "bytes_accessed_per_round": report.bytes_accessed / rounds,
+        "peak_bytes": report.peak_bytes,
+        "arithmetic_intensity": round(report.arithmetic_intensity, 4),
+        "verdict": report.verdict,
+        "compile_seconds": round(report.compile_seconds, 4),
+        "step_clients": step_clients,
+        "cohort_mode": cohort_mode,
+    }
+    if report.lower_bound_s is not None:
+        cost["lower_bound_s_per_round"] = report.lower_bound_s / rounds
+
+    if budget is not None and report.peak_bytes > budget:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"memory_analysis peak {report.peak_bytes:,} bytes exceeds the "
+            f"device HBM budget {budget:,} bytes"
+        ), cost=cost)
+
+    if report.peaks is not None:
+        score = report.lower_bound_s / rounds
+    else:
+        score = report.bytes_accessed / rounds
+    return CandidateOutcome(cand, True, score=score, cost=cost)
+
+
+def _scoring_basis(platform: str, has_peaks: bool, peaks_basis: str | None) -> str:
+    if has_peaks:
+        return (
+            "achievable walltime per round: the roofline lower bound "
+            "max(flops/peak_flops, bytes_accessed/peak_bandwidth) of the "
+            f"per-device program, divided by its rounds ({peaks_basis})"
+        )
+    return (
+        "bytes-accessed ordering: compiler cost_analysis bytes accessed per "
+        f"round, lower is better — platform={platform!r} has no published "
+        "peaks, so this is a relative ordering, NOT a predicted walltime"
+    )
+
+
+def autotune(
+    model: Any,
+    population: PopulationSpec | Any,
+    training: Any = None,
+    *,
+    participation: float = 1.0,
+    num_rounds: int = 1,
+    eval_every: int = 0,
+    space: TuningSpace | None = None,
+    hbm_budget_bytes: int | None = None,
+    cache_dir: str | Path | None = ".jax_cache",
+    out_dir: str | Path | None = "runs",
+    telemetry: Any = None,
+    force: bool = False,
+    include_epilogues: bool = True,
+) -> AutotuneResult:
+    """Sweep the round-program configuration space with the compiler's cost
+    model; returns the ranked :class:`AutotuneResult` (winner first).
+
+    ``population`` is a :class:`PopulationSpec` or a ``ClientData`` (shapes are
+    taken, data is never touched).  Zero round programs execute: every candidate
+    is lowered AOT with abstract arguments.  Results are cached under
+    ``cache_dir`` keyed by (model fingerprint, population, space, training dims,
+    device kind/count) — a cache hit compiles nothing; ``force=True`` re-sweeps.
+    Raises :class:`AutotuneError` when every candidate is rejected (the artifact
+    is still written first).
+    """
+    import jax
+
+    from nanofed_tpu.trainer.config import TrainingConfig
+
+    training = training or TrainingConfig()
+    if not isinstance(population, PopulationSpec):
+        population = PopulationSpec.from_client_data(population)
+    devices = jax.devices()
+    platform = str(devices[0].platform)
+    device_kind = str(getattr(devices[0], "device_kind", platform))
+    n_devices = len(devices)
+    if space is None:
+        space = TuningSpace.default(
+            population, n_devices, training.batch_size, num_rounds
+        )
+    budget, budget_basis = resolve_hbm_budget(hbm_budget_bytes, devices)
+    key = compute_cache_key(
+        model, population, training, space, participation, num_rounds,
+        eval_every, device_kind, n_devices, hbm_budget=budget,
+    )
+
+    cache_path = (
+        Path(cache_dir) / f"autotune_{key[:16]}.json"
+        if cache_dir is not None else None
+    )
+    if cache_path is not None and not force:
+        cached = _read_cache(cache_path, key)
+        # A winnerless entry is never written (below), but guard anyway: a
+        # cache hit must not short-circuit the all-rejected AutotuneError.
+        if cached is not None and cached.winner is not None:
+            cached.cache_hit = True
+            cached.compiles = 0
+            _log.info(
+                "autotune cache hit (%s): winner %s, zero compiles",
+                cache_path, cached.winner.to_dict(),
+            )
+            _finish(cached, out_dir, telemetry)
+            return cached
+    outcomes: list[CandidateOutcome] = []
+    compiles = 0
+    for cand in space.candidates():
+        outcome = _evaluate_candidate(
+            cand, model, population, training, participation, num_rounds,
+            eval_every, n_devices, budget,
+        )
+        if outcome.cost.get("compile_seconds") is not None:
+            compiles += 1
+        outcomes.append(outcome)
+        _log.info(
+            "autotune candidate %s: %s",
+            cand.to_dict(),
+            (f"score {outcome.score:.4g}" if outcome.feasible
+             else f"rejected ({outcome.reject_reason})"),
+        )
+
+    ranked = rank_candidates(outcomes)
+    feasible = [o for o in ranked if o.feasible]
+    has_peaks = any("lower_bound_s_per_round" in o.cost for o in feasible)
+    peaks_basis = None
+    if has_peaks:
+        from nanofed_tpu.observability.profiling import peaks_for_device_kind
+
+        peaks = peaks_for_device_kind(device_kind, platform)
+        peaks_basis = peaks.basis if peaks is not None else None
+    result = AutotuneResult(
+        winner=feasible[0].config if feasible else None,
+        outcomes=ranked,
+        scoring_basis=_scoring_basis(platform, has_peaks, peaks_basis),
+        platform=platform,
+        device_kind=device_kind,
+        num_devices=n_devices,
+        hbm_budget_bytes=budget,
+        budget_basis=budget_basis,
+        cache_key=key,
+        compiles=compiles,
+        compile_seconds_total=math.fsum(
+            o.cost.get("compile_seconds", 0.0) for o in outcomes
+        ),
+        space=space.to_dict(),
+        population=population.to_dict(),
+    )
+    if include_epilogues:
+        try:
+            from nanofed_tpu.tuning.epilogues import profile_aggregation_epilogues
+
+            flat = sum(
+                int(math.prod(leaf.shape) or 1)
+                for leaf in jax.tree.leaves(
+                    jax.eval_shape(lambda: model.init(jax.random.key(0)))
+                )
+            )
+            result.epilogues = profile_aggregation_epilogues(flat_size=flat)
+        except Exception as e:  # the sweep result must not die on the side table
+            result.epilogues = {"error": f"epilogue profiling failed: {e}"}
+
+    if cache_path is not None and result.winner is not None:
+        # Failed (all-rejected) sweeps are never cached: a later invocation
+        # must re-reject — and re-raise — rather than return winner=None.
+        _write_cache(cache_path, result)
+    _finish(result, out_dir, telemetry)
+    if result.winner is None:
+        raise AutotuneError(
+            "autotune found no feasible candidate: " + "; ".join(
+                f"{o.config.to_dict()} -> {o.reject_reason}" for o in ranked
+            )
+        )
+    _log.info(
+        "autotune winner: %s (%s)", result.winner.to_dict(), result.scoring_basis
+    )
+    return result
+
+
+def _read_cache(path: Path, key: str) -> AutotuneResult | None:
+    try:
+        with path.open() as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if d.get("cache_key") != key:
+        return None
+    try:
+        return AutotuneResult.from_dict(d)
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _write_cache(path: Path, result: AutotuneResult) -> None:
+    """Best-effort (an unwritable cache dir must not fail the sweep)."""
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(result.to_dict(), indent=2))
+        tmp.replace(path)
+    except OSError as e:
+        _log.warning("could not write autotune cache %s: %s", path, e)
+
+
+def _finish(
+    result: AutotuneResult, out_dir: str | Path | None, telemetry: Any
+) -> None:
+    """Emit the ranked-table artifact + the telemetry record (also on cache hits,
+    so every invocation leaves a fresh auditable table under runs/)."""
+    if out_dir is not None:
+        from nanofed_tpu.utils.dates import get_current_time
+
+        stamp = get_current_time().strftime("%Y%m%dT%H%M%S")
+        path = Path(out_dir) / f"autotune_{stamp}_{result.cache_key[:8]}.json"
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(result.to_dict(), indent=2))
+            result.artifact_path = str(path)
+        except OSError as e:
+            _log.warning("could not write autotune artifact %s: %s", path, e)
+    if telemetry is not None:
+        telemetry.record("autotune", **result.telemetry_payload())
+
+
+def format_candidate_table(result: AutotuneResult) -> str:
+    """Human-readable ranked table (what ``nanofed-tpu profile --sweep`` prints)."""
+    rows = [(
+        "rank", "chunk", "rpb", "shards", "batch", "score", "peak bytes",
+        "verdict",
+    )]
+    for i, o in enumerate(result.outcomes):
+        c = o.config
+        rows.append((
+            str(i + 1) if o.feasible else "-",
+            str(c.client_chunk or "-"), str(c.rounds_per_block),
+            str(c.model_shards), str(c.batch_size),
+            f"{o.score:.4g}" if o.score is not None else "-",
+            f"{o.cost.get('peak_bytes', 0):,}" if o.cost else "-",
+            o.cost.get("verdict", o.reject_reason or "-")
+            if not o.feasible else o.cost.get("verdict", "-"),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = []
+    for j, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    lines.append("")
+    lines.append(f"scoring basis: {result.scoring_basis}")
+    lines.append(
+        f"memory budget: "
+        + (f"{result.hbm_budget_bytes:,} bytes" if result.hbm_budget_bytes
+           else "none")
+        + f" ({result.budget_basis})"
+    )
+    if result.winner is not None:
+        lines.append(f"winner: {result.winner.to_dict()}")
+    rejected = [o for o in result.outcomes if not o.feasible]
+    for o in rejected:
+        lines.append(f"rejected {o.config.to_dict()}: {o.reject_reason}")
+    return "\n".join(lines)

@@ -63,6 +63,8 @@ MODULES = [
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry",
                        "nanofed_tpu.observability.profiling"]),
+    ("tuning", ["nanofed_tpu.tuning.autotuner",
+                "nanofed_tpu.tuning.epilogues"]),
     ("analysis", ["nanofed_tpu.analysis.fedlint",
                   "nanofed_tpu.analysis.contracts"]),
     ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",
@@ -118,7 +120,14 @@ def document_module(modname: str) -> str:
     for name, obj in vars(mod).items():
         if not _is_public(name):
             continue
-        if inspect.isclass(obj) or inspect.isfunction(obj):
+        # Plain classes/functions, plus functools.wraps'd wrapper objects —
+        # notably jax.jit callables (the Pallas ops are module-level jits):
+        # they carry the wrapped function's __module__/__doc__/signature, and
+        # skipping them silently dropped every kernel from the ops page.
+        wrapped_fn = inspect.isfunction(getattr(obj, "__wrapped__", None))
+        if inspect.isclass(obj) or inspect.isfunction(obj) or (
+            callable(obj) and wrapped_fn
+        ):
             if getattr(obj, "__module__", None) != modname:
                 continue  # re-exports documented at their home module
             members.append((name, obj))

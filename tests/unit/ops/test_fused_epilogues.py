@@ -1,0 +1,212 @@
+"""Fused aggregation-epilogue kernels: parity against the unfused paths they replace
+(interpret mode on the CPU mesh; the same code runs as real kernels on TPU).
+
+The q8/topk epilogue must reproduce codec-level aggregation — the weighted FedAvg
+mean of ``reconstruct_q8``'d client params — to float tolerance, and the validated
+epilogue must match sanitize-then-reduce exactly, including NaN/inf rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.communication.codec import (
+    Q8_QUANT_TAG,
+    Q8_SCALE_TAG,
+    encode_delta_q8,
+    encode_delta_topk8,
+    decode_delta_topk8,
+    reconstruct_q8,
+)
+from nanofed_tpu.ops import dequant_accumulate_flat, masked_weighted_mean_flat
+
+
+def _unfused_reference(q, scales, weights, base):
+    """The path the server runs today, as separate stages: dequantize the int8
+    stack to a materialized float array, then weighted-mean-reduce onto the base."""
+    dequant = q.astype(np.float32) * scales[:, None]  # the [C, P] intermediate
+    return base + (weights / weights.sum()) @ dequant
+
+
+class TestDequantAccumulate:
+    def test_matches_unfused_dequant_then_reduce(self):
+        rng = np.random.default_rng(0)
+        c, p = 9, 1333  # C not a sublane multiple, P not a lane multiple
+        q = rng.integers(-127, 128, size=(c, p), dtype=np.int8)
+        scales = rng.uniform(1e-4, 1e-2, size=c).astype(np.float32)
+        weights = rng.uniform(0.5, 2.0, size=c).astype(np.float32)
+        base = rng.normal(size=p).astype(np.float32)
+        got = dequant_accumulate_flat(
+            jnp.asarray(q), jnp.asarray(scales), jnp.asarray(weights),
+            jnp.asarray(base),
+        )
+        want = _unfused_reference(q, scales, weights, base)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_explicit_denominator(self):
+        # FedBuff-style pre-normalized coefficients: weights carry the staleness
+        # discount, denom is the aggregated count, NOT sum(weights).
+        rng = np.random.default_rng(1)
+        c, p = 4, 640
+        q = rng.integers(-127, 128, size=(c, p), dtype=np.int8)
+        scales = np.full(c, 1e-3, np.float32)
+        discounts = np.asarray([1.0, 0.7071, 0.5774, 0.5], np.float32)
+        base = np.zeros(p, np.float32)
+        got = dequant_accumulate_flat(
+            jnp.asarray(q), jnp.asarray(scales), jnp.asarray(discounts),
+            jnp.asarray(base), denom=jnp.float32(float(c)),
+        )
+        want = (discounts / c) @ (q.astype(np.float32) * scales[:, None])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_zero_weights_return_base_unchanged(self):
+        c, p = 3, 512
+        q = np.full((c, p), 77, np.int8)
+        got = dequant_accumulate_flat(
+            jnp.asarray(q), jnp.full(c, 1.0, jnp.float32),
+            jnp.zeros(c, jnp.float32), jnp.full(p, 2.5, jnp.float32),
+        )
+        np.testing.assert_allclose(np.asarray(got), 2.5, rtol=1e-6)
+
+    def test_rejects_non_int8(self):
+        import pytest
+
+        with pytest.raises(TypeError, match="int8"):
+            dequant_accumulate_flat(
+                jnp.zeros((2, 128), jnp.float32), jnp.ones(2), jnp.ones(2),
+                jnp.zeros(128),
+            )
+
+    def test_codec_level_q8_aggregation_parity(self):
+        """End to end against the wire format: encoding each client's delta with
+        ``encode_delta_q8`` and aggregating with the FUSED kernel must equal the
+        weighted mean of the ``reconstruct_q8``'d params (the unfused server
+        path), to float tolerance."""
+        import io
+
+        rng = np.random.default_rng(2)
+        c = 5
+        base_tree = {"w": rng.normal(size=(13, 7)).astype(np.float32),
+                     "b": rng.normal(size=(19,)).astype(np.float32)}
+        flat = lambda t: np.concatenate([np.ravel(t["w"]), np.ravel(t["b"])])
+        weights = rng.uniform(1.0, 3.0, size=c).astype(np.float32)
+
+        q_rows, scale_rows, unfused_params = [], [], []
+        p_total = flat(base_tree).size
+        for i in range(c):
+            delta = {k: rng.normal(size=v.shape).astype(np.float32) * 0.1
+                     for k, v in base_tree.items()}
+            payload = encode_delta_q8(delta, seed=100 + i)
+            # Unfused path: reconstruct full params per client (dequant + add).
+            unfused_params.append(flat(reconstruct_q8(base_tree, payload)))
+            # Fused path inputs: the raw int8 leaves + scales off the wire, in
+            # checkpoint-flat (tree_flatten_with_names) leaf order.
+            with np.load(io.BytesIO(payload)) as data:
+                row = np.zeros(p_total, np.int8)
+                scale_by_leaf = {}
+                # leaf offsets must match flat()'s concatenation order: w then b
+                offset_by_leaf = {"w": 0, "b": base_tree["w"].size}
+                for key in data.files:
+                    if key.endswith(Q8_QUANT_TAG):
+                        name = key[: -len(Q8_QUANT_TAG)]
+                        off = offset_by_leaf[name]
+                        vals = data[key].ravel()
+                        row[off: off + vals.size] = vals
+                    elif key.endswith(Q8_SCALE_TAG):
+                        scale_by_leaf[key[: -len(Q8_SCALE_TAG)]] = float(data[key])
+            # Per-leaf scales differ; express the row in a single scale by
+            # rescaling int8 counts into a shared float basis is lossy — instead
+            # aggregate per leaf below.  Here both leaves share a scale only by
+            # construction of this test when uniform; so run the kernel PER LEAF.
+            q_rows.append((row, scale_by_leaf))
+            scale_rows.append(scale_by_leaf)
+
+        # Aggregate per leaf with the fused kernel (per-leaf scales are exactly
+        # how the wire format defines them), concatenate, compare to the weighted
+        # mean of unfused reconstructions.
+        out = np.zeros(p_total, np.float32)
+        for name, off, size in (("w", 0, base_tree["w"].size),
+                                ("b", base_tree["w"].size, base_tree["b"].size)):
+            q_stack = np.stack([row[off: off + size] for row, _ in q_rows])
+            scales = np.asarray([s[name] for s in scale_rows], np.float32)
+            out[off: off + size] = np.asarray(dequant_accumulate_flat(
+                jnp.asarray(q_stack), jnp.asarray(scales), jnp.asarray(weights),
+                jnp.asarray(flat(base_tree)[off: off + size]),
+            ))
+        want = (weights / weights.sum()) @ np.stack(unfused_params)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_topk8_dense_rows_aggregate(self):
+        """The topk8 path decodes to DENSE int8-scaled rows (zeros off the shipped
+        coordinates) — the same fused kernel aggregates them."""
+        rng = np.random.default_rng(3)
+        base = {"w": np.zeros((40,), np.float32)}
+        weights = np.asarray([1.0, 1.0], np.float32)
+        deltas = [
+            {"w": rng.normal(size=(40,)).astype(np.float32)} for _ in range(2)
+        ]
+        dense = [
+            np.ravel(decode_delta_topk8(
+                encode_delta_topk8(d, fraction=0.2, seed=7 + i), like=base
+            )["w"])
+            for i, d in enumerate(deltas)
+        ]
+        want = np.mean(np.stack(dense), axis=0)
+        # Re-quantize the decoded dense rows into a shared int8 basis per row
+        # (scale = absmax/127) to drive the kernel; tolerance covers that round.
+        q_rows, scales = [], []
+        for row in dense:
+            s = max(float(np.max(np.abs(row))), 1e-12) / 127.0
+            q_rows.append(np.round(row / s).astype(np.int8))
+            scales.append(s)
+        got = dequant_accumulate_flat(
+            jnp.asarray(np.stack(q_rows)), jnp.asarray(scales, jnp.float32),
+            jnp.asarray(weights), jnp.zeros(40, jnp.float32),
+        )
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-2)
+
+
+class TestMaskedWeightedMean:
+    def test_matches_sanitize_then_reduce(self):
+        rng = np.random.default_rng(0)
+        c, p = 6, 900
+        x = rng.normal(size=(c, p)).astype(np.float32)
+        # Poison one INVALID row with NaN/inf and one VALID row with a single inf
+        # coordinate (finite-but-poisoned values must be zeroed, not averaged).
+        x[2, :] = np.nan
+        x[4, 10] = np.inf
+        weights = rng.uniform(0.5, 2.0, size=c).astype(np.float32)
+        valid = np.asarray([1, 1, 0, 1, 1, 0], np.float32)
+        got = masked_weighted_mean_flat(
+            jnp.asarray(x), jnp.asarray(weights), jnp.asarray(valid)
+        )
+        sanitized = np.where(np.isfinite(x), x, 0.0)
+        w = weights * valid
+        want = (w / w.sum()) @ sanitized
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_all_invalid_degenerates_to_zeros(self):
+        x = jnp.ones((3, 600), jnp.float32)
+        got = masked_weighted_mean_flat(
+            x, jnp.ones(3, jnp.float32), jnp.zeros(3, jnp.float32)
+        )
+        np.testing.assert_allclose(np.asarray(got), 0.0, atol=1e-9)
+
+    def test_boolean_mask_accepted(self):
+        x = jnp.stack([jnp.full((512,), 2.0), jnp.full((512,), 6.0)])
+        got = masked_weighted_mean_flat(
+            x, jnp.ones(2, jnp.float32), jnp.asarray([True, False])
+        )
+        np.testing.assert_allclose(np.asarray(got), 2.0, rtol=1e-6)
+
+    def test_matches_unfused_weighted_mean_on_clean_input(self):
+        from nanofed_tpu.ops import weighted_mean_flat
+
+        rng = np.random.default_rng(5)
+        c, p = 5, 1024
+        x = jnp.asarray(rng.normal(size=(c, p)), jnp.float32)
+        w = jnp.asarray(rng.uniform(0.5, 2.0, size=c), jnp.float32)
+        got = masked_weighted_mean_flat(x, w, jnp.ones(c, jnp.float32))
+        want = weighted_mean_flat(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,243 @@
+"""Autotuner unit tests: ranking determinism, the memory-budget rejection path,
+the CPU scoring fallback's stated basis, and the zero-compile cache hit."""
+
+import json
+
+import pytest
+
+from nanofed_tpu.models import get_model
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.tuning import (
+    AutotuneError,
+    CandidateConfig,
+    CandidateOutcome,
+    PopulationSpec,
+    TuningSpace,
+    autotune,
+    rank_candidates,
+    resolve_hbm_budget,
+)
+
+MODEL = get_model("digits_mlp")
+POP = PopulationSpec(num_clients=8, capacity=32, sample_shape=(8, 8, 1))
+TRAINING = TrainingConfig(batch_size=16, local_epochs=1, learning_rate=0.1)
+TINY_SPACE = TuningSpace(
+    client_chunks=(None, 1),
+    rounds_per_blocks=(1, 2),
+    model_shards=(1,),
+    batch_sizes=(16,),
+)
+
+
+def _sweep(tmp_path, **kwargs):
+    defaults = dict(
+        num_rounds=4, space=TINY_SPACE,
+        cache_dir=tmp_path / "cache", out_dir=tmp_path / "runs",
+        include_epilogues=False,
+    )
+    defaults.update(kwargs)
+    return autotune(MODEL, POP, TRAINING, **defaults)
+
+
+class TestRanking:
+    def _outcome(self, chunk, rpb, shards, batch, score, peak=0, feasible=True,
+                 reason=None):
+        return CandidateOutcome(
+            CandidateConfig(chunk, rpb, shards, batch),
+            feasible=feasible, score=score, reject_reason=reason,
+            cost={"peak_bytes": peak} if feasible else {},
+        )
+
+    def test_feasible_sorted_by_score(self):
+        a = self._outcome(None, 1, 1, 16, score=3.0)
+        b = self._outcome(1, 1, 1, 16, score=1.0)
+        c = self._outcome(2, 1, 1, 16, score=2.0)
+        assert [o.score for o in rank_candidates([a, b, c])] == [1.0, 2.0, 3.0]
+
+    def test_exact_tie_prefers_larger_block(self):
+        # The AOT cost model cannot see the host dispatch tax — identical
+        # per-round cost must rank the fused block first.
+        single = self._outcome(None, 1, 1, 16, score=2.0)
+        fused = self._outcome(None, 8, 1, 16, score=2.0)
+        ranked = rank_candidates([single, fused])
+        assert ranked[0].config.rounds_per_block == 8
+
+    def test_tie_then_smaller_peak_then_key(self):
+        heavy = self._outcome(2, 4, 1, 16, score=2.0, peak=100)
+        light = self._outcome(4, 4, 1, 16, score=2.0, peak=50)
+        assert rank_candidates([heavy, light])[0] is light
+        # Full tie: the stable candidate key decides, independent of input order.
+        x = self._outcome(1, 4, 1, 16, score=2.0, peak=50)
+        y = self._outcome(2, 4, 1, 16, score=2.0, peak=50)
+        assert rank_candidates([y, x])[0] is x
+        assert rank_candidates([x, y])[0] is x
+
+    def test_rejected_follow_feasible_with_reasons(self):
+        ok = self._outcome(None, 1, 1, 16, score=1.0)
+        bad = self._outcome(1, 1, 1, 16, score=None, feasible=False,
+                            reason="exceeds budget")
+        ranked = rank_candidates([bad, ok])
+        assert ranked[0] is ok
+        assert ranked[1].reject_reason == "exceeds budget"
+
+
+class TestSpace:
+    def test_default_space_respects_geometry(self):
+        space = TuningSpace.default(POP, n_devices=8, batch_size=16, num_rounds=10)
+        assert None in space.client_chunks
+        assert all(r <= 10 for r in space.rounds_per_blocks)
+        # batch candidates must divide the packed capacity
+        assert all(POP.capacity % b == 0 for b in space.batch_sizes)
+        assert 2 in space.model_shards  # 8 devices admit a model axis
+
+    def test_candidates_deduped_and_ordered(self):
+        space = TuningSpace((None, None), (1,), (1,), (16,))
+        assert len(space.candidates()) == 1
+
+
+class TestBudgetResolution:
+    def test_explicit_wins(self):
+        budget, basis = resolve_hbm_budget(123456)
+        assert budget == 123456 and "explicit" in basis
+
+    def test_env_var(self, monkeypatch):
+        monkeypatch.setenv("NANOFED_AUTOTUNE_HBM_BUDGET", "1e9")
+        budget, basis = resolve_hbm_budget()
+        assert budget == 1_000_000_000 and "NANOFED_AUTOTUNE_HBM_BUDGET" in basis
+
+    def test_cpu_is_unbounded_not_fabricated(self, monkeypatch):
+        monkeypatch.delenv("NANOFED_AUTOTUNE_HBM_BUDGET", raising=False)
+        budget, basis = resolve_hbm_budget()
+        # The CPU runtime reports no bytes_limit and no HBM row exists for it:
+        # the budget must be honestly absent, never invented.
+        assert budget is None
+        assert "unbounded" in basis
+
+
+class TestMemoryBudgetRejection:
+    def test_all_rejected_raises_with_reasons(self, tmp_path):
+        with pytest.raises(AutotuneError, match="exceeds the device HBM budget"):
+            _sweep(tmp_path, hbm_budget_bytes=1024)
+        # The artifact is still written first, with the full rejected table.
+        artifacts = list((tmp_path / "runs").glob("autotune_*.json"))
+        assert artifacts
+        table = json.loads(artifacts[0].read_text())
+        assert table["winner"] is None
+        assert all(not c["feasible"] for c in table["candidates"])
+        assert all(
+            "exceeds the device HBM budget" in c["reject_reason"]
+            for c in table["candidates"]
+        )
+        assert table["hbm_budget_bytes"] == 1024
+        assert "explicit" in table["budget_basis"]
+
+    def test_partial_rejection_keeps_feasible_winner(self, tmp_path):
+        # First, learn the candidates' peaks with no budget...
+        free = _sweep(tmp_path, cache_dir=None, out_dir=None)
+        peaks = sorted(
+            o.cost["peak_bytes"] for o in free.outcomes if o.feasible
+        )
+        assert peaks[0] < peaks[-1], "need distinct peaks to split the budget"
+        # ...then set the budget between min and max: the heavy candidates must
+        # be rejected, the winner drawn from the survivors.
+        budget = (peaks[0] + peaks[-1]) // 2
+        res = _sweep(tmp_path, cache_dir=None, out_dir=None,
+                     hbm_budget_bytes=budget)
+        rejected = [o for o in res.outcomes if not o.feasible]
+        assert rejected and res.winner is not None
+        winner_outcome = next(
+            o for o in res.outcomes if o.feasible and o.config == res.winner
+        )
+        assert winner_outcome.cost["peak_bytes"] <= budget
+        for o in rejected:
+            assert "exceeds the device HBM budget" in o.reject_reason
+            # Rejected-for-memory candidates still carry their measured cost,
+            # so the table explains WHY they were over.
+            assert o.cost["peak_bytes"] > budget
+
+
+class TestCpuOrderingFallback:
+    def test_basis_states_bytes_accessed_not_walltime(self, tmp_path):
+        res = _sweep(tmp_path)
+        assert "bytes-accessed ordering" in res.scoring_basis
+        assert "NOT a predicted walltime" in res.scoring_basis
+        # No fabricated peaks: no CPU candidate may carry a lower-bound walltime.
+        for o in res.outcomes:
+            assert "lower_bound_s_per_round" not in o.cost
+        # The artifact carries the basis field verbatim.
+        table = json.loads((tmp_path / "runs").glob("autotune_*.json").__next__()
+                           .read_text())
+        assert table["scoring_basis"] == res.scoring_basis
+
+    def test_winner_is_min_bytes_per_round(self, tmp_path):
+        res = _sweep(tmp_path)
+        feasible = [o for o in res.outcomes if o.feasible]
+        best = min(feasible, key=lambda o: o.score)
+        assert res.winner == res.outcomes[0].config
+        assert res.outcomes[0].score == best.score
+
+
+class TestCacheAndFeasibility:
+    def test_cache_hit_skips_all_compiles(self, tmp_path):
+        first = _sweep(tmp_path)
+        assert not first.cache_hit and first.compiles > 0
+        second = _sweep(tmp_path)
+        assert second.cache_hit
+        assert second.compiles == 0
+        assert second.winner == first.winner
+        assert [o.to_dict() for o in second.outcomes] == [
+            o.to_dict() for o in first.outcomes
+        ]
+
+    def test_force_resweeps(self, tmp_path):
+        _sweep(tmp_path)
+        forced = _sweep(tmp_path, force=True)
+        assert not forced.cache_hit and forced.compiles > 0
+
+    def test_population_change_misses_cache(self, tmp_path):
+        _sweep(tmp_path)
+        other_pop = PopulationSpec(num_clients=16, capacity=32,
+                                   sample_shape=(8, 8, 1))
+        res = autotune(
+            MODEL, other_pop, TRAINING, num_rounds=4, space=TINY_SPACE,
+            cache_dir=tmp_path / "cache", out_dir=None,
+            include_epilogues=False,
+        )
+        assert not res.cache_hit
+
+    def test_failed_sweep_is_not_cached(self, tmp_path):
+        # An all-rejected sweep must raise EVERY time — the first failure must
+        # not be cached as a winnerless result a later call silently returns.
+        with pytest.raises(AutotuneError):
+            _sweep(tmp_path, hbm_budget_bytes=1024)
+        assert not list((tmp_path / "cache").glob("autotune_*.json"))
+        with pytest.raises(AutotuneError):
+            _sweep(tmp_path, hbm_budget_bytes=1024)
+
+    def test_budget_change_misses_cache(self, tmp_path):
+        # The budget changes which candidates are rejected (hence the winner),
+        # so it is part of the cache key: an unbudgeted sweep's cache entry
+        # must not answer a budgeted sweep.
+        free = _sweep(tmp_path)
+        peaks = sorted(o.cost["peak_bytes"] for o in free.outcomes if o.feasible)
+        budgeted = _sweep(
+            tmp_path, hbm_budget_bytes=(peaks[0] + peaks[-1]) // 2
+        )
+        assert not budgeted.cache_hit
+        assert any(not o.feasible for o in budgeted.outcomes)
+
+    def test_static_infeasibility_reasons(self, tmp_path):
+        space = TuningSpace(
+            client_chunks=(3,),       # does not divide per-device count
+            rounds_per_blocks=(9,),   # exceeds num_rounds=4
+            model_shards=(5,),        # does not divide 8 devices
+            batch_sizes=(7,),         # does not divide capacity 32
+        )
+        with pytest.raises(AutotuneError):
+            _sweep(tmp_path, space=space, cache_dir=None, out_dir=None)
+
+    def test_eval_every_blocks_fused_candidates(self, tmp_path):
+        space = TuningSpace((None,), (4,), (1,), (16,))
+        with pytest.raises(AutotuneError, match="eval_every"):
+            _sweep(tmp_path, space=space, eval_every=2, cache_dir=None,
+                   out_dir=None)
