@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke autotune-smoke example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke autotune-smoke multihost-smoke multihost-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -48,6 +48,22 @@ loadtest-smoke:
 # repeat sweep must hit the result cache with ZERO compiles.  Tier-1-safe.
 autotune-smoke:
 	python -m pytest tests/integration/test_autotune_smoke.py -q
+
+# Multi-host smoke (parallel.mesh hosts axis): a REAL 2-process
+# jax.distributed CPU run (gloo collectives, subprocess-spawned, tier-1-safe
+# timeout) of the hierarchical 3-axis round program — per-host data sharding,
+# host-local psum then one cross-host psum — asserted for trajectory parity
+# (losses + final params to float tolerance) against a single-process 1-D
+# mesh running the byte-identical workload.
+multihost-smoke:
+	python scripts/multihost_harness.py smoke --timeout 300
+	JAX_PLATFORMS=cpu python -m pytest tests/unit/parallel/test_host_mesh.py -m slow -p no:cacheprovider
+
+# The pod-scale artifact: 100k streamed clients (chunked streaming x
+# multi-process) -> runs/multihost_*.json with rounds/sec + clients/sec and
+# the process_count/hosts topology block.  Minutes, not seconds — not tier-1.
+multihost-bench:
+	python scripts/multihost_harness.py bench
 
 # Compile-only cost profile on CPU (observability.profiling): the `profile`
 # subcommand must produce a non-empty roofline table — single step, fused

@@ -623,6 +623,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
     from nanofed_tpu.parallel import (
         build_round_block,
         build_round_step,
+        host_axis_size,
         init_server_state,
         make_mesh,
         mesh_shape,
@@ -646,6 +647,17 @@ def run_worker(platform: str, workloads: list[str]) -> None:
     n_dev = len(mesh.devices.flat)
     repl = replicated_sharding(mesh)
     strategy = fedavg_strategy()
+
+    # Every bench record states its host/process geometry (ROADMAP item-1
+    # evidence convention): single-host runs say process_count/hosts of 1,
+    # they never omit the block — a reader of the artifact alone can tell a
+    # pod measurement from a laptop one.
+    topology_block = {
+        "process_count": jax.process_count(),
+        "hosts": host_axis_size(mesh),
+        "devices": n_dev,
+        "mesh_shape": list(mesh_shape(mesh)),
+    }
 
     # CPU fallback: the CNN costs ~137 ms/sample-pass on this 1-core host (measured
     # round-3), so full workloads exceed any driver budget by an order of magnitude —
@@ -778,6 +790,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             "unit": "s",
             "platform": str(devices[0].platform),
             "mesh_shape": list(mesh_shape(mesh)),
+            "topology": topology_block,
         })
         if BENCH_STRICT:
             out["strict"] = True
@@ -841,6 +854,7 @@ def run_worker(platform: str, workloads: list[str]) -> None:
             "compute_dtype": "bfloat16",
             "devices": n_dev,
             "mesh_shape": list(mesh_shape(mesh)),
+            "topology": topology_block,
             "rounds_per_block": headline_rpb,
             "baseline_basis": (
                 f"reference tutorial 53.48s / {PARITY_SAMPLE_PASSES} sample-passes "

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from nanofed_tpu.core.types import ClientMetrics, ClientUpdates, Params
 from nanofed_tpu.utils.trees import tree_weighted_mean
@@ -58,31 +57,45 @@ def compute_weights(
     return w
 
 
-def psum_weighted_mean(tree: Params, weights: jax.Array, axis_name: str) -> Params:
-    """In-mesh weighted mean over the client axis: local contraction then ICI ``psum``.
+def _client_psum(x: jax.Array, axis_name: str | tuple[str, ...]) -> jax.Array:
+    """``psum`` over the client axis — hierarchically (innermost first: the
+    host-local ICI stage, then ONE cross-host DCN stage on the already-reduced
+    value) when ``axis_name`` is the 3-axis mesh's ``(hosts, clients)`` tuple.
+    Lazy import: ``aggregation`` must stay importable without triggering the
+    ``parallel`` package's own import of this module (cycle)."""
+    from nanofed_tpu.parallel.mesh import hierarchical_psum
+
+    return hierarchical_psum(x, axis_name)
+
+
+def psum_weighted_mean(
+    tree: Params, weights: jax.Array, axis_name: str | tuple[str, ...]
+) -> Params:
+    """In-mesh weighted mean over the client axis: local contraction then ICI ``psum``
+    (host-local then cross-host when ``axis_name`` is the hierarchical axis tuple).
 
     ``tree`` leaves are ``[C_local, ...]`` (this device's clients); ``weights`` is
     ``[C_local]``.  Safe under all-zero weights (returns zeros).
     """
-    den = lax.psum(weights.sum(), axis_name)
+    den = _client_psum(weights.sum(), axis_name)
     den = jnp.maximum(den, 1e-12)
 
     def leaf_mean(leaf: jax.Array) -> jax.Array:
         w = weights.astype(leaf.dtype)
         local = jnp.tensordot(w, leaf, axes=1)
-        return lax.psum(local, axis_name) / den.astype(leaf.dtype)
+        return _client_psum(local, axis_name) / den.astype(leaf.dtype)
 
     return jax.tree.map(leaf_mean, tree)
 
 
 def psum_weighted_metrics(
-    metrics: ClientMetrics, weights: jax.Array, axis_name: str
+    metrics: ClientMetrics, weights: jax.Array, axis_name: str | tuple[str, ...]
 ) -> dict[str, jax.Array]:
     """In-mesh weighted metric means + total sample count (masked by participation)."""
-    den = jnp.maximum(lax.psum(weights.sum(), axis_name), 1e-12)
+    den = jnp.maximum(_client_psum(weights.sum(), axis_name), 1e-12)
     participating = (weights > 0).astype(metrics.samples.dtype)
     return {
-        "loss": lax.psum((metrics.loss * weights).sum(), axis_name) / den,
-        "accuracy": lax.psum((metrics.accuracy * weights).sum(), axis_name) / den,
-        "samples": lax.psum((metrics.samples * participating).sum(), axis_name),
+        "loss": _client_psum((metrics.loss * weights).sum(), axis_name) / den,
+        "accuracy": _client_psum((metrics.accuracy * weights).sum(), axis_name) / den,
+        "samples": _client_psum((metrics.samples * participating).sum(), axis_name),
     }

@@ -17,8 +17,10 @@ it on data:
   (``Coordinator(strict=True)`` / CLI ``--strict`` / bench
   ``NANOFED_BENCH_STRICT=1``).
 * :func:`check_input_shardings` spot-checks the parallel layout: client data
-  sharded over the client axis (and nothing else), params replicated — or, on
-  a 2-D ``clients x model`` mesh, model-sharded per the FSDP layout.
+  sharded over the client axis (and nothing else; jointly over
+  ``(hosts, clients)`` on a 3-axis multi-host mesh), params replicated — or
+  model-sharded per the FSDP layout on a mesh with a model axis; never client-
+  or host-sharded.
 
 Zero execution, zero compilation: ``eval_shape`` only traces, so strict
 construction costs milliseconds even at the 1000-client flagship shape.
@@ -204,33 +206,45 @@ def check_input_shardings(
     params: Any,
     axis_name: str = "clients",
     model_axis: str = "model",
+    host_axis: str = "hosts",
 ) -> None:
     """Spot-check the parallel layout on CONCRETE inputs.
 
     Client data: every leaf sharded over ``axis_name`` in its leading dimension
-    and over nothing else in the trailing ones (on a 2-D mesh that means
-    replicated over ``model`` — every model column holds its clients whole).
+    — or over ``(host_axis, axis_name)`` jointly, hosts-major, on a 3-axis
+    ``hosts x clients x model`` mesh (per-host data sharding) — and over
+    nothing else in the trailing ones (replicated over ``model``: every model
+    column holds its clients whole).  A leading dim sharded over ``hosts``
+    alone, ``(clients, hosts)`` inverted, or any mix with ``model`` is
+    rejected.
 
     Params (and any params-shaped state): every leaf either fully replicated
-    (the 1-D layout) or sharded ONLY over ``model_axis`` (the FSDP layout of a
-    2-D ``clients x model`` mesh — at most one sharded dimension, never the
-    client axis: a client-sharded param leaf would make every client train a
-    different slice of the model).
+    (the 1-D layout) or sharded ONLY over ``model_axis`` (the FSDP layout —
+    at most one sharded dimension, never the client OR hosts axis: a client-
+    sharded param leaf would make every client train a different slice of the
+    model, and a host-sharded one would desynchronize the global model across
+    hosts — the exact failure hierarchical aggregation exists to prevent).
 
     Leaves that carry no ``NamedSharding`` (host arrays, abstract values,
     single-device placements) are skipped — this is a layout audit, not a
     placement requirement."""
     from jax.sharding import NamedSharding
 
+    lead_ok = (
+        (axis_name,),  # 1-D / 2-D: clients alone
+        (host_axis, axis_name),  # 3-axis: hosts-major joint sharding
+    )
     for path, leaf in _leaves_with_paths(data):
         sharding = getattr(leaf, "sharding", None)
         if not isinstance(sharding, NamedSharding):
             continue
         spec = sharding.spec
-        if len(spec) == 0 or spec[0] != axis_name:
+        if len(spec) == 0 or _spec_axes(spec[0]) not in lead_ok:
             raise ContractViolation(
-                f"data{path}: expected leading-axis sharding over {axis_name!r}, "
-                f"got spec {spec} — the round program shards clients over the mesh"
+                f"data{path}: expected leading-axis sharding over {axis_name!r} "
+                f"(or ({host_axis!r}, {axis_name!r}) jointly on a 3-axis mesh), "
+                f"got spec {spec} — the round program shards clients over the "
+                "mesh, hosts-major"
             )
         for entry in tuple(spec)[1:]:
             if _spec_axes(entry):
@@ -251,7 +265,8 @@ def check_input_shardings(
                 f"params{path}: expected replicated placement or a single "
                 f"dimension sharded over {model_axis!r}, got spec "
                 f"{sharding.spec} — params ride every device whole (1-D) or "
-                "split over the model axis only (2-D FSDP layout)"
+                "split over the model axis only (FSDP layout); client- or "
+                "host-sharded params are never valid"
             )
 
 

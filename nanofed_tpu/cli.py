@@ -103,6 +103,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 ("--client-chunk", args.client_chunk is not None),
                 ("--rounds-per-block", args.rounds_per_block != 1),
                 ("--model-shards", args.model_shards != 1),
+                ("--hosts", args.hosts != 1),
             ) if engaged
         ]
         if pinned:
@@ -114,17 +115,52 @@ def _cmd_run(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
 
-    if args.model_shards != 1:
+    if args.distributed:
+        # Activate jax.distributed BEFORE any backend init: afterwards
+        # jax.devices() is the GLOBAL device list and --hosts can span real
+        # processes.  Configuration rides the JAX_COORDINATOR_ADDRESS /
+        # JAX_NUM_PROCESSES / JAX_PROCESS_ID env (or TPU-pod auto-detection)
+        # — see parallel.initialize_distributed.
+        from nanofed_tpu.parallel import initialize_distributed
+
+        try:
+            info = initialize_distributed()
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"# distributed: process {info['process_index']} of "
+              f"{info['process_count']}", file=sys.stderr)
+        if info["process_count"] > 1:
+            # The Coordinator is single-controller: its host-built round
+            # inputs (cohort slot arrays, weights, rng stacks) are committed
+            # process-local arrays a multi-process sharding rejects at the
+            # first dispatch.  Refuse up front with the working alternative
+            # instead of failing round 1 with an XLA placement error.
+            print(
+                "error: `run` drives the single-controller Coordinator, "
+                "which cannot feed a multi-process mesh (its host-built "
+                "round inputs are process-local). Drive real multi-process "
+                "rounds with scripts/multihost_harness.py (smoke|bench), "
+                "which computes every round input as a replicated jitted "
+                "program on each process; single-process `--hosts N` "
+                "exercises the same hierarchical program on virtual hosts.",
+                file=sys.stderr,
+            )
+            return 2
+
+    if args.model_shards != 1 or args.hosts != 1:
         # Same up-front courtesy as the other invalid combinations: validate
         # against the device count HERE (the one place that forces backend
         # init) so the error is a CLI message, not a traceback —
         # run_experiment re-runs the identical shared validator.
         import jax
 
-        from nanofed_tpu.parallel import mesh_shape_for_model_shards
+        from nanofed_tpu.parallel import mesh_shape_for_topology
 
         try:
-            mesh_shape_for_model_shards(args.model_shards, len(jax.devices()))
+            mesh_shape_for_topology(
+                args.hosts, args.model_shards, len(jax.devices())
+            )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
@@ -156,6 +192,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         rounds_per_block=args.rounds_per_block,
         client_metrics_every=args.client_metrics_every,
         model_shards=args.model_shards,
+        hosts=args.hosts,
         strict=args.strict,
         profile_programs=args.profile_programs,
         autotune=args.autotune,
@@ -203,12 +240,17 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         pins["client_chunks"] = (args.client_chunk,)
     if args.model_shards != 1:
         pins["model_shards"] = (args.model_shards,)
+    if args.hosts != 1:
+        pins["hosts"] = (args.hosts,)
     space = None
     if pins:
         import dataclasses
 
         import jax
 
+        # TuningSpace.default owns the multi-process hosts-axis rule, so a
+        # pin on one knob cannot silently flatten the hosts axis of the
+        # others.
         space = dataclasses.replace(
             TuningSpace.default(
                 pop, len(jax.devices()), training.batch_size, num_rounds
@@ -274,11 +316,13 @@ def _cmd_profile(args: argparse.Namespace) -> int:
     from nanofed_tpu.models import get_model
     from nanofed_tpu.observability import format_cost_table
     from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
-    from nanofed_tpu.parallel import mesh_shape_for_model_shards
+    from nanofed_tpu.parallel import mesh_shape_for_topology
     from nanofed_tpu.trainer import TrainingConfig
 
     try:
-        mesh_shape = mesh_shape_for_model_shards(args.model_shards, len(jax.devices()))
+        mesh_shape = mesh_shape_for_topology(
+            args.hosts, args.model_shards, len(jax.devices())
+        )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -668,6 +712,28 @@ def main(argv: list[str] | None = None) -> int:
         "N must divide the device count; 1 = classic replicated layout",
     )
     run.add_argument(
+        "--hosts", type=int, default=1, metavar="H",
+        help="add a third 'hosts' mesh axis: devices arrange as an (H, "
+        "devices/(H*model-shards), model-shards) hosts x clients x model "
+        "mesh and the FedAvg reduce becomes HIERARCHICAL — host-local psum "
+        "over clients (ICI), then ONE cross-host psum over hosts (DCN), so "
+        "inter-host traffic per round is one model-sized tensor. Cohorts "
+        "sample host-locally. Single-process this slices virtual hosts over "
+        "the local devices; combine with --distributed on a real multi-host "
+        "cluster. H * model-shards must divide the device count",
+    )
+    run.add_argument(
+        "--distributed", action="store_true",
+        help="call jax.distributed.initialize before anything (multi-host "
+        "bring-up: JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID "
+        "env, or TPU-pod auto-detection; CPU clusters get gloo collectives) "
+        "so jax.devices() is the GLOBAL device list. Single-process "
+        "environments make this a documented no-op; an ACTUAL multi-process "
+        "environment is refused here — the Coordinator is single-controller, "
+        "and scripts/multihost_harness.py (smoke|bench) is the end-to-end "
+        "multi-process driver",
+    )
+    run.add_argument(
         "--rounds-per-block", type=int, default=1,
         help="fuse this many rounds into ONE device program (lax.scan inside a "
         "single jit): no Python dispatch, no block_until_ready, no metrics "
@@ -909,6 +975,11 @@ def main(argv: list[str] | None = None) -> int:
     profile.add_argument("--client-chunk", type=int, default=None)
     profile.add_argument("--model-shards", type=int, default=1, metavar="N",
                          help="profile the 2-D clients x model (FSDP) programs")
+    profile.add_argument(
+        "--hosts", type=int, default=1, metavar="H",
+        help="profile the 3-axis hosts x clients x model programs "
+        "(hierarchical aggregation; virtual hosts over the local devices)",
+    )
     profile.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     profile.add_argument("--no-scaffold", action="store_true",
                          help="skip the SCAFFOLD round program")

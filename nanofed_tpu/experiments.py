@@ -74,6 +74,7 @@ def run_experiment(
     rounds_per_block: int = 1,
     client_metrics_every: int = 1,
     model_shards: int = 1,
+    hosts: int = 1,
     strict: bool = False,
     profile_programs: bool = False,
     autotune: bool = False,
@@ -100,6 +101,20 @@ def run_experiment(
     FSDP-shards params + server optimizer state over the model axis (see
     ``parallel.mesh.param_sharding``) — the model never materializes
     replicated between rounds; must divide the device count.
+
+    ``hosts > 1`` (CLI ``--hosts``) adds the third mesh axis: a ``(hosts,
+    devices/(hosts*model_shards), model_shards)`` hosts x clients x model
+    mesh whose FedAvg reduce is HIERARCHICAL — host-local psum over
+    ``clients`` then ONE cross-host psum over ``hosts`` — with host-local
+    cohort sampling (each host's slot segment only references its resident
+    clients).  Single-process it slices virtual hosts over the local devices
+    (how tier-1 exercises the path).  The Coordinator is single-controller —
+    its host-built round inputs are process-local arrays a multi-process
+    sharding rejects — so a real multi-process cluster is driven by
+    ``scripts/multihost_harness.py`` (which computes round inputs as
+    replicated jitted programs per process), not by this function; the CLI
+    ``run --distributed`` refuses ``process_count > 1`` for the same reason.
+    ``hosts * model_shards`` must divide the device count.
 
     ``strict=True`` (CLI ``--strict``) enables the analysis-subsystem runtime
     guards: round programs are contract-checked at build time via
@@ -129,9 +144,9 @@ def run_experiment(
             trim_k=robust_trim_k if robust_trim_k is not None else 1,
             method=robust_method or "trimmed_mean",
         )
-    from nanofed_tpu.parallel import mesh_shape_for_model_shards
+    from nanofed_tpu.parallel import mesh_shape_for_topology
 
-    mesh_shape = mesh_shape_for_model_shards(model_shards, len(jax.devices()))
+    mesh_shape = mesh_shape_for_topology(hosts, model_shards, len(jax.devices()))
 
     mdl = get_model(model)
     train, test = load_datasets_for(mdl, data_dir, train_size, seed)
@@ -176,6 +191,7 @@ def run_experiment(
                 ("client_chunk", client_chunk is not None),
                 ("rounds_per_block", rounds_per_block != 1),
                 ("model_shards", model_shards != 1),
+                ("hosts", hosts != 1),
             ) if engaged
         ]
         if pinned:

@@ -181,6 +181,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     program_profiles: dict[str, dict[str, Any]] = {}
     loadtests: dict[str, dict[str, Any]] = {}
     autotunes: dict[str, dict[str, Any]] = {}
+    topology: dict[str, Any] | None = None
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -230,6 +231,18 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 }
+            elif rtype == "topology":
+                # Host/process geometry of the run (multi-host federation):
+                # single-host runs record process_count/hosts of 1, they don't
+                # omit the block — the ROADMAP item-1 evidence convention.
+                topology = {
+                    k: rec[k]
+                    for k in (
+                        "process_count", "hosts", "mesh_shape", "devices",
+                        "num_clients",
+                    )
+                    if k in rec
+                }
             elif rtype == "loadtest":
                 # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
                 # by serving path; last record per mode wins (a re-run
@@ -260,6 +273,8 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         "rounds": rounds,
         "phases": {name: _digest(d) for name, d in sorted(spans.items())},
     }
+    if topology is not None:
+        out["topology"] = topology
     if round_durations:
         out["round_duration"] = _digest(round_durations)
     if program_profiles:

@@ -51,8 +51,10 @@ from nanofed_tpu.observability.telemetry import RunTelemetry, install_jax_event_
 from nanofed_tpu.orchestration.types import RoundMetrics, RoundStatus, TrainingProgress
 from nanofed_tpu.parallel.mesh import (
     MODEL_AXIS,
-    client_axis_size,
+    client_shard_count,
+    host_axis_size,
     make_mesh,
+    mesh_shape as mesh_axis_sizes,
     model_axis_size,
     pad_client_count,
     pad_clients,
@@ -181,7 +183,7 @@ class Coordinator:
         """
         import dataclasses
 
-        from nanofed_tpu.parallel.mesh import mesh_shape_for_model_shards
+        from nanofed_tpu.parallel.mesh import mesh_shape_for_topology
         from nanofed_tpu.trainer.config import TrainingConfig as _TC
         from nanofed_tpu.tuning import PopulationSpec, autotune
 
@@ -218,8 +220,9 @@ class Coordinator:
                 training, batch_size=winner.batch_size
             ),
             client_chunk=winner.client_chunk,
-            mesh_shape=mesh_shape_for_model_shards(
-                winner.model_shards, len(_jax.devices())
+            mesh_shape=mesh_shape_for_topology(
+                getattr(winner, "hosts", 1), winner.model_shards,
+                len(_jax.devices()),
             ),
             **kwargs,
         )
@@ -272,12 +275,16 @@ class Coordinator:
         # config.dropout_rate's per-round coin flips.
         self._chaos = chaos
         # mesh_shape=(n_client_shards, n_model_shards) builds the 2-D clients x
-        # model mesh (FSDP-style parameter sharding — see parallel.mesh); an
-        # explicit mesh= wins and must not be combined with it.
+        # model mesh (FSDP-style parameter sharding — see parallel.mesh);
+        # mesh_shape=(n_hosts, n_client_shards, n_model_shards) the 3-D
+        # hosts x clients x model mesh with hierarchical (host-local then
+        # cross-host) aggregation.  An explicit mesh= wins and must not be
+        # combined with it.
         if mesh is not None and mesh_shape is not None:
             raise ValueError(
                 "pass either mesh= (a prebuilt Mesh) or mesh_shape= "
-                "(n_client_shards, n_model_shards), not both"
+                "((n_client_shards, n_model_shards) or (n_hosts, "
+                "n_client_shards, n_model_shards)), not both"
             )
         if mesh is not None:
             self.mesh = mesh
@@ -318,14 +325,22 @@ class Coordinator:
         self.num_clients = int(train_data.x.shape[0])
         # Clients pad to the number of CLIENT shards (== device count on a 1-D
         # mesh; the first mesh dim on a 2-D clients x model mesh — the model
-        # axis holds parameter shards, not clients).
-        n_dev = client_axis_size(self.mesh)
+        # axis holds parameter shards, not clients; hosts x clients jointly on
+        # a 3-axis mesh, where data rows shard hosts-major so each host row
+        # holds a contiguous client range).
+        n_dev = client_shard_count(self.mesh)
+        self._n_hosts = host_axis_size(self.mesh)
         padded = pad_client_count(self.num_clients, n_dev)
-        self._data = shard_client_data(pad_clients(train_data, padded), self.mesh)
+        padded_data = pad_clients(train_data, padded)
+        # Sample counts come from the HOST copy before sharding: pulling the
+        # sharded mask back would be a pointless device->host round trip — and
+        # is impossible on a multi-process mesh (no process holds every row).
         self._num_samples = jnp.asarray(
-            np.asarray(self._data.mask).sum(axis=1), dtype=jnp.float32
+            np.asarray(padded_data.mask).sum(axis=1), dtype=jnp.float32
         )
+        self._data = shard_client_data(padded_data, self.mesh)
         self._padded_clients = padded
+        self._rows_per_host = padded // self._n_hosts
 
         # Model-state placement: params and server opt state ride the mesh in
         # the param_sharding layout — replicated on a 1-D mesh, FSDP
@@ -379,6 +394,27 @@ class Coordinator:
         self._step_clients = (
             pad_client_count(self.cohort_size, n_dev) if self._cohort_mode else padded
         )
+        # Host-local cohorts (3-axis mesh): each host's slot segment of the
+        # gathered cohort only ever references that host's resident client
+        # rows, so the in-round cohort gather moves zero inter-host data —
+        # sampling is stratified per host (proportional quotas), placement
+        # fills per-host slot segments (see _sample_cohort/_place_cohort).
+        self._slots_per_host = self._step_clients // self._n_hosts
+        if self._cohort_mode and self._n_hosts > 1:
+            # Every quantity below is static, so an infeasible cohort is
+            # refused HERE — before any program compiles — not at round 1's
+            # first draw (same up-front rule as the robust-floor check).
+            caps = [
+                min(max(0, stop - start), self._slots_per_host)
+                for start, stop in self._host_populations()
+            ]
+            if sum(caps) < self.cohort_size:
+                raise NanoFedError(
+                    f"cohort_size {self.cohort_size} exceeds the hosts-axis "
+                    f"capacity (per-host caps {caps} = min(resident clients, "
+                    f"slot segment {self._slots_per_host})) — shrink the "
+                    "cohort or raise participation"
+                )
         if self._cohort_mode:
             from nanofed_tpu.parallel.mesh import client_sharding
 
@@ -578,6 +614,18 @@ class Coordinator:
             else (self.base_dir if config.save_metrics else None)
         )
         self.telemetry = RunTelemetry(tel_dir) if tel_dir is not None else None
+        if self.telemetry is not None:
+            # The run's topology block (ROADMAP item-1 evidence bar): every
+            # telemetry stream states its host/process geometry — single-host
+            # runs say 1, they don't omit it — and metrics-summary surfaces it.
+            self.telemetry.record(
+                "topology",
+                process_count=jax.process_count(),
+                hosts=self._n_hosts,
+                mesh_shape=list(mesh_axis_sizes(self.mesh)),
+                devices=len(jax.devices()),
+                num_clients=self.num_clients,
+            )
         self._tracer = (
             self.telemetry.tracer
             if self.telemetry is not None
@@ -691,8 +739,13 @@ class Coordinator:
         irrelevant (lowering never executes), so data placeholders are zeros.
         """
         attrs = {
-            "mesh_shape": list(
-                (client_axis_size(self.mesh), self._model_shards)
+            # Per-axis mesh sizes in axis order: [clients, model] on 1-D/2-D
+            # meshes (a 1-D mesh records its implicit model dim of 1), and
+            # [hosts, clients, model] once the hosts axis engages.
+            "mesh_shape": (
+                list(mesh_axis_sizes(self.mesh))
+                if len(self.mesh.axis_names) > 1
+                else [client_shard_count(self.mesh), self._model_shards]
             ),
             "step_clients": self._step_clients,
         }
@@ -856,8 +909,11 @@ class Coordinator:
                 cohort_mask=jax.ShapeDtypeStruct((rpb, n), jnp.float32),
             )
             self._log.info("strict: round_block contract ok (%s)", report)
+        from nanofed_tpu.parallel.mesh import HOST_AXIS
+
         check_input_shardings(
-            self._data, self.params, axis_name=CLIENT_AXIS, model_axis=MODEL_AXIS
+            self._data, self.params, axis_name=CLIENT_AXIS,
+            model_axis=MODEL_AXIS, host_axis=HOST_AXIS,
         )
 
     def _dispatch_guard(self):
@@ -1004,7 +1060,20 @@ class Coordinator:
             host_rng = self._secret_sampling_rng
         else:
             host_rng = np.random.default_rng(self.config.seed * 100_003 + round_id)
-        sampled = host_rng.choice(self.num_clients, size=self.cohort_size, replace=False)
+        if self._n_hosts > 1 and self._cohort_mode:
+            # Host-LOCAL stratified draw (3-axis mesh): quota_h clients from
+            # each host's own resident range, proportional to its population
+            # (largest remainder), so every host's slot segment can be filled
+            # from rows it already holds.  Per-client inclusion probability
+            # stays quota_h / pop_h == cohort/N under proportional quotas.
+            # NOTE: the draw ORDER differs from the single-host path, so a
+            # hosts-mesh run is seed-deterministic but not cohort-identical
+            # to the same seed on a 1-D mesh under partial participation.
+            sampled = self._sample_host_local(host_rng)
+        else:
+            sampled = host_rng.choice(
+                self.num_clients, size=self.cohort_size, replace=False
+            )
         if self.config.dropout_rate > 0:
             keep = host_rng.random(len(sampled)) >= self.config.dropout_rate
             sampled = sampled[keep]
@@ -1017,6 +1086,101 @@ class Coordinator:
                      if not self._chaos.crashed(int(c), round_id)]
             sampled = np.asarray(alive, dtype=sampled.dtype)
         return sampled
+
+    def _host_populations(self) -> list[tuple[int, int]]:
+        """Per-host resident client id ranges ``[(start, stop), ...]`` — data
+        rows shard hosts-major, so host h owns the contiguous padded rows
+        ``[h*rows_per_host, (h+1)*rows_per_host)``; clipping to ``num_clients``
+        drops the padding rows (the last host may own fewer real clients)."""
+        return [
+            (h * self._rows_per_host,
+             min((h + 1) * self._rows_per_host, self.num_clients))
+            for h in range(self._n_hosts)
+        ]
+
+    def _sample_host_local(self, host_rng: np.random.Generator) -> np.ndarray:
+        """Stratified cohort draw over the hosts axis: proportional quotas
+        with RANDOMIZED largest-remainder rounding, each host's quota drawn
+        without replacement from its own resident range, clamped to its slot
+        segment.
+
+        The leftover slots after flooring are assigned by per-round weighted
+        draws (weight = a host's outstanding remainder, uniform fallback once
+        remainders are exhausted) — never by a deterministic remainder sort,
+        which would hand the extras to the SAME hosts every round: with
+        uneven per-host populations (padding always clips the last host) that
+        permanently skews — or zeroes — some clients' inclusion probability,
+        while the randomized rounding keeps it at cohort/N in expectation
+        (exactly, up to cap clipping), which is the rate the central-DP
+        accountant assumes."""
+        ranges = self._host_populations()
+        pops = [max(0, stop - start) for start, stop in ranges]
+        total = sum(pops)
+        exact = [self.cohort_size * p / total for p in pops]
+        quotas = [int(q) for q in exact]
+        # Floor quotas, capped by both the host's population and its slot
+        # segment (a quota the slots can't hold would overflow placement).
+        caps = [min(p, self._slots_per_host) for p in pops]
+        quotas = [min(q, c) for q, c in zip(quotas, caps)]
+        short = self.cohort_size - sum(quotas)
+        # A shortfall the caps cannot absorb at all is a sizing error,
+        # surfaced like _place_cohort's overflow (and refused up front at
+        # construction) — never a silently smaller cohort.
+        while short > 0:
+            open_hosts = [h for h in range(self._n_hosts)
+                          if quotas[h] < caps[h]]
+            if not open_hosts:
+                raise NanoFedError(
+                    f"cohort_size {self.cohort_size} exceeds the hosts-axis "
+                    f"capacity (per-host caps {caps} = min(resident clients, "
+                    f"slot segment {self._slots_per_host})) — shrink the "
+                    "cohort or raise participation"
+                )
+            w = np.array([max(exact[h] - quotas[h], 0.0) for h in open_hosts])
+            if w.sum() <= 0:
+                w = np.ones(len(open_hosts))
+            pick = open_hosts[
+                int(host_rng.choice(len(open_hosts), p=w / w.sum()))
+            ]
+            quotas[pick] += 1
+            short -= 1
+        parts = []
+        for (start, _), pop, quota in zip(ranges, pops, quotas):
+            if quota > 0:
+                parts.append(
+                    start + host_rng.choice(pop, size=quota, replace=False)
+                )
+        return np.concatenate(parts) if parts else np.zeros(0, dtype=np.int64)
+
+    def _place_cohort(
+        self, survived: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Lay a sampled cohort into the step's ``[step_clients]`` slot arrays
+        (client ids + survivor mask).  Single-host: front-packed, padding slots
+        alias row 0 with weight 0 (the classic layout).  Hosts mesh: each
+        host's survivors fill that host's slot segment, and its padding slots
+        alias that host's FIRST resident row — a padding slot must never force
+        a cross-host gather for a zero-weight client."""
+        idx = np.zeros(self._step_clients, dtype=np.int32)
+        mask = np.zeros(self._step_clients, dtype=np.float32)
+        if self._n_hosts <= 1:
+            idx[: len(survived)] = survived
+            mask[: len(survived)] = 1.0
+            return idx, mask
+        slots = self._slots_per_host
+        for h, (start, stop) in enumerate(self._host_populations()):
+            rows = survived[(survived >= start) & (survived < stop)]
+            if len(rows) > slots:
+                raise NanoFedError(
+                    f"host {h} drew {len(rows)} cohort clients but its slot "
+                    f"segment holds {slots} — host-local sampling must cap "
+                    "per-host quotas at the segment width"
+                )
+            base = h * slots
+            idx[base : base + slots] = start  # padding aliases a HOST-LOCAL row
+            idx[base : base + len(rows)] = rows
+            mask[base : base + len(rows)] = 1.0
+        return idx, mask
 
     # ------------------------------------------------------------------
     # Fused multi-round blocks
@@ -1067,8 +1231,9 @@ class Coordinator:
                     survived = self._sample_cohort(r)
                     survived_counts.append(len(survived))
                     if self._cohort_mode:
-                        idx_rows[i, : len(survived)] = survived
-                        mask_rows[i, : len(survived)] = 1.0
+                        # Slot layout shared with the single-round path
+                        # (host-segmented on a 3-axis mesh).
+                        idx_rows[i], mask_rows[i] = self._place_cohort(survived)
                     else:
                         mask_rows[i, survived] = 1.0
             lr_scales = lr_schedule_scales(
@@ -1250,15 +1415,13 @@ class Coordinator:
         with self._tracer.span("cohort-gather", round=round_id,
                                cohort=len(survived)):
             if self._cohort_mode:
-                # Gather the cohort's rows.  Dropped + padding slots point at row 0
-                # with weight 0: their CONTRIBUTION is zero in every reduce, though
-                # their (static-shape) local fit still executes — the waste is
-                # bounded by the dropout fraction + device padding of K_pad, vs the
-                # full-N path burning N - K slots every round.
-                idx = np.zeros(self._step_clients, dtype=np.int32)
-                idx[: len(survived)] = survived
-                mask = np.zeros(self._step_clients, dtype=np.float32)
-                mask[: len(survived)] = 1.0
+                # Gather the cohort's rows.  Dropped + padding slots point at a
+                # resident row (row 0; each host's first row on a 3-axis mesh)
+                # with weight 0: their CONTRIBUTION is zero in every reduce,
+                # though their (static-shape) local fit still executes — the
+                # waste is bounded by the dropout fraction + device padding of
+                # K_pad, vs the full-N path burning N - K slots every round.
+                idx, mask = self._place_cohort(survived)
                 idx_dev = jnp.asarray(idx)
                 data = self._gather_cohort(self._data, idx_dev)
                 weights = compute_weights(self._num_samples[idx_dev], jnp.asarray(mask))

@@ -38,12 +38,12 @@ from typing import Any, Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from nanofed_tpu.aggregation.base import Strategy
 from nanofed_tpu.aggregation.fedavg import compute_weights
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params
-from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+from nanofed_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
 from nanofed_tpu.parallel.round_step import build_sharded_round
 from nanofed_tpu.security.validation import ValidationConfig
 from nanofed_tpu.trainer.config import TrainingConfig
@@ -176,7 +176,9 @@ def build_round_block(
         grad_fn=grad_fn, local_fit=local_fit, validation=validation,
         client_chunk=client_chunk, params_like=params_like, axis_name=axis_name,
     )
-    csh = NamedSharding(mesh, P(axis_name))
+    # Joint (hosts, clients) spec on a 3-axis mesh: the in-scan cohort gather's
+    # result must land in the same layout the data rides, host rows intact.
+    csh = client_sharding(mesh, axis_name)
 
     def one_round(data, num_samples, carry, xs):
         gp, sos = carry

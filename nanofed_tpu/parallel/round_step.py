@@ -31,9 +31,8 @@ from nanofed_tpu.aggregation.robust import RobustAggregationConfig, robust_aggre
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import (
     CLIENT_AXIS,
-    ModelAxisLayout,
+    MeshLayout,
     multi_axis_shard_map_kwargs,
-    pcast_varying,
     shard_map,
 )
 from nanofed_tpu.privacy.noise import get_noise_generator, tree_noise
@@ -150,9 +149,15 @@ def build_sharded_round(
     # back to its shard before the server update.  On any 1-D mesh every layout
     # method is the identity and the specs stay P()/P(clients) — the classic
     # program, byte for byte.
-    layout = ModelAxisLayout(mesh)
+    layout = MeshLayout(mesh, axis_name=axis_name)
     layout.require_params_like(params_like)
     raw_keys_at_boundary = layout.raw_keys_at_boundary
+    # The client DATA axis of the program: the plain client axis on 1-D/2-D
+    # meshes, the (hosts, clients) tuple on a 3-axis mesh — every client-axis
+    # collective below reduces over c_axes (hierarchically once hosts exist:
+    # host-local psum over ICI, then ONE cross-host psum over DCN, so the
+    # inter-host stage moves one model-sized tensor per round).
+    c_axes = layout.client_axes
 
     if robust is not None and central_privacy is not None:
         raise ValueError(
@@ -253,14 +258,18 @@ def build_sharded_round(
                               local_wsum):
         """Aggregate a streamed local weighted-delta sum: one tree-psum, then the same
         server transform / metrics as the materializing path."""
-        total_w = lax.psum(weights.sum(), axis_name)
-        global_wsum = jax.tree.map(lambda x: lax.psum(x, axis_name), local_wsum)
+        total_w = layout.client_psum(weights.sum())
+        global_wsum = jax.tree.map(
+            layout.client_psum, local_wsum
+        )
         if central_privacy is not None:
             # local_wsum was accumulated with UNIFORM weights over clipped deltas, so
             # sensitivity of the mean is exactly C/K — identical math to the
             # materializing DP path.
             participants = jnp.maximum(
-                lax.psum((weights > 0).sum().astype(jnp.float32), axis_name), 1.0
+                layout.client_psum(
+                    (weights > 0).sum().astype(jnp.float32)),
+                1.0,
             )
             agg_delta = jax.tree.map(
                 lambda x: x / participants.astype(x.dtype), global_wsum
@@ -270,8 +279,9 @@ def build_sharded_round(
             den = jnp.maximum(total_w, 1e-12)
             agg_delta = jax.tree.map(lambda x: x / den.astype(x.dtype), global_wsum)
         new_gp, new_sos = apply_server_update(gp, sos, agg_delta, total_w)
-        metrics = psum_weighted_metrics(client_metrics, weights, axis_name)
-        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        metrics = psum_weighted_metrics(client_metrics, weights, c_axes)
+        metrics["participating_clients"] = layout.client_psum(
+            (weights > 0).sum())
         return new_gp, new_sos, metrics, client_metrics, sq_norms
 
     def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale):
@@ -284,7 +294,7 @@ def build_sharded_round(
         gp_full = layout.gather_full(gp, params_specs)
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
-        gp_v = pcast_varying(gp_full, axis_name)
+        gp_v = layout.cast_varying(gp_full)
         # The schedule scale is replicated data closed over by the per-client fit (the
         # same scalar for every client in the round).
         fit = (
@@ -341,7 +351,7 @@ def build_sharded_round(
                 eligible,
                 validation.z_score_threshold,
                 float(validation.min_clients_for_stats),
-                sum_fn=lambda x: lax.psum(x.sum(), axis_name),
+                sum_fn=lambda x: layout.client_psum(x.sum()),
             )
             valid = stats.finite & range_ok & ~anomalous
             weights = weights * valid.astype(weights.dtype)
@@ -354,64 +364,65 @@ def build_sharded_round(
                 )
             )
 
-        total_w = lax.psum(weights.sum(), axis_name)
+        total_w = layout.client_psum(weights.sum())
         robust_kept = None
         if robust is not None:
             # Order statistics need the FULL client axis on every device: gather,
             # trim each coordinate's extremes, average the kept ranks.  The result
             # is identical on all devices (same gathered inputs), i.e. replicated.
-            gathered = jax.tree.map(
-                lambda d: lax.all_gather(d, axis_name, tiled=True), delta
-            )
-            part_full = lax.all_gather(
-                (weights > 0).astype(jnp.float32), axis_name, tiled=True
+            gathered = jax.tree.map(layout.client_all_gather, delta)
+            part_full = layout.client_all_gather(
+                (weights > 0).astype(jnp.float32)
             )
             agg_delta, trim_ok, kept = robust_aggregate(robust, gathered, part_full)
             # Every device computed the identical aggregate from the identical
             # gathered inputs, but shard_map's replication checker cannot infer
             # that — a pmean over equal values IS the value and makes the
             # replication explicit (same cost class as the plain path's psum).
-            agg_delta = jax.tree.map(lambda x: lax.pmean(x, axis_name), agg_delta)
-            trim_ok_f = lax.pmean(trim_ok.astype(jnp.float32), axis_name)
-            robust_kept = lax.pmean(kept, axis_name)
+            agg_delta = jax.tree.map(layout.client_pmean, agg_delta)
+            trim_ok_f = layout.client_pmean(trim_ok.astype(jnp.float32))
+            robust_kept = layout.client_pmean(kept)
             # Fail closed below the 2k+1 floor: zero effective weight leaves params
             # AND server state untouched (same semantics as an empty round).
             total_w = total_w * trim_ok_f.astype(total_w.dtype)
         elif central_privacy is not None:
             delta = clip_deltas(delta)
             uniform = (weights > 0).astype(jnp.float32)
-            participants = jnp.maximum(lax.psum(uniform.sum(), axis_name), 1.0)
-            agg_delta = psum_weighted_mean(delta, uniform, axis_name)
+            participants = jnp.maximum(
+                layout.client_psum(uniform.sum()), 1.0
+            )
+            agg_delta = psum_weighted_mean(delta, uniform, c_axes)
             agg_delta = add_central_noise(agg_delta, noise_rng, participants)
         else:
-            agg_delta = psum_weighted_mean(delta, weights, axis_name)
+            agg_delta = psum_weighted_mean(delta, weights, c_axes)
         new_gp, new_sos = apply_server_update(gp, sos, agg_delta, total_w)
 
-        metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
+        metrics = psum_weighted_metrics(result.metrics, weights, c_axes)
         if robust_kept is not None:
             # The attacker's DELTA is trimmed but its metric row would still ride
             # the weighted mean (a NaN loss from one client would corrupt every
             # round's reported numbers) — so the reported loss/accuracy are the
             # TRIMMED means of the per-client scalars, same estimator, same k.
-            scalar_gather = lambda v: lax.all_gather(v, axis_name, tiled=True)
+            scalar_gather = layout.client_all_gather
             robust_scalars, _, _ = robust_aggregate(
                 robust,
                 {"loss": scalar_gather(result.metrics.loss),
                  "accuracy": scalar_gather(result.metrics.accuracy)},
                 part_full,
             )
-            metrics["loss"] = lax.pmean(robust_scalars["loss"], axis_name)
-            metrics["accuracy"] = lax.pmean(robust_scalars["accuracy"], axis_name)
+            metrics["loss"] = layout.client_pmean(robust_scalars["loss"])
+            metrics["accuracy"] = layout.client_pmean(robust_scalars["accuracy"])
             metrics["robust_kept_clients"] = robust_kept
         if validation is not None:
             # participating = PRE-validation cohort; valid = the subset that survived.
             # The difference is the number of rejected updates this round.
-            metrics["participating_clients"] = lax.psum(participating.sum(), axis_name)
-            metrics["valid_clients"] = lax.psum(
-                (valid & (participating > 0)).sum(), axis_name
-            )
+            metrics["participating_clients"] = layout.client_psum(
+                participating.sum())
+            metrics["valid_clients"] = layout.client_psum(
+                (valid & (participating > 0)).sum())
         else:
-            metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+            metrics["participating_clients"] = layout.client_psum(
+                (weights > 0).sum())
         sq_norms = jax.vmap(tree_sq_norm)(delta)
         return new_gp, new_sos, metrics, result.metrics, sq_norms
 
@@ -420,12 +431,12 @@ def build_sharded_round(
     # stacks stay P(clients) (replicated over model), and metrics stay P()
     # (identical on every model column by construction — see
     # multi_axis_shard_map_kwargs for why the checker is off there).
+    dspec = layout.data_spec
     inner = shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(params_specs, sos_specs, P(axis_name), P(axis_name),
-                  P(axis_name), P(), P()),
-        out_specs=(params_specs, sos_specs, P(), P(axis_name), P(axis_name)),
+        in_specs=(params_specs, sos_specs, dspec, dspec, dspec, P(), P()),
+        out_specs=(params_specs, sos_specs, P(), dspec, dspec),
         **multi_axis_shard_map_kwargs(mesh),
     )
     if not raw_keys_at_boundary:

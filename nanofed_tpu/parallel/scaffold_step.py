@@ -40,9 +40,8 @@ from nanofed_tpu.aggregation.fedavg import psum_weighted_mean, psum_weighted_met
 from nanofed_tpu.core.types import ClientData, ClientMetrics, Params, PRNGKey
 from nanofed_tpu.parallel.mesh import (
     CLIENT_AXIS,
-    ModelAxisLayout,
+    MeshLayout,
     multi_axis_shard_map_kwargs,
-    pcast_varying,
     shard_map,
 )
 from nanofed_tpu.trainer.config import TrainingConfig
@@ -110,8 +109,9 @@ def build_scaffold_round_step(
     # feed the per-client compute, and each model shard slices its piece of the
     # full aggregates before updating.  The per-client control stack stays
     # client-sharded like data.  No-op on any 1-D mesh.
-    layout = ModelAxisLayout(mesh)
+    layout = MeshLayout(mesh, axis_name=axis_name)
     layout.require_params_like(params_like)
+    c_axes = layout.client_axes
     raw_keys_at_boundary = layout.raw_keys_at_boundary
     params_specs = layout.boundary_specs(params_like)
     sos_specs = layout.boundary_specs(
@@ -126,8 +126,8 @@ def build_scaffold_round_step(
         # shards for the update at the end.
         gp_full = layout.gather_full(gp, params_specs)
         cg_full = layout.gather_full(c_global, params_specs)
-        gp_v = pcast_varying(gp_full, axis_name)
-        cg_v = pcast_varying(cg_full, axis_name)
+        gp_v = layout.cast_varying(gp_full)
+        cg_v = layout.cast_varying(cg_full)
         fit = lambda g, d, r, ci: local_fit(g, d, r, cg_v, ci, lr_scale=lr_scale)
         c_local = rngs.shape[0]
         chunking = client_chunk is not None and client_chunk < c_local
@@ -152,13 +152,13 @@ def build_scaffold_round_step(
 
         delta_y = jax.tree.map(lambda p, g: p - g[None], result.params, gp_v)
         participating = (weights > 0).astype(jnp.float32)
-        total_w = lax.psum(weights.sum(), axis_name)
+        total_w = layout.client_psum(weights.sum())
 
         # Model update: server_tx over the UNIFORM participant mean of delta y —
         # full aggregate sliced down to this device's model shard first, so the
         # server optimizer only ever touches shard-sized state.
         agg_delta = layout.slice_shard(
-            psum_weighted_mean(delta_y, participating, axis_name)
+            psum_weighted_mean(delta_y, participating, c_axes)
         )
         neg_delta = jax.tree.map(jnp.negative, agg_delta)
         updates, new_sos = server_tx.update(neg_delta, sos, gp)
@@ -176,7 +176,9 @@ def build_scaffold_round_step(
             result.delta_c,
         )
         c_sum = layout.slice_shard(
-            jax.tree.map(lambda d: lax.psum(d.sum(axis=0), axis_name), delta_c)
+            jax.tree.map(
+                lambda d: layout.client_psum(d.sum(axis=0)), delta_c
+            )
         )
         new_c_global = jax.tree.map(
             lambda c, s: jnp.where(ok, c + s / float(num_clients_total), c).astype(
@@ -185,18 +187,20 @@ def build_scaffold_round_step(
             c_global, c_sum,
         )
 
-        metrics = psum_weighted_metrics(result.metrics, weights, axis_name)
-        metrics["participating_clients"] = lax.psum((weights > 0).sum(), axis_name)
+        metrics = psum_weighted_metrics(result.metrics, weights, c_axes)
+        metrics["participating_clients"] = layout.client_psum(
+            (weights > 0).sum())
         sq_norms = jax.vmap(tree_sq_norm)(delta_y)
         return new_gp, new_sos, new_c_global, delta_c, metrics, result.metrics, sq_norms
 
+    dspec = layout.data_spec
     inner = shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(params_specs, sos_specs, params_specs, P(axis_name),
-                  P(axis_name), P(axis_name), P(axis_name), P()),
-        out_specs=(params_specs, sos_specs, params_specs, P(axis_name), P(),
-                   P(axis_name), P(axis_name)),
+        in_specs=(params_specs, sos_specs, params_specs, dspec,
+                  dspec, dspec, dspec, P()),
+        out_specs=(params_specs, sos_specs, params_specs, dspec, P(),
+                   dspec, dspec),
         **multi_axis_shard_map_kwargs(mesh),
     )
     if raw_keys_at_boundary:

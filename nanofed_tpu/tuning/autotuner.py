@@ -124,19 +124,23 @@ class PopulationSpec:
 @dataclass(frozen=True, order=True)
 class CandidateConfig:
     """One point of the swept configuration space.  Ordered (field order) so the
-    deterministic last-resort tie-break is the dataclass ordering itself."""
+    deterministic last-resort tie-break is the dataclass ordering itself.
+    ``hosts`` (default 1: every pre-multi-host candidate) is the hosts-axis
+    size of the mesh the candidate lowers on — >1 builds the 3-axis
+    ``hosts x clients x model`` mesh with hierarchical aggregation."""
 
     client_chunk: int | None
     rounds_per_block: int
     model_shards: int
     batch_size: int
+    hosts: int = 1
 
     @property
-    def key(self) -> tuple[int, int, int, int]:
+    def key(self) -> tuple[int, int, int, int, int]:
         """Stable sort key (``None`` chunk orders first as 0)."""
         return (
             self.client_chunk or 0, self.rounds_per_block,
-            self.model_shards, self.batch_size,
+            self.model_shards, self.batch_size, self.hosts,
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -145,6 +149,7 @@ class CandidateConfig:
             "rounds_per_block": self.rounds_per_block,
             "model_shards": self.model_shards,
             "batch_size": self.batch_size,
+            "hosts": self.hosts,
         }
 
     @classmethod
@@ -154,6 +159,7 @@ class CandidateConfig:
             rounds_per_block=int(d["rounds_per_block"]),
             model_shards=int(d["model_shards"]),
             batch_size=int(d["batch_size"]),
+            hosts=int(d.get("hosts", 1)),
         )
 
 
@@ -179,6 +185,11 @@ class TuningSpace:
     rounds_per_blocks: tuple[int, ...]
     model_shards: tuple[int, ...]
     batch_sizes: tuple[int, ...]
+    #: Hosts-axis sizes to sweep; (1,) = single-host meshes only.  On a
+    #: multi-process run :func:`autotune` defaults this to the process count —
+    #: a flat mesh across processes would pay one DCN reduce per client shard,
+    #: so the hierarchical topology is the only sensible default there.
+    hosts: tuple[int, ...] = (1,)
 
     @classmethod
     def default(
@@ -187,8 +198,20 @@ class TuningSpace:
         n_devices: int,
         batch_size: int,
         num_rounds: int,
+        hosts: tuple[int, ...] | None = None,
     ) -> "TuningSpace":
         from nanofed_tpu.parallel.mesh import pad_client_count
+
+        if hosts is None:
+            import jax
+
+            # THE one home of the multi-process space rule (cli.py and
+            # autotune() both rely on it): multi-process runs sweep the
+            # hierarchical hosts=(process_count,) topology — a flat client
+            # axis across processes would pay one cross-host (DCN) reduce
+            # per client shard instead of one per round.
+            pc = jax.process_count()
+            hosts = (pc,) if pc > 1 else (1,)
 
         per_dev = pad_client_count(population.num_clients, n_devices) // n_devices
         chunks: list[int | None] = [None] + [
@@ -205,6 +228,7 @@ class TuningSpace:
             rounds_per_blocks=rpbs,
             model_shards=shards,
             batch_sizes=batches,
+            hosts=tuple(hosts),
         )
 
     def candidates(self) -> list[CandidateConfig]:
@@ -213,7 +237,8 @@ class TuningSpace:
             for rpb in self.rounds_per_blocks:
                 for shards in self.model_shards:
                     for b in self.batch_sizes:
-                        out.append(CandidateConfig(chunk, rpb, shards, b))
+                        for h in self.hosts:
+                            out.append(CandidateConfig(chunk, rpb, shards, b, h))
         return sorted(set(out), key=lambda c: c.key)
 
     def to_dict(self) -> dict[str, Any]:
@@ -222,6 +247,7 @@ class TuningSpace:
             "rounds_per_blocks": list(self.rounds_per_blocks),
             "model_shards": list(self.model_shards),
             "batch_sizes": list(self.batch_sizes),
+            "hosts": list(self.hosts),
         }
 
 
@@ -435,7 +461,9 @@ def compute_cache_key(
     candidates are rejected, hence the winner).  Learning RATE is deliberately
     excluded — it never changes the compiled program's cost."""
     payload = {
-        "v": 2,
+        # v3: the swept space (and CandidateConfig) grew the hosts axis — any
+        # pre-hosts cache entry must miss.
+        "v": 3,
         "hbm_budget": hbm_budget,
         "model": _model_fingerprint(model),
         "population": population.to_dict(),
@@ -543,11 +571,18 @@ def _evaluate_candidate(
             f"model_shards {cand.model_shards} does not divide the "
             f"{n_devices} available devices"
         ))
-    n_cs = n_devices // cand.model_shards
+    if cand.hosts < 1 or n_devices % (cand.hosts * cand.model_shards) != 0:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"hosts {cand.hosts} x model_shards {cand.model_shards} does not "
+            f"divide the {n_devices} available devices — the 3-axis mesh "
+            "needs a full (hosts, clients, model) grid"
+        ))
+    n_cs = n_devices // (cand.hosts * cand.model_shards)
+    n_client_shards = cand.hosts * n_cs
     padded, step_clients, cohort, cohort_mode = _plan_layout(
-        C, n_cs, participation, cand.client_chunk
+        C, n_client_shards, participation, cand.client_chunk
     )
-    c_local = step_clients // n_cs
+    c_local = step_clients // n_client_shards
     if (
         cand.client_chunk is not None
         and cand.client_chunk < c_local
@@ -557,12 +592,32 @@ def _evaluate_candidate(
             f"client_chunk {cand.client_chunk} does not divide the "
             f"per-device client count {c_local}"
         ))
+    if (
+        cand.hosts > 1
+        and cand.client_chunk is not None
+        and cand.client_chunk > c_local
+    ):
+        # Single-host, an oversized chunk silently degrades to the full vmap
+        # (the coordinator's documented fallback, mirrored by _plan_layout);
+        # on a multi-host TOPOLOGY that silence would hide a real sizing
+        # error — the chunk exceeds the per-device slice of the per-host
+        # client shard, so the knob the operator asked for cannot engage
+        # anywhere.  Reject, stated with both quantities.
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"client_chunk {cand.client_chunk} exceeds the per-device client "
+            f"count ({c_local} of the {c_local * n_cs}-client per-host client "
+            f"shard on the hosts={cand.hosts} topology) — chunking would "
+            "silently no-op; shrink the chunk or the hosts axis"
+        ))
 
     # --- Build + lower (compile; nothing executes) ---------------------------
     training_c = dc.replace(training, batch_size=cand.batch_size)
-    mesh = make_mesh(
-        shape=(n_cs, cand.model_shards) if cand.model_shards > 1 else None
-    )
+    if cand.hosts > 1:
+        mesh = make_mesh(shape=(cand.hosts, n_cs, cand.model_shards))
+    elif cand.model_shards > 1:
+        mesh = make_mesh(shape=(n_cs, cand.model_shards))
+    else:
+        mesh = make_mesh()
     params_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
     strategy = fedavg_strategy()
     sos_abs = jax.eval_shape(lambda p: init_server_state(strategy, p), params_abs)
@@ -593,7 +648,7 @@ def _evaluate_candidate(
 
     name = (
         f"cand_chunk{cand.client_chunk or 0}_rpb{cand.rounds_per_block}"
-        f"_m{cand.model_shards}_b{cand.batch_size}"
+        f"_m{cand.model_shards}_b{cand.batch_size}_h{cand.hosts}"
     )
     try:
         if cand.rounds_per_block == 1:
@@ -723,6 +778,7 @@ def autotune(
     device_kind = str(getattr(devices[0], "device_kind", platform))
     n_devices = len(devices)
     if space is None:
+        # TuningSpace.default owns the multi-process hosts-axis rule.
         space = TuningSpace.default(
             population, n_devices, training.batch_size, num_rounds
         )
@@ -871,15 +927,15 @@ def _finish(
 def format_candidate_table(result: AutotuneResult) -> str:
     """Human-readable ranked table (what ``nanofed-tpu profile --sweep`` prints)."""
     rows = [(
-        "rank", "chunk", "rpb", "shards", "batch", "score", "peak bytes",
-        "verdict",
+        "rank", "chunk", "rpb", "shards", "batch", "hosts", "score",
+        "peak bytes", "verdict",
     )]
     for i, o in enumerate(result.outcomes):
         c = o.config
         rows.append((
             str(i + 1) if o.feasible else "-",
             str(c.client_chunk or "-"), str(c.rounds_per_block),
-            str(c.model_shards), str(c.batch_size),
+            str(c.model_shards), str(c.batch_size), str(c.hosts),
             f"{o.score:.4g}" if o.score is not None else "-",
             f"{o.cost.get('peak_bytes', 0):,}" if o.cost else "-",
             o.cost.get("verdict", o.reject_reason or "-")

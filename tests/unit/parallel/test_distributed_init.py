@@ -96,3 +96,78 @@ def test_force_calls_bare_initialize(monkeypatch):
         {"coordinator_address": None, "num_processes": None, "process_id": None}
     ]
     assert info["process_count"] == 8
+
+
+def test_cpu_gloo_collectives_selected_before_init(monkeypatch):
+    """On a CPU platform the wrapper must select the gloo cross-process
+    collectives BEFORE jax.distributed.initialize — without them every
+    multi-device program dies with XLA's 'Multiprocess computations aren't
+    implemented on the CPU backend'.  An operator's explicit choice wins."""
+    from types import SimpleNamespace
+
+    from jax._src import xla_bridge
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    updates = []
+    monkeypatch.setattr(
+        mesh_mod.jax.config, "update",
+        lambda name, value: updates.append((name, value)),
+    )
+    monkeypatch.setattr(
+        xla_bridge, "CPU_COLLECTIVES_IMPLEMENTATION",
+        SimpleNamespace(value="none"),
+    )
+    mesh_mod._enable_cpu_collectives()
+    assert updates == [("jax_cpu_collectives_implementation", "gloo")]
+
+    # Operator override: a non-"none" value is left alone.
+    updates.clear()
+    monkeypatch.setattr(
+        xla_bridge, "CPU_COLLECTIVES_IMPLEMENTATION",
+        SimpleNamespace(value="mpi"),
+    )
+    mesh_mod._enable_cpu_collectives()
+    assert updates == []
+
+    # Non-CPU platforms carry their own collectives: nothing to select.
+    updates.clear()
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setattr(
+        xla_bridge, "CPU_COLLECTIVES_IMPLEMENTATION",
+        SimpleNamespace(value="none"),
+    )
+    mesh_mod._enable_cpu_collectives()
+    assert updates == []
+
+
+def test_multi_process_path_enables_collectives(monkeypatch):
+    """The explicit-coordinator path routes through the collectives selection
+    exactly once, before jax.distributed.initialize."""
+    order = []
+    monkeypatch.setattr(
+        mesh_mod, "_enable_cpu_collectives",
+        lambda: order.append("collectives"),
+    )
+    monkeypatch.setattr(
+        mesh_mod.jax.distributed, "initialize",
+        lambda **kw: order.append("initialize"),
+    )
+    monkeypatch.setattr(mesh_mod.jax, "process_index", lambda: 0, raising=False)
+    monkeypatch.setattr(mesh_mod.jax, "process_count", lambda: 2, raising=False)
+    initialize_distributed(
+        coordinator_address="localhost:1", num_processes=2, process_id=0
+    )
+    assert order == ["collectives", "initialize"]
+
+
+def test_single_process_path_touches_no_config(monkeypatch):
+    """The documented no-op must not flip global config either."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    updates = []
+    monkeypatch.setattr(
+        mesh_mod.jax.config, "update",
+        lambda name, value: updates.append((name, value)),
+    )
+    assert initialize_distributed()["process_count"] == 1
+    assert updates == []

@@ -241,3 +241,67 @@ class TestCacheAndFeasibility:
         with pytest.raises(AutotuneError, match="eval_every"):
             _sweep(tmp_path, space=space, eval_every=2, cache_dir=None,
                    out_dir=None)
+
+
+class TestHostsAxis:
+    """The hosts axis of the search space (3-axis hosts x clients x model
+    meshes): static feasibility, the per-host-shard-vs-chunk rejection rule,
+    and cache-key sensitivity — all exercised through rejection paths and pure
+    helpers, zero compiles."""
+
+    def test_candidates_cross_hosts_axis(self):
+        space = TuningSpace((None,), (1,), (1,), (16,), hosts=(1, 2))
+        cands = space.candidates()
+        assert sorted(c.hosts for c in cands) == [1, 2]
+        assert all(c.to_dict()["hosts"] in (1, 2) for c in cands)
+
+    def test_hosts_default_is_single_host(self):
+        assert TuningSpace((None,), (1,), (1,), (16,)).hosts == (1,)
+        assert CandidateConfig(None, 1, 1, 16).hosts == 1
+
+    def test_hosts_grid_rejection_is_stated(self, tmp_path):
+        # 3 hosts cannot tile 8 devices: every candidate is rejected with the
+        # grid reason in the artifact, never silently skipped.
+        space = TuningSpace((None,), (1,), (1,), (16,), hosts=(3,))
+        with pytest.raises(AutotuneError, match="does not divide"):
+            _sweep(tmp_path, space=space, cache_dir=None, out_dir=None)
+
+    def test_chunk_exceeding_per_host_shard_is_rejected(self, tmp_path):
+        # hosts=2 over 8 devices -> 8 client shards -> 1 client/device at this
+        # 8-client population; a chunk of 4 exceeds the per-host shard and
+        # would silently no-op — the multi-host sweep must SAY so instead
+        # (reusing _plan_layout's fallback rule).  Single-host the same chunk
+        # follows the documented silent-degrade rule, so only the hosts=2
+        # candidate dies; with no feasible single-host candidate in the space,
+        # the sweep raises with the stated reason.
+        space = TuningSpace((4,), (1,), (1,), (16,), hosts=(2,))
+        with pytest.raises(AutotuneError, match="per-host client shard"):
+            _sweep(tmp_path, space=space, cache_dir=None, out_dir=None)
+
+    def test_hosts_axis_changes_cache_key(self):
+        from nanofed_tpu.tuning.autotuner import compute_cache_key
+
+        base = dict(
+            model=MODEL, population=POP, training=TRAINING,
+            participation=1.0, num_rounds=4, eval_every=0,
+            device_kind="cpu", num_devices=8, hbm_budget=None,
+        )
+        one = compute_cache_key(
+            space=TuningSpace((None,), (1,), (1,), (16,), hosts=(1,)), **base
+        )
+        two = compute_cache_key(
+            space=TuningSpace((None,), (1,), (1,), (16,), hosts=(2,)), **base
+        )
+        assert one != two
+
+    def test_winner_hosts_survives_artifact_round_trip(self):
+        from nanofed_tpu.tuning.autotuner import AutotuneResult
+
+        result = AutotuneResult(
+            winner=CandidateConfig(None, 1, 1, 16, hosts=2),
+            outcomes=[], scoring_basis="?", platform="cpu",
+            device_kind="cpu", num_devices=8, hbm_budget_bytes=None,
+            budget_basis="?", cache_key="k",
+        )
+        back = AutotuneResult.from_dict(result.to_dict())
+        assert back.winner.hosts == 2
