@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke profile-smoke loadtest-smoke autotune-smoke multihost-smoke multihost-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke multihost-smoke multihost-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -31,6 +31,21 @@ bench-smoke:
 # (tier-1-safe: seconds of real time, determinism from the plan's seed).
 chaos-smoke:
 	python -m pytest tests/integration/test_chaos.py::test_chaos_smoke -q
+
+# Host-chaos smoke (parallel.resilience + faults host kinds): a REAL
+# 2-process kill-and-recover cycle — a seeded plan kills one worker
+# mid-round, the supervisor detects it (process exit / frozen heartbeat),
+# reaps every survivor, re-forms the mesh over the surviving host set,
+# resumes from the newest generation committed by all participants (at most
+# one block of rounds re-run), rejoins the failed host, and asserts
+# post-recovery loss parity vs an unfailed shrunk-mesh run + zero orphans.
+# The telemetry digest at the end proves metrics-summary reads the new
+# host_failure / recovery records.
+hostchaos-smoke:
+	python scripts/multihost_harness.py hostchaos --num-processes 2 \
+	  --rounds 6 --block-size 2 --timeout 240 --out-dir /tmp/nanofed_hostchaos_runs
+	python -m nanofed_tpu.cli metrics-summary /tmp/nanofed_multihost/telemetry | \
+	  python -c "import json,sys; d=json.load(sys.stdin); assert d['host_failures'] and d['recoveries'], d; print('metrics-summary digests host_failure/recovery OK')"
 
 # Loadtest smoke (nanofed_tpu.loadgen): a ~200-client synthetic swarm on a
 # VirtualClock drives BOTH serving paths — per-submit and batched device

@@ -598,6 +598,45 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0 if all(h["status"] == "COMPLETED" for h in history) else 1
 
 
+def _cmd_chaos_plan(args: argparse.Namespace) -> int:
+    """Generate a seeded FaultPlan (chaos harness) and print or save it —
+    the operator surface for drills: `serve --chaos-plan` consumes the wire/
+    client kinds, the hostchaos supervisor the host kinds."""
+    from nanofed_tpu.faults import FaultPlan
+
+    try:
+        plan = FaultPlan.generate(
+            args.seed,
+            [f"c{i}" for i in range(args.clients)],
+            args.rounds,
+            crash_fraction=args.crash_fraction,
+            straggler_fraction=args.straggler_fraction,
+            straggler_delay_s=args.straggler_delay,
+            drop_fraction=args.drop_fraction,
+            duplicate_fraction=args.duplicate_fraction,
+            corrupt_fraction=args.corrupt_fraction,
+            server_kill_round=args.server_kill_round,
+            hosts=args.hosts,
+            host_crash_count=args.host_crashes,
+            host_stall_count=args.host_stalls,
+            dcn_degrade_fraction=args.dcn_degrade_fraction,
+            dcn_delay_s=args.dcn_delay,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not plan.events:
+        print("error: the requested plan is empty — give at least one "
+              "fraction/count/round", file=sys.stderr)
+        return 2
+    if args.out is not None:
+        plan.save(args.out)
+        print(f"wrote {len(plan.events)} events to {args.out}")
+    else:
+        print(plan.to_json())
+    return 0
+
+
 def _cmd_metrics_summary(args: argparse.Namespace) -> int:
     """Digest a run's ``telemetry.jsonl`` (observability subsystem): per-phase span
     durations, round outcomes, and headline counters, as one JSON document."""
@@ -932,6 +971,35 @@ def main(argv: list[str] | None = None) -> int:
         "records) here; live metrics are always scrapable at GET /metrics",
     )
 
+    chaos_plan = sub.add_parser(
+        "chaos-plan",
+        help="generate a seeded FaultPlan JSON (nanofed_tpu.faults) — client "
+        "wire faults and/or host-targeted mesh faults (host_crash/host_stall/"
+        "dcn_degrade) — consumable by `serve --chaos-plan` and the multihost "
+        "harness's hostchaos supervisor",
+    )
+    chaos_plan.add_argument("--seed", type=int, default=0)
+    chaos_plan.add_argument("--clients", type=int, default=0,
+                            help="client population the *_fraction draws "
+                            "sample from (client ids are c0..cN-1)")
+    chaos_plan.add_argument("--rounds", type=int, default=10)
+    chaos_plan.add_argument("--crash-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--straggler-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--straggler-delay", type=float, default=1.0)
+    chaos_plan.add_argument("--drop-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--duplicate-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--corrupt-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--server-kill-round", type=int, default=None)
+    chaos_plan.add_argument("--hosts", type=int, default=0,
+                            help="hosts-axis size the host faults draw over")
+    chaos_plan.add_argument("--host-crashes", type=int, default=0)
+    chaos_plan.add_argument("--host-stalls", type=int, default=0)
+    chaos_plan.add_argument("--dcn-degrade-fraction", type=float, default=0.0)
+    chaos_plan.add_argument("--dcn-delay", type=float, default=0.5,
+                            metavar="SECONDS")
+    chaos_plan.add_argument("--out", default=None, metavar="PLAN.json",
+                            help="write the plan here (default: stdout)")
+
     summary = sub.add_parser(
         "metrics-summary",
         help="digest a run's telemetry.jsonl: per-phase durations, round outcomes, "
@@ -1076,6 +1144,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_bench(args)
     if args.cmd == "serve":
         return _cmd_serve(args)
+    if args.cmd == "chaos-plan":
+        return _cmd_chaos_plan(args)
     if args.cmd == "metrics-summary":
         return _cmd_metrics_summary(args)
     if args.cmd == "profile":

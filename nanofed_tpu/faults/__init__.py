@@ -13,8 +13,10 @@ state-store crash recovery.  See docs/robustness.md.
 is imported lazily.
 """
 
+from nanofed_tpu.faults.host_injector import HostChaosInjector
 from nanofed_tpu.faults.plan import (
     FAULT_KINDS,
+    HOST_KINDS,
     ChaosSchedule,
     FaultEvent,
     FaultPlan,
@@ -23,10 +25,12 @@ from nanofed_tpu.faults.plan import (
 
 __all__ = [
     "FAULT_KINDS",
+    "HOST_KINDS",
     "ChaosClient",
     "ChaosSchedule",
     "FaultEvent",
     "FaultPlan",
+    "HostChaosInjector",
     "InjectedServerCrash",
 ]
 

@@ -1,0 +1,86 @@
+"""Host-boundary fault injection: one multi-host WORKER under a plan.
+
+``HostChaosInjector`` is the hosts-axis sibling of :class:`ChaosClient`: where
+that wrapper perturbs one HTTP client's submits, this one perturbs one
+``jax.distributed`` worker process's round loop, applying the host fault kinds
+exactly where a real failing host would produce them:
+
+* ``host_crash``  — the process exits immediately (``os._exit``, no cleanup,
+  no Python teardown): to every peer this is indistinguishable from a kernel
+  panic or preemption — sockets drop, heartbeats freeze, the in-flight gloo
+  collective never completes.
+* ``host_stall``  — the process stops making progress but STAYS ALIVE
+  (``stall_now`` returns True and the worker parks in a sleep loop, never
+  dispatching, never heartbeating): the failure mode liveness probes cannot
+  see, detectable only by frozen heartbeat sequence numbers and by the
+  collective watchdog's deadline on the peers.
+* ``dcn_degrade`` — ``seconds`` of injected latency before this host's
+  cross-host exchange for ``count`` rounds: a degraded-but-alive DCN link
+  that must NOT trip a correctly-sized watchdog deadline.
+
+Like ``ChaosClient``, the injector does not re-implement anything: the worker
+asks it three questions per round and the production round program runs
+untouched (traced code never sees the chaos — ``--strict``/fedlint clean).
+
+Pure stdlib, importable by the harness worker before JAX initializes.
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+
+from nanofed_tpu.faults.plan import ChaosSchedule, FaultEvent
+
+__all__ = ["HostChaosInjector"]
+
+#: The exit code an injected ``host_crash`` dies with — distinctive, so the
+#: supervisor can tell a planned kill from an organic worker bug in telemetry
+#: (both recover the same way).
+HOST_CRASH_EXIT_CODE = 31
+
+
+class HostChaosInjector:
+    """Drives one worker process through the host faults of a plan.
+
+    Use at the top of the worker's round loop::
+
+        injector = HostChaosInjector(schedule, host=process_id)
+        for r in range(rounds):
+            injector.maybe_fail(r)        # may os._exit / park forever
+            clock.sleep(injector.dcn_delay_s(r))   # degraded DCN link
+            ...watchdogged dispatch...
+    """
+
+    def __init__(self, schedule: ChaosSchedule, host: int) -> None:
+        self.schedule = schedule
+        self.host = int(host)
+
+    # -- queries (side-effect-free beyond schedule consumption) -----------
+
+    def take_fault(self, round_number: int) -> FaultEvent | None:
+        """The terminal fault (``host_crash``/``host_stall``) due for this
+        host at this round, consumed exactly once; None otherwise."""
+        return self.schedule.take_host_fault(self.host, round_number)
+
+    def dcn_delay_s(self, round_number: int) -> float:
+        """Injected cross-host latency to apply before this round's dispatch."""
+        return self.schedule.dcn_delay(self.host, round_number)
+
+    # -- the actual boundary action ---------------------------------------
+
+    def maybe_fail(self, round_number: int) -> None:
+        """Apply the terminal fault due this round, if any: ``host_crash``
+        exits the process with :data:`HOST_CRASH_EXIT_CODE`; ``host_stall``
+        parks forever (alive, silent).  Returns normally when no fault fires."""
+        event = self.take_fault(round_number)
+        if event is None:
+            return
+        if event.kind == "host_crash":
+            # No cleanup on purpose: atexit/finally handlers would make the
+            # death look tidier than a real host loss.
+            os._exit(HOST_CRASH_EXIT_CODE)
+        # host_stall: alive but silent, forever.  Plain time.sleep (not the
+        # injectable clock): a stalled host's time is nobody's schedule.
+        while True:  # pragma: no cover - only the peers' watchdog ends this
+            _time.sleep(3600)

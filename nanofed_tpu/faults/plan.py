@@ -38,6 +38,19 @@ kind            where it fires
 ``server_kill`` the ``NetworkCoordinator`` round loop: raises
                 :class:`InjectedServerCrash` mid-round; recovery is the
                 ``persistence.state_store`` resume path
+``host_crash``  host boundary (``faults.host_injector.HostChaosInjector``
+                inside a multi-host worker): the worker PROCESS exits
+                mid-round — its peers surface the loss through the
+                ``parallel.resilience`` watchdog/heartbeats and the
+                supervisor re-forms the mesh over the survivors
+``host_stall``  host boundary: the worker stops making progress but stays
+                alive (heartbeats freeze, collectives never complete) — the
+                failure mode a liveness check cannot see and only a
+                deadline-bracketed dispatch can
+``dcn_degrade`` host boundary: ``seconds`` of injected latency on this
+                host's cross-host (DCN) exchanges for ``count`` rounds —
+                degraded-but-alive inter-host links that must NOT trip the
+                watchdog when the deadline is sized right
 ==============  ============================================================
 
 Pure stdlib — importable by anything (the communication layer takes a schedule
@@ -54,6 +67,7 @@ from typing import Any, Iterable
 
 __all__ = [
     "FAULT_KINDS",
+    "HOST_KINDS",
     "ChaosSchedule",
     "FaultEvent",
     "FaultPlan",
@@ -62,12 +76,17 @@ __all__ = [
 
 FAULT_KINDS = (
     "crash", "delay", "skew", "corrupt", "duplicate", "drop", "ack_drop",
-    "server_kill",
+    "server_kill", "host_crash", "host_stall", "dcn_degrade",
 )
 
 #: Kinds the server-side wire middleware handles (everything else is a client-
-#: boundary or round-loop fault).
+#: boundary, host-boundary, or round-loop fault).
 WIRE_KINDS = ("drop", "ack_drop", "delay")
+
+#: Kinds targeting a whole HOST (a multi-host worker process) rather than one
+#: client or the server: consumed by ``faults.host_injector`` inside the
+#: worker, detected by ``parallel.resilience`` on the surviving peers.
+HOST_KINDS = ("host_crash", "host_stall", "dcn_degrade")
 
 
 class InjectedServerCrash(RuntimeError):
@@ -84,11 +103,15 @@ class InjectedServerCrash(RuntimeError):
 class FaultEvent:
     """One fault: ``kind`` fires against ``client`` in ``round``.
 
-    ``seconds`` parameterizes ``delay`` (latency) and ``skew`` (rounds of
-    header skew, as an int); ``count`` is how many times a one-shot wire fault
-    fires (``drop``/``ack_drop``) or how many extra duplicates are sent.
-    ``client`` is None for ``server_kill``.  Simulator clients are ints,
-    network clients strings — both are stored as given and compared as given.
+    ``seconds`` parameterizes ``delay`` (latency), ``skew`` (rounds of header
+    skew, as an int), and ``dcn_degrade`` (injected cross-host latency);
+    ``count`` is how many times a one-shot wire fault fires
+    (``drop``/``ack_drop``), how many extra duplicates are sent, or how many
+    rounds a ``dcn_degrade`` persists.  ``client`` is None for ``server_kill``
+    and the host kinds; the host kinds instead carry ``host`` — the hosts-axis
+    row (== ``jax.process_index`` at launch) the fault targets.  Simulator
+    clients are ints, network clients strings — both are stored as given and
+    compared as given.
     """
 
     kind: str
@@ -96,6 +119,7 @@ class FaultEvent:
     client: str | int | None = None
     seconds: float = 0.0
     count: int = 1
+    host: int | None = None
 
     def __post_init__(self) -> None:
         if self.kind not in FAULT_KINDS:
@@ -108,6 +132,15 @@ class FaultEvent:
             raise ValueError("seconds must be >= 0")
         if self.kind == "server_kill" and self.client is not None:
             raise ValueError("server_kill is not a per-client fault")
+        if self.kind in HOST_KINDS:
+            if self.host is None:
+                raise ValueError(f"{self.kind} needs a target host")
+            if self.host < 0:
+                raise ValueError("host must be >= 0")
+            if self.client is not None:
+                raise ValueError(f"{self.kind} is not a per-client fault")
+        elif self.host is not None:
+            raise ValueError(f"{self.kind} does not take a host")
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"kind": self.kind, "round": self.round}
@@ -117,6 +150,8 @@ class FaultEvent:
             d["seconds"] = self.seconds
         if self.count != 1:
             d["count"] = self.count
+        if self.host is not None:
+            d["host"] = self.host
         return d
 
     @classmethod
@@ -127,6 +162,7 @@ class FaultEvent:
             client=d.get("client"),
             seconds=float(d.get("seconds", 0.0)),
             count=int(d.get("count", 1)),
+            host=None if d.get("host") is None else int(d["host"]),
         )
 
 
@@ -160,12 +196,21 @@ class FaultPlan:
         duplicate_fraction: float = 0.0,
         corrupt_fraction: float = 0.0,
         server_kill_round: int | None = None,
+        hosts: int = 0,
+        host_crash_count: int = 0,
+        host_stall_count: int = 0,
+        dcn_degrade_fraction: float = 0.0,
+        dcn_delay_s: float = 0.5,
     ) -> "FaultPlan":
         """Draw a plan from ``seed``: each ``*_fraction`` of the client
         population is assigned that fault at a seeded round.  Crashes land in
         the first half of the run (so the survival claim covers most rounds);
-        wire faults are spread uniformly.  Deterministic: the same arguments
-        always yield the same plan."""
+        wire faults are spread uniformly.  With ``hosts`` > 0 the host-boundary
+        kinds draw too: ``host_crash_count``/``host_stall_count`` hosts (never
+        the same host twice — a run must keep a quorum to recover INTO) fail at
+        seeded mid-run rounds, and ``dcn_degrade_fraction`` of the hosts get
+        ``dcn_delay_s`` of injected cross-host latency at a seeded round.
+        Deterministic: the same arguments always yield the same plan."""
         rng = random.Random(seed)
         pool = list(clients)
         events: list[FaultEvent] = []
@@ -193,7 +238,32 @@ class FaultPlan:
                 ))
         if server_kill_round is not None:
             events.append(FaultEvent(kind="server_kill", round=server_kill_round))
-        events.sort(key=lambda e: (e.round, e.kind, str(e.client)))
+        if host_crash_count or host_stall_count or dcn_degrade_fraction:
+            if hosts < 1:
+                raise ValueError("host faults need hosts >= 1 in generate()")
+            host_pool = list(range(hosts))
+            n_fail = host_crash_count + host_stall_count
+            if n_fail > len(host_pool):
+                raise ValueError(
+                    f"cannot fail {n_fail} of {hosts} hosts (each host fails "
+                    "at most once per plan)"
+                )
+            failed = rng.sample(host_pool, n_fail)
+            for i, h in enumerate(failed):
+                kind = "host_crash" if i < host_crash_count else "host_stall"
+                # Mid-run like client crashes: rounds [1, num_rounds/2] so the
+                # recovered mesh still has most of the run left to prove itself.
+                events.append(FaultEvent(
+                    kind=kind, round=1 + rng.randrange(max(1, num_rounds // 2)),
+                    host=h,
+                ))
+            n_dcn = round(dcn_degrade_fraction * hosts)
+            for h in rng.sample(host_pool, n_dcn) if n_dcn else []:
+                events.append(FaultEvent(
+                    kind="dcn_degrade", round=rng.randrange(num_rounds),
+                    host=h, seconds=dcn_delay_s,
+                ))
+        events.sort(key=lambda e: (e.round, e.kind, str(e.client), -1 if e.host is None else e.host))
         return cls(seed=seed, events=tuple(events))
 
     # -- serialization ---------------------------------------------------
@@ -322,6 +392,38 @@ class ChaosSchedule:
                 if self._take(i, e):
                     return True
         return False
+
+    # -- host-boundary queries (faults.host_injector) ---------------------
+
+    def take_host_fault(self, host: int, round_number: int) -> FaultEvent | None:
+        """The terminal host fault (``host_crash``/``host_stall``) firing
+        against ``host`` at or before this round, consumed exactly once — a
+        worker that survived its scheduled round (e.g. it was down for other
+        reasons) still dies at the next boundary check, matching the permanent
+        semantics of client ``crash``."""
+        for i, e in enumerate(self.plan.events):
+            if e.kind not in ("host_crash", "host_stall"):
+                continue
+            if e.host != host or e.round > round_number:
+                continue
+            if self._take(i, e):
+                return e
+        return None
+
+    def dcn_delay(self, host: int, round_number: int) -> float:
+        """Injected cross-host (DCN) latency for ``host`` this round: the sum
+        of the ``dcn_degrade`` events covering it.  An event with ``count`` N
+        degrades N consecutive dispatches starting at its round, each firing
+        consumed (and counted) separately."""
+        total = 0.0
+        for i, e in enumerate(self.plan.events):
+            if e.kind != "dcn_degrade" or e.host != host:
+                continue
+            if not (e.round <= round_number < e.round + e.count):
+                continue
+            if self._take(i, e):
+                total += e.seconds
+        return total
 
     def counts(self) -> dict[str, int]:
         """Fired-fault totals by kind (for run records / assertions)."""

@@ -182,6 +182,8 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     loadtests: dict[str, dict[str, Any]] = {}
     autotunes: dict[str, dict[str, Any]] = {}
     topology: dict[str, Any] | None = None
+    host_failures: list[dict[str, Any]] = []
+    recoveries: list[dict[str, Any]] = []
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -243,6 +245,28 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 }
+            elif rtype == "host_failure":
+                # One detected host-level failure (parallel.resilience /
+                # the hostchaos supervisor): who died, how, when.
+                host_failures.append({
+                    k: rec[k]
+                    for k in (
+                        "kind", "host", "round", "generation",
+                        "detection_s", "detail",
+                    )
+                    if k in rec
+                })
+            elif rtype == "recovery":
+                # One completed elastic recovery: the MTTR evidence record.
+                recoveries.append({
+                    k: rec[k]
+                    for k in (
+                        "recovery_s", "resumed_generation", "resumed_round",
+                        "rounds_lost", "hosts_before", "hosts_after",
+                        "reshape", "rejoin",
+                    )
+                    if k in rec
+                })
             elif rtype == "loadtest":
                 # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
                 # by serving path; last record per mode wins (a re-run
@@ -289,6 +313,24 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Autotuner layer (nanofed_tpu.tuning): the winner config, scoring
         # basis, and sweep economics per swept configuration.
         out["autotunes"] = dict(sorted(autotunes.items()))
+    if host_failures:
+        # Host fault-tolerance layer (parallel.resilience): every detected
+        # host failure, by kind, plus the recovery outcomes with MTTR — a
+        # hostchaos run's telemetry digests to "what died, how fast did the
+        # mesh come back".
+        by_kind: dict[str, int] = {}
+        for f in host_failures:
+            kind = str(f.get("kind", "?"))
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        out["host_failures"] = {"by_kind": by_kind, "events": host_failures}
+    if recoveries:
+        mttrs = [float(r["recovery_s"]) for r in recoveries if "recovery_s" in r]
+        out["recoveries"] = {
+            "count": len(recoveries),
+            "events": recoveries,
+        }
+        if mttrs:
+            out["recoveries"]["mttr"] = _digest(mttrs)
     if snapshot is not None:
         headline = {}
         for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",

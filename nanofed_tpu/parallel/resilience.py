@@ -1,0 +1,408 @@
+"""Host-failure detection for the multi-host mesh: heartbeats + watchdog.
+
+The wire tier (PR 6) already fails loudly and recovers: a dead client is
+silence the round timeout absorbs, a dead server is an exception the state
+store resumes through.  The MESH tier had neither — a worker process dying
+mid-round leaves every surviving host blocked inside a gloo cross-host psum
+*forever*, because the collective has no deadline and the dead peer will never
+arrive.  This module gives the mesh the same fault model the wire has, without
+touching traced code:
+
+* :class:`HostFailure` — the typed, *recoverable* error a detected host loss
+  surfaces as (subclasses ``RuntimeError`` so ``persistence.is_recoverable``
+  treats it like any crash: supervisors re-form and resume).
+* :class:`Heartbeat` / :class:`HostMonitor` — liveness via atomically-written
+  per-host heartbeat files carrying a monotonically increasing sequence
+  number.  The monitor never compares wall clocks across hosts (clock skew is
+  a failure mode of its own): it tracks *when it last saw each host's sequence
+  advance* on its OWN injectable :class:`~nanofed_tpu.utils.clock.Clock`, so a
+  ``host_stall`` (alive but frozen — the failure a process liveness probe
+  cannot see) surfaces as a bounded-age verdict, virtual-clock-testable.
+* :class:`CollectiveWatchdog` — brackets every cross-host dispatch with a
+  deadline ON THE HOST SIDE (the jitted program is untouched, so ``--strict``
+  and fedlint stay clean).  A dead or stalled peer turns the infinite gloo
+  hang into a :class:`HostFailure` within ``deadline_s``.  The sync
+  :meth:`~CollectiveWatchdog.run` path drives real workers (the dispatch runs
+  in a daemon thread; on timeout the thread is abandoned and the worker must
+  exit — a hung gloo collective cannot be cancelled, only orphaned); the async
+  :meth:`~CollectiveWatchdog.guard` path is the same deadline bracket on the
+  injectable clock, which is how tests prove "would hang forever without the
+  watchdog" in milliseconds of real time.
+
+Detection windows (see docs/robustness.md "Host fault model"): a crash is
+detected by the supervisor within one poll interval (process exit) or by peers
+within ``deadline_s`` (hung collective); a stall is detected within
+``stall_timeout_s`` (frozen heartbeat) or ``deadline_s``, whichever trips
+first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+from pathlib import Path
+from typing import Any, Callable, NamedTuple
+
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = [
+    "CollectiveWatchdog",
+    "Heartbeat",
+    "HostFailure",
+    "HostMonitor",
+    "HostState",
+    "no_orphans",
+    "resilience_metrics",
+]
+
+
+class HostFailure(RuntimeError):
+    """A detected host-level failure: which host, how it failed, when.
+
+    ``kind`` is one of ``"host_crash"`` (process gone), ``"host_stall"``
+    (alive but frozen heartbeat), or ``"collective_timeout"`` (a cross-host
+    dispatch exceeded the watchdog deadline — the observer cannot tell WHICH
+    peer is dead, only that one is).  Subclasses ``RuntimeError`` on purpose:
+    ``persistence.is_recoverable`` must treat a host loss exactly like a
+    server crash — re-form, resume, retry.
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        host: int | None = None,
+        round_number: int | None = None,
+        detail: str = "",
+    ) -> None:
+        self.kind = kind
+        self.host = host
+        self.round_number = round_number
+        self.detail = detail
+        where = f"host {host}" if host is not None else "a peer host"
+        at = f" in round {round_number}" if round_number is not None else ""
+        super().__init__(
+            f"{kind}: {where}{at}" + (f" — {detail}" if detail else "")
+        )
+
+
+def resilience_metrics(registry: Any | None = None) -> dict[str, Any]:
+    """The three host-fault-tolerance instruments, declared ONCE so the
+    monitor, the watchdog, and the supervisor cannot drift on names:
+
+    * ``nanofed_host_failures_total{kind=...}`` — detected host failures;
+    * ``nanofed_mesh_reshapes_total`` — mesh re-formations over a shrunk (or
+      re-grown, on rejoin) host set;
+    * ``nanofed_recovery_seconds`` — failure detection → first completed
+      post-recovery round (the MTTR the hostchaos artifact records).
+    """
+    from nanofed_tpu.observability.registry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    return {
+        "host_failures": reg.counter(
+            "nanofed_host_failures_total",
+            "Detected host-level failures, by kind (host_crash/host_stall/"
+            "collective_timeout)",
+            labels=("kind",),
+        ),
+        "mesh_reshapes": reg.counter(
+            "nanofed_mesh_reshapes_total",
+            "Mesh re-formations over a changed host set (shrink on failure, "
+            "regrow on rejoin)",
+        ),
+        "recovery_seconds": reg.histogram(
+            "nanofed_recovery_seconds",
+            "Failure detection to first completed post-recovery round (MTTR)",
+        ),
+    }
+
+
+class HostState(NamedTuple):
+    """One host's liveness as the monitor sees it."""
+
+    host: int
+    seq: int
+    round_number: int | None
+    generation: int | None
+    status: str
+    age_s: float  # time since the monitor last saw seq advance (its clock)
+
+
+class Heartbeat:
+    """The worker half: an atomically-published per-host heartbeat file.
+
+    Each :meth:`beat` bumps a monotonically increasing sequence number and
+    rewrites ``host_<id>.hb.json`` via tmp + ``replace`` (readers never see a
+    torn write).  The payload carries round/generation/status so the
+    supervisor's recovery decision (which generation is safe to resume from)
+    reads the same file its liveness check does.
+    """
+
+    def __init__(self, directory: str | Path, host: int) -> None:
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.host = int(host)
+        self.path = self.dir / f"host_{self.host}.hb.json"
+        self._seq = 0
+
+    def beat(
+        self,
+        round_number: int | None = None,
+        generation: int | None = None,
+        status: str = "running",
+    ) -> None:
+        self._seq += 1
+        payload = {
+            "host": self.host,
+            "seq": self._seq,
+            "round": round_number,
+            "generation": generation,
+            "status": status,
+            "wall_t": _time.time(),  # human forensics only — never compared
+        }
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(payload))
+        tmp.replace(self.path)
+
+
+class HostMonitor:
+    """The supervisor half: reads every heartbeat file and answers "which
+    hosts have stopped making progress?" on an injectable clock.
+
+    A host is **stalled** once its sequence number has not advanced for
+    ``stall_timeout_s`` on the monitor's clock — no cross-host clock
+    comparison, so NTP skew between workers cannot fake a failure.  A host
+    with no heartbeat file yet is *missing*, not stalled (bring-up is not a
+    fault); pair with process polling to classify exits as crashes.
+
+    Each host is flagged (and counted in ``nanofed_host_failures_total
+    {kind="host_stall"}``) at most once until :meth:`clear`-ed — recovery or
+    rejoin resets the verdict.
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        stall_timeout_s: float,
+        clock: Clock | None = None,
+        registry: Any | None = None,
+    ) -> None:
+        if stall_timeout_s <= 0:
+            raise ValueError("stall_timeout_s must be > 0")
+        self.dir = Path(directory)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self._clock = clock or SYSTEM_CLOCK
+        self._last_advance: dict[int, tuple[int, float]] = {}  # host -> (seq, t)
+        self._flagged: set[int] = set()
+        self._m = resilience_metrics(registry)
+        self._log = Logger()
+
+    def poll(self) -> dict[int, HostState]:
+        """Read every heartbeat file and refresh the per-host age bookkeeping.
+        Torn/unparseable files are skipped (the next beat supersedes them)."""
+        now = self._clock.time()
+        states: dict[int, HostState] = {}
+        for path in sorted(self.dir.glob("host_*.hb.json")):
+            try:
+                payload = json.loads(path.read_text())
+                host, seq = int(payload["host"]), int(payload["seq"])
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                continue
+            prev = self._last_advance.get(host)
+            if prev is None or seq > prev[0]:
+                self._last_advance[host] = (seq, now)
+            seen_seq, seen_t = self._last_advance[host]
+            states[host] = HostState(
+                host=host,
+                seq=seen_seq,
+                round_number=payload.get("round"),
+                generation=payload.get("generation"),
+                status=str(payload.get("status", "?")),
+                age_s=now - seen_t,
+            )
+        return states
+
+    def stalled(self) -> list[HostFailure]:
+        """Hosts whose heartbeat has been frozen past the stall timeout —
+        newly flagged ones only (each failure reported once until cleared)."""
+        failures = []
+        for host, state in self.poll().items():
+            if state.age_s <= self.stall_timeout_s or host in self._flagged:
+                continue
+            self._flagged.add(host)
+            self._m["host_failures"].inc(kind="host_stall")
+            self._log.warning(
+                "host %d stalled: heartbeat frozen at seq %d for %.1fs "
+                "(timeout %.1fs)", host, state.seq, state.age_s,
+                self.stall_timeout_s,
+            )
+            failures.append(HostFailure(
+                "host_stall", host=host, round_number=state.round_number,
+                detail=f"heartbeat frozen for {state.age_s:.1f}s",
+            ))
+        return failures
+
+    def clear(self, host: int) -> None:
+        """Forget a host's stall verdict and age bookkeeping (recovery killed
+        and reaped it, or it is rejoining with a fresh heartbeat)."""
+        self._flagged.discard(host)
+        self._last_advance.pop(host, None)
+
+
+class CollectiveWatchdog:
+    """Deadline-brackets a cross-host dispatch so a dead/stalled peer surfaces
+    as :class:`HostFailure` instead of an infinite collective hang.
+
+    The bracket wraps the HOST-side dispatch (the call that launches the
+    compiled program and blocks on its result); nothing traced changes.  Two
+    entry points, one deadline rule:
+
+    * :meth:`run` (sync, real workers): the dispatch runs in a daemon thread;
+      the caller waits at most ``deadline_s``.  On timeout the thread — stuck
+      inside gloo, uncancellable — is deliberately orphaned and the caller
+      must treat the process as lost (exit; the supervisor reaps and
+      re-forms).  That is the honest contract: a hung collective cannot be
+      recovered *within* the process.
+    * :meth:`guard` (async, injectable clock): races the awaitable against
+      ``clock.sleep(deadline_s)``.  On a :class:`VirtualClock` a stalled peer
+      "hangs" in virtual time and the failure fires in milliseconds of real
+      time — the bounded-detection test the acceptance bar demands.
+
+    ``dcn_grace_s`` widens the deadline for dispatches the fault plan has
+    deliberately degraded (``dcn_degrade``): injected latency must not be
+    misread as a dead peer.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        clock: Clock | None = None,
+        host: int | None = None,
+        registry: Any | None = None,
+    ) -> None:
+        if deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        self.deadline_s = float(deadline_s)
+        self.host = host
+        self._clock = clock or SYSTEM_CLOCK
+        self._m = resilience_metrics(registry)
+        self._log = Logger()
+
+    def _timeout(self, round_number: int | None, waited: float) -> HostFailure:
+        self._m["host_failures"].inc(kind="collective_timeout")
+        self._log.warning(
+            "collective watchdog tripped after %.2fs (deadline %.2fs, "
+            "round %s): a peer host is dead or stalled", waited,
+            self.deadline_s, round_number,
+        )
+        return HostFailure(
+            "collective_timeout", host=None, round_number=round_number,
+            detail=(
+                f"cross-host dispatch exceeded {self.deadline_s:.2f}s "
+                "deadline; a peer is dead or stalled"
+            ),
+        )
+
+    def run(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        round_number: int | None = None,
+        dcn_grace_s: float = 0.0,
+        tick: Callable[[], None] | None = None,
+        tick_interval_s: float = 0.5,
+        **kwargs: Any,
+    ) -> Any:
+        """Sync bracket: ``fn(*args, **kwargs)`` with a deadline.  Exceptions
+        from ``fn`` propagate unchanged; only the deadline becomes a
+        :class:`HostFailure`.
+
+        ``tick`` (if given) runs every ``tick_interval_s`` while waiting —
+        the dispatching host's heartbeat.  A host BLOCKED on a collective is
+        alive (it is waiting on its peers, and will fail loudly via this very
+        deadline); without the tick its frozen heartbeat would make the
+        monitor misread every waiting peer as the stalled one.
+
+        The dispatch runs on a DAEMON thread, not a ThreadPoolExecutor:
+        executor threads are non-daemon (and atexit-joined) on every current
+        Python, so a thread wedged in gloo would make the worker's own
+        ``sys.exit`` after the timeout hang exactly as hard as the collective
+        it was escaping."""
+        deadline = self.deadline_s + max(0.0, dcn_grace_s)
+        outcome: dict[str, Any] = {}
+        done = threading.Event()
+
+        def runner() -> None:
+            try:
+                outcome["value"] = fn(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 — re-raised verbatim
+                outcome["error"] = exc
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=runner, daemon=True, name="nanofed-watchdog"
+        ).start()
+        start = _time.monotonic()
+        while True:
+            # Completion always wins over an expired deadline: the dispatch
+            # may have finished during the last tick() (heartbeat file I/O),
+            # and raising then would discard a round that actually completed
+            # — triggering a full, needless kill-reap-reshape recovery.
+            if done.is_set():
+                if "error" in outcome:
+                    raise outcome["error"]
+                return outcome["value"]
+            remaining = deadline - (_time.monotonic() - start)
+            if remaining <= 0:
+                raise self._timeout(round_number, deadline)
+            if done.wait(
+                timeout=min(remaining, tick_interval_s)
+                if tick is not None else remaining
+            ):
+                if "error" in outcome:
+                    raise outcome["error"]
+                return outcome["value"]
+            if tick is not None:
+                tick()
+
+    async def guard(
+        self,
+        awaitable: Any,
+        round_number: int | None = None,
+        dcn_grace_s: float = 0.0,
+    ) -> Any:
+        """Async bracket on the injectable clock: the virtual-clock-testable
+        form of :meth:`run` (same deadline rule, same typed failure)."""
+        import asyncio
+
+        deadline = self.deadline_s + max(0.0, dcn_grace_s)
+        task = asyncio.ensure_future(awaitable)
+        timer = asyncio.ensure_future(self._clock.sleep(deadline))
+        done, _ = await asyncio.wait(
+            {task, timer}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if task in done:
+            timer.cancel()
+            return task.result()
+        task.cancel()
+        raise self._timeout(round_number, deadline)
+
+
+def no_orphans(pids: list[int]) -> list[int]:
+    """The subset of ``pids`` still alive — the hostchaos artifact's
+    zero-orphans check (a recovery that leaks a worker holding the rendezvous
+    port poisons every later run on the machine)."""
+    alive = []
+    for pid in pids:
+        try:
+            os.kill(pid, 0)  # signal 0: existence probe only
+        except ProcessLookupError:
+            continue
+        except PermissionError:
+            pass  # exists, just not ours — still an orphan
+        alive.append(pid)
+    return alive

@@ -3,6 +3,7 @@
 Replaces ``nanofed/server/model_manager/`` and ``nanofed/server/fault_tolerance.py``.
 """
 
+from nanofed_tpu.persistence.generation_store import GenerationRecord, GenerationStore
 from nanofed_tpu.persistence.model_manager import ModelManager, make_json_serializable
 from nanofed_tpu.persistence.serialization import (
     load_pytree_npz,
@@ -29,6 +30,8 @@ __all__ = [
     "RECOVERABLE_EXCEPTIONS",
     "CheckpointMetadata",
     "FileStateStore",
+    "GenerationRecord",
+    "GenerationStore",
     "ModelManager",
     "RestoredState",
     "SimpleRecoveryStrategy",

@@ -1,0 +1,209 @@
+"""Coordinated multi-host checkpointing: generations with commit markers.
+
+:class:`FileStateStore` checkpoints ONE process's round state — enough for the
+single-controller coordinators, wrong for a multi-host mesh, where recovery
+must answer a harder question: *which checkpoint did EVERY host finish
+writing?*  A host that crashes immediately after publishing its own state has
+peers mid-write; resuming from "my newest file" would mix rounds across hosts
+and silently fork the replicated model state.
+
+:class:`GenerationStore` generalizes the layout to the multi-host contract:
+
+* Each host writes its block-boundary checkpoint under a monotonically
+  increasing **generation** number (``generation = completed_rounds //
+  block_size``), then publishes a per-host **commit marker** — state first,
+  marker second, both via atomic tmp+replace with fsync durability
+  (:func:`~nanofed_tpu.persistence.serialization.save_state_pickle`), so a
+  marker's existence proves its state file is complete *and on disk*.
+* The marker records the **participant set** the generation was written under
+  (the hosts-axis rows of the mesh at that time): a generation is *complete*
+  only when every host in that recorded set has committed it.  Recovery
+  resumes from the newest complete generation — never from a torn one.
+* Params are replicated across hosts on the (h, c, 1) mesh, so restore may
+  read ANY committed host's state file; after an elastic reshape the shrunk
+  host set resumes from whichever survivor's file is present.
+
+**At-most-one-block loss guarantee**: checkpoints happen at block boundaries
+(every ``block_size`` rounds).  A failure at round *r* recovers to generation
+``g = r // block_size`` minus at most one: the newest complete generation is
+at worst the one before the block containing *r* (when the failure interrupts
+the commit of the boundary itself), so at most ``block_size`` rounds — one
+block — are re-run, and zero rounds of any complete generation are lost.
+Tested in ``tests/unit/persistence/test_generation_store.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from nanofed_tpu.core.exceptions import CheckpointError
+from nanofed_tpu.core.types import Params, PyTree
+from nanofed_tpu.persistence.serialization import (
+    load_state_pickle,
+    save_state_pickle,
+    write_text_durable,
+)
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = ["GenerationRecord", "GenerationStore"]
+
+
+class GenerationRecord:
+    """What :meth:`GenerationStore.latest_complete` hands back."""
+
+    def __init__(
+        self,
+        generation: int,
+        round_number: int,
+        hosts: tuple[int, ...],
+        params: Params,
+        server_state: PyTree,
+        meta: dict[str, Any],
+    ) -> None:
+        self.generation = generation
+        self.round_number = round_number
+        self.hosts = hosts
+        self.params = params
+        self.server_state = server_state
+        self.meta = meta
+
+
+class GenerationStore:
+    """Per-host, generation-numbered checkpoints with commit-by-all recovery.
+
+    Layout::
+
+        base_dir/generations/gen_<G>/
+          host_<H>.state.pkl       {params, server_state} (numpy-leaf pytrees)
+          host_<H>.commit.json     {host, generation, round, hosts: [...]}
+
+    One instance per host process (``host`` is the hosts-axis row).  The
+    supervisor — or a rejoining host — reads with ``host=None``.
+    """
+
+    def __init__(self, base_dir: str | Path, host: int | None = None) -> None:
+        self.base_dir = Path(base_dir) / "generations"
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+        self.host = host
+        self._log = Logger()
+
+    def _gen_dir(self, generation: int) -> Path:
+        return self.base_dir / f"gen_{generation}"
+
+    # -- writer side (one call per host per block boundary) ----------------
+
+    def commit(
+        self,
+        generation: int,
+        round_number: int,
+        params: Params,
+        server_state: PyTree,
+        hosts: list[int] | tuple[int, ...],
+        meta: dict[str, Any] | None = None,
+    ) -> None:
+        """Write THIS host's state for ``generation``, then its commit marker.
+
+        ``hosts`` is the participant set of the CURRENT mesh — the set whose
+        unanimous commit makes the generation a legal recovery point.  Marker
+        written strictly after state (both atomic + fsynced), so marker ⇒
+        durable state.
+        """
+        if self.host is None:
+            raise CheckpointError("a read-only GenerationStore cannot commit")
+        if generation < 0:
+            raise CheckpointError(f"generation must be >= 0, got {generation}")
+        d = self._gen_dir(generation)
+        d.mkdir(parents=True, exist_ok=True)
+        save_state_pickle(
+            d / f"host_{self.host}.state.pkl",
+            {"params": params, "server_state": server_state},
+        )
+        marker = {
+            "host": self.host,
+            "generation": generation,
+            "round": int(round_number),
+            "hosts": sorted(int(h) for h in hosts),
+            **(meta or {}),
+        }
+        # Durable publish (fsync file before rename, dir after), same contract
+        # as the state writer: a marker that can be lost to a host crash —
+        # or worse, survive one its state file didn't — breaks commit-by-all.
+        write_text_durable(
+            d / f"host_{self.host}.commit.json", json.dumps(marker, indent=2)
+        )
+
+    # -- reader side (supervisor / recovering worker) ----------------------
+
+    def _markers(self, generation: int) -> dict[int, dict[str, Any]]:
+        out: dict[int, dict[str, Any]] = {}
+        for path in self._gen_dir(generation).glob("host_*.commit.json"):
+            try:
+                marker = json.loads(path.read_text())
+                out[int(marker["host"])] = marker
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                continue  # torn marker: that host has not committed
+        return out
+
+    def generations(self) -> list[int]:
+        """All generation numbers with at least one commit marker, ascending."""
+        gens = []
+        for d in self.base_dir.glob("gen_*"):
+            try:
+                g = int(d.name.split("_", 1)[1])
+            except ValueError:
+                continue
+            if self._markers(g):
+                gens.append(g)
+        return sorted(gens)
+
+    def is_complete(self, generation: int) -> bool:
+        """True when every host in the generation's RECORDED participant set
+        has committed it.  Markers that disagree on the participant set mean a
+        torn reshape — not a legal recovery point."""
+        markers = self._markers(generation)
+        if not markers:
+            return False
+        participant_sets = {tuple(m.get("hosts", ())) for m in markers.values()}
+        if len(participant_sets) != 1:
+            return False
+        (participants,) = participant_sets
+        if not participants:
+            return False
+        return all(
+            h in markers
+            and (self._gen_dir(generation) / f"host_{h}.state.pkl").exists()
+            for h in participants
+        )
+
+    def latest_complete(self) -> GenerationRecord | None:
+        """Newest generation committed by ALL its participants, restored; None
+        when no complete generation exists (start fresh).  State is loaded
+        from this host's own file when present, else any committed
+        participant's (params/server_state are replicated across hosts)."""
+        for g in reversed(self.generations()):
+            if not self.is_complete(g):
+                continue
+            markers = self._markers(g)
+            hosts = tuple(sorted(markers))
+            prefer = (
+                self.host if self.host is not None and self.host in markers
+                else hosts[0]
+            )
+            state = load_state_pickle(
+                self._gen_dir(g) / f"host_{prefer}.state.pkl"
+            )
+            marker = markers[prefer]
+            return GenerationRecord(
+                generation=g,
+                round_number=int(marker["round"]),
+                hosts=hosts,
+                params=state["params"],
+                server_state=state["server_state"],
+                meta={
+                    k: v for k, v in marker.items()
+                    if k not in ("host", "generation", "round", "hosts")
+                },
+            )
+        return None

@@ -15,6 +15,7 @@ Loading supports two modes:
 
 from __future__ import annotations
 
+import os
 import pickle
 from pathlib import Path
 from typing import Any
@@ -69,6 +70,55 @@ def flatten_to_arrays(tree: PyTree) -> dict[str, np.ndarray]:
     return arrays
 
 
+def _fsync_dir(path: Path) -> None:
+    """fsync a directory so a just-published rename survives power loss.
+    Platforms whose directory fds reject fsync (some network filesystems,
+    Windows) degrade to the pre-fsync durability — never an error."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_file(f) -> None:
+    """Flush and fsync an OPEN file: the rename that publishes it must never
+    point at data still in the page cache."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _publish(tmp: Path, path: Path) -> None:
+    """Durable atomic publish of a CLOSED, already-fsynced tmp file: rename,
+    then fsync the parent directory (the rename itself is metadata the crash
+    can lose — without this, a host dying right after "checkpoint written"
+    can reboot to the OLD file, or to none).  Runs AFTER the ``with`` block
+    closes the handle — renaming an open file is a sharing violation on
+    Windows.  The multi-host commit protocol (``GenerationStore``) leans on
+    the fsync-file / rename / fsync-dir sequence: a commit marker proves its
+    state file is complete *and on disk*."""
+    tmp.replace(path)  # atomic publish: no torn checkpoint on crash
+    _fsync_dir(path.parent)
+
+
+def write_text_durable(path: str | Path, text: str) -> None:
+    """Durably publish a small text file (commit markers, manifests) through
+    the same fsync-before-rename / fsync-dir-after contract as the checkpoint
+    writers — a marker whose rename can be lost to a host crash would vouch
+    for state the recovery protocol then cannot find."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(text)
+        _fsync_file(f)
+    _publish(tmp, path)
+
+
 def save_pytree_npz(path: str | Path, tree: PyTree) -> None:
     """Save a pytree of arrays as a compressed ``.npz`` keyed by leaf path names."""
     arrays = flatten_to_arrays(tree)
@@ -77,7 +127,8 @@ def save_pytree_npz(path: str | Path, tree: PyTree) -> None:
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
-    tmp.replace(path)  # atomic publish: no torn checkpoint on crash
+        _fsync_file(f)
+    _publish(tmp, path)
 
 
 def load_pytree_npz(path: str | Path, like: PyTree | None = None) -> PyTree:
@@ -146,7 +197,8 @@ def save_state_pickle(path: str | Path, tree: PyTree) -> None:
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         pickle.dump(tree_to_numpy(tree), f, protocol=pickle.HIGHEST_PROTOCOL)
-    tmp.replace(path)
+        _fsync_file(f)
+    _publish(tmp, path)
 
 
 def load_state_pickle(path: str | Path) -> PyTree:

@@ -23,7 +23,11 @@ from typing import Any, Callable, NamedTuple
 
 from nanofed_tpu.core.exceptions import CheckpointError, NanoFedError
 from nanofed_tpu.core.types import Params, PyTree
-from nanofed_tpu.persistence.serialization import load_state_pickle, save_state_pickle
+from nanofed_tpu.persistence.serialization import (
+    load_state_pickle,
+    save_state_pickle,
+    write_text_durable,
+)
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
 
@@ -101,10 +105,11 @@ class FileStateStore:
             timestamp=get_current_time().isoformat(),
             metrics=metrics or {},
         )
-        # metadata.json written last: its presence marks the checkpoint as complete.
-        tmp = d / "metadata.json.tmp"
-        tmp.write_text(json.dumps(meta.to_dict(), indent=2))
-        tmp.replace(d / "metadata.json")
+        # metadata.json written last: its presence marks the checkpoint as
+        # complete — published durably (fsync'd) for the same reason as the
+        # GenerationStore commit markers: a marker must never outlive (or
+        # predate) the durability of the state it vouches for.
+        write_text_durable(d / "metadata.json", json.dumps(meta.to_dict(), indent=2))
         if self.keep_last is not None:
             self._prune()
         return meta

@@ -38,14 +38,16 @@ MODULES = [
                      "nanofed_tpu.aggregation.robust"]),
     ("parallel", ["nanofed_tpu.parallel.mesh", "nanofed_tpu.parallel.round_step",
                   "nanofed_tpu.parallel.multi_round",
-                  "nanofed_tpu.parallel.scaffold_step"]),
+                  "nanofed_tpu.parallel.scaffold_step",
+                  "nanofed_tpu.parallel.resilience"]),
     ("privacy", ["nanofed_tpu.privacy.config", "nanofed_tpu.privacy.noise",
                  "nanofed_tpu.privacy.accounting", "nanofed_tpu.privacy.mechanisms"]),
     ("security", ["nanofed_tpu.security.validation", "nanofed_tpu.security.signing",
                   "nanofed_tpu.security.secure_agg"]),
     ("persistence", ["nanofed_tpu.persistence.serialization",
                      "nanofed_tpu.persistence.model_manager",
-                     "nanofed_tpu.persistence.state_store"]),
+                     "nanofed_tpu.persistence.state_store",
+                     "nanofed_tpu.persistence.generation_store"]),
     ("orchestration", ["nanofed_tpu.orchestration.types",
                        "nanofed_tpu.orchestration.coordinator"]),
     ("communication", ["nanofed_tpu.communication.codec",
@@ -54,7 +56,8 @@ MODULES = [
                        "nanofed_tpu.communication.retry",
                        "nanofed_tpu.communication.network_coordinator"]),
     ("faults", ["nanofed_tpu.faults.plan",
-                "nanofed_tpu.faults.injector"]),
+                "nanofed_tpu.faults.injector",
+                "nanofed_tpu.faults.host_injector"]),
     ("ingest", ["nanofed_tpu.ingest.buffer",
                 "nanofed_tpu.ingest.pipeline"]),
     ("loadgen", ["nanofed_tpu.loadgen.swarm",

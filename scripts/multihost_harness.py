@@ -21,6 +21,23 @@ so the whole hosts-axis path is testable without a pod:
   gloo-over-loopback measure the PROGRAM (hierarchical collectives, chunked
   streaming, multi-controller dispatch) at population scale, not TPU silicon.
 
+* ``hostchaos`` (``make hostchaos-smoke``): the host fault-tolerance drill.
+  A SUPERVISOR spawns the worker mesh under a seeded fault plan
+  (``host_crash``/``host_stall``/``dcn_degrade`` — ``nanofed_tpu.faults``),
+  the workers heartbeat (``parallel.resilience.Heartbeat``), bracket every
+  cross-host dispatch with a ``CollectiveWatchdog`` deadline, and checkpoint
+  at block boundaries under generation numbers with commit markers
+  (``persistence.GenerationStore``).  When the plan kills or stalls a host,
+  the supervisor detects it (process exit / frozen heartbeat), kills and
+  REAPS every survivor, re-forms the mesh over the surviving host set (the
+  shrunk hosts axis, cohort quotas, and data sharding all re-derive through
+  ``MeshLayout``), resumes from the newest generation committed by ALL
+  participants (at most one block of rounds re-run), and optionally lets the
+  failed host REJOIN at the next generation boundary.  The run ends with a
+  ``runs/hostchaos_*.json`` artifact: MTTR, rounds lost, post-recovery loss
+  parity vs an unfailed run on the same shrunk mesh from the same recovery
+  point, and a zero-orphans check over every pid ever spawned.
+
 Launcher (default entry) spawns the worker processes of itself; workers rendez-
 vous through ``jax.distributed`` on a loopback coordinator.  Every knob rides
 argv so the launcher and workers cannot drift.
@@ -31,14 +48,26 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # the hostchaos supervisor imports nanofed_tpu
+    sys.path.insert(0, str(REPO))
 
 SMOKE_TOL = 5e-5  # hierarchical vs flat psum: re-association only (~1e-7 seen)
+
+#: Worker exit code when the collective watchdog (or a gloo/distributed error)
+#: surfaced a PEER's failure — distinct from the planned victim's own death
+#: (HOST_CRASH_RC, imported so the supervisor's rc match can never drift from
+#: what the injector actually exits with; host_injector is pure stdlib).
+PEER_FAILURE_RC = 32
+from nanofed_tpu.faults.host_injector import (  # noqa: E402
+    HOST_CRASH_EXIT_CODE as HOST_CRASH_RC,
+)
 
 
 def _worker_env(args: argparse.Namespace, process_id: int) -> dict[str, str]:
@@ -145,11 +174,23 @@ def run_worker(args: argparse.Namespace) -> int:
     )
     strategy = fedavg_strategy()
     params_host = model.init(jax.random.key(args.seed))
+    sos_host = init_server_state(strategy, params_host)
+    start_round = 0
+    if args.job == "hostchaos" and args.resume:
+        from nanofed_tpu.persistence import GenerationStore
+
+        rec = GenerationStore(args.ckpt_dir).latest_complete()
+        if rec is not None:
+            # Newest generation committed by ALL its participants: the only
+            # legal multi-host recovery point (at-most-one-block loss).
+            params_host, sos_host = rec.params, rec.server_state
+            start_round = rec.round_number
+            log(f"resumed generation {rec.generation} at round {start_round} "
+                f"(committed by hosts {list(rec.hosts)})")
+        else:
+            log("resume requested but no complete generation yet — fresh start")
     params = jax.device_put(params_host, param_sharding(mesh, params_host))
-    sos = jax.device_put(
-        init_server_state(strategy, params_host),
-        param_sharding(mesh, init_server_state(strategy, params_host)),
-    )
+    sos = jax.device_put(sos_host, param_sharding(mesh, sos_host))
     step = build_round_step(
         model.apply, training, mesh, strategy,
         client_chunk=args.client_chunk, params_like=params,
@@ -181,6 +222,12 @@ def run_worker(args: argparse.Namespace) -> int:
     def round_rngs(r):
         return stack_rngs(
             jax.random.fold_in(jax.random.key(args.seed), r), padded
+        )
+
+    if args.job == "hostchaos":
+        return _hostchaos_rounds(
+            args, info, log, mesh, step, params, sos, data, weights,
+            round_rngs, start_round,
         )
 
     losses: list[float] = []
@@ -221,6 +268,140 @@ def run_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def _hostchaos_rounds(
+    args: argparse.Namespace,
+    info: dict,
+    log,
+    mesh,
+    step,
+    params,
+    sos,
+    data,
+    weights,
+    round_rngs,
+    start_round: int,
+) -> int:
+    """The fault-tolerant worker round loop: chaos injection at the host
+    boundary, heartbeats, a watchdog deadline around every dispatch, and
+    generation checkpoints at block boundaries.  The jitted round program is
+    byte-identical to the smoke/bench jobs — chaos and resilience live
+    entirely on the host side of the dispatch."""
+    import jax
+    import numpy as np
+
+    from nanofed_tpu.faults import ChaosSchedule, FaultPlan, HostChaosInjector
+    from nanofed_tpu.parallel import (
+        CollectiveWatchdog,
+        Heartbeat,
+        HostFailure,
+        mesh_shape,
+    )
+    from nanofed_tpu.persistence import GenerationStore
+
+    host = args.host_id
+    hosts_list = [int(h) for h in args.hosts_list.split(",")]
+    injector = None
+    if args.fault_plan:
+        injector = HostChaosInjector(
+            ChaosSchedule(FaultPlan.load(args.fault_plan)), host=host
+        )
+    hb = Heartbeat(args.hb_dir, host)
+    store = GenerationStore(args.ckpt_dir, host=host)
+    watchdog = CollectiveWatchdog(args.watchdog_deadline)
+    progress = Path(args.progress) if args.progress else None
+    pid = info["process_index"]
+
+    def dispatch(params, sos, rngs):
+        res = step(params, sos, data, weights, rngs)
+        # Block INSIDE the watchdog bracket: the hang a dead peer causes
+        # lives in the collective the result depends on.
+        jax.block_until_ready((res.params, res.server_opt_state, res.metrics))
+        return res
+
+    def commit(rounds_done: int, params, sos) -> None:
+        gen = rounds_done // args.block_size
+        p_host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+        s_host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), sos)
+        store.commit(gen, rounds_done, p_host, s_host, hosts=hosts_list)
+        hb.beat(round_number=rounds_done, generation=gen, status="committed")
+        log(f"committed generation {gen} at round {rounds_done}")
+
+    losses: list[float] = []
+    executed: list[int] = []
+    first_dispatch = True
+    for r in range(start_round, args.rounds):
+        if injector is not None:
+            injector.maybe_fail(r)  # may os._exit (crash) or park (stall)
+            delay = injector.dcn_delay_s(r)
+            if delay:
+                log(f"chaos: dcn_degrade {delay:.3f}s before round {r}")
+                time.sleep(delay)
+        else:
+            delay = 0.0
+        hb.beat(round_number=r, generation=r // args.block_size,
+                status="dispatch")
+        rngs = round_rngs(r)
+        # The first dispatched round pays trace+compile; the deadline must
+        # not misread a slow compile (or a planned-degraded DCN link) as a
+        # dead peer.
+        grace = delay + (args.compile_grace if first_dispatch else 0.0)
+        try:
+            res = watchdog.run(
+                dispatch, params, sos, rngs,
+                round_number=r, dcn_grace_s=grace,
+                # Keep beating while blocked on the collective: a waiting
+                # peer is alive — only the genuinely stalled host freezes.
+                tick=lambda: hb.beat(
+                    round_number=r, generation=r // args.block_size,
+                    status="dispatch",
+                ),
+            )
+        except HostFailure as exc:
+            log(f"watchdog: {exc}")
+            hb.beat(round_number=r, status="peer_failure")
+            # os._exit, not sys.exit: the interpreter's atexit runs JAX's
+            # distributed teardown, which BARRIERS on the very peer that just
+            # failed — the clean exit would hang as hard as the collective.
+            os._exit(PEER_FAILURE_RC)
+        except Exception as exc:  # gloo/coordination error: a peer is gone
+            log(f"dispatch failed (peer loss?): {type(exc).__name__}: {exc}")
+            hb.beat(round_number=r, status="peer_failure")
+            os._exit(PEER_FAILURE_RC)
+        first_dispatch = False
+        params, sos = res.params, res.server_opt_state
+        loss = float(res.metrics["loss"])
+        losses.append(loss)
+        executed.append(r)
+        hb.beat(round_number=r + 1, generation=(r + 1) // args.block_size,
+                status="running")
+        if progress is not None and pid == 0:
+            with progress.open("a") as f:
+                f.write(json.dumps(
+                    {"round": r, "loss": loss, "wall_t": time.time()}
+                ) + "\n")
+        log(f"round {r}: loss={loss:.5f}")
+        if (r + 1) % args.block_size == 0:
+            commit(r + 1, params, sos)
+
+    hb.beat(round_number=args.rounds, status="done")
+    if pid == 0 and args.out is not None:
+        Path(args.out).write_text(json.dumps({
+            "mode": "hostchaos",
+            "start_round": start_round,
+            "rounds": executed,
+            "losses": losses,
+            "topology": {
+                "process_count": info["process_count"],
+                "hosts": args.hosts,
+                "host_ids": hosts_list,
+                "devices": len(jax.devices()),
+                "mesh_shape": list(mesh_shape(mesh)),
+            },
+        }, indent=2))
+        log(f"wrote {args.out}")
+    return 0
+
+
 def _spawn(args: argparse.Namespace, mode_args: list[str], out: str | None,
            hosts: int, num_processes: int, port: int) -> list[subprocess.Popen]:
     procs = []
@@ -239,11 +420,33 @@ def _spawn(args: argparse.Namespace, mode_args: list[str], out: str | None,
     return procs
 
 
+def _reap(procs: list[subprocess.Popen], grace_s: float = 5.0) -> None:
+    """Terminate AND reap every still-running worker.  Kill-without-wait (the
+    old failure path) leaves zombies holding the rendezvous port: the next
+    parity run on the machine then dies in jax.distributed bring-up.  SIGTERM
+    first (workers flush logs), SIGKILL after the grace, ``wait()`` always —
+    no child of the launcher may outlive this call."""
+    for q in procs:
+        if q.poll() is None:
+            q.terminate()
+    deadline = time.time() + grace_s
+    for q in procs:
+        if q.poll() is not None:
+            continue
+        try:
+            q.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            q.kill()
+            q.wait()
+
+
 def _wait(procs: list[subprocess.Popen], timeout_s: float) -> None:
     # Poll ALL workers, not procs[0] first: a fast crash in worker 1 while
     # worker 0 blocks in the jax.distributed rendezvous must surface as the
     # real non-zero exit code immediately, not as a full-timeout "timed out"
-    # after the peer-less rendezvous finally expires.
+    # after the peer-less rendezvous finally expires.  Any failure path reaps
+    # the survivors BEFORE raising: a failed parity run must not leave orphan
+    # processes holding the rendezvous port.
     deadline = time.time() + timeout_s
     pending = list(procs)
     while pending:
@@ -252,14 +455,12 @@ def _wait(procs: list[subprocess.Popen], timeout_s: float) -> None:
             if rc is None:
                 continue
             if rc != 0:
-                for q in procs:
-                    q.kill()
+                _reap(procs)
                 raise SystemExit(f"worker exited rc={rc}")
             pending.remove(p)
         if pending:
             if time.time() > deadline:
-                for q in procs:
-                    q.kill()
+                _reap(procs)
                 raise SystemExit(f"worker timed out after {timeout_s:.0f}s")
             time.sleep(0.2)
 
@@ -391,12 +592,485 @@ def run_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def _spawn_hostchaos(
+    args: argparse.Namespace,
+    host_ids: list[int],
+    port: int,
+    *,
+    rounds: int,
+    hb_dir: Path,
+    ckpt_dir: Path,
+    resume: bool,
+    plan_path: Path | None,
+    out: Path | None,
+    progress: Path | None,
+) -> list[subprocess.Popen]:
+    """Spawn one hostchaos worker per LOGICAL host id.  Process ids renumber
+    0..n-1 every phase (jax.distributed needs a dense range); logical host ids
+    survive reshapes — they are what the fault plan targets, what heartbeats
+    and commit markers are keyed by, and what lets a restarted host rejoin as
+    itself."""
+    procs = []
+    n = len(host_ids)
+    for pid, host in enumerate(host_ids):
+        cmd = [
+            sys.executable, str(Path(__file__).resolve()), "worker",
+            "--job", "hostchaos",
+            "--process-id", str(pid),
+            "--num-processes", str(n),
+            "--coordinator", f"localhost:{port}",
+            "--hosts", str(n),
+            "--clients", str(args.clients),
+            "--capacity", str(args.capacity),
+            "--batch-size", str(args.batch_size),
+            "--rounds", str(rounds),
+            "--model", args.model,
+            "--seed", str(args.seed),
+            "--devices-per-process", str(args.devices_per_process),
+            "--block-size", str(args.block_size),
+            "--watchdog-deadline", str(args.watchdog_deadline),
+            "--compile-grace", str(args.compile_grace),
+            "--host-id", str(host),
+            "--hosts-list", ",".join(str(h) for h in host_ids),
+            "--hb-dir", str(hb_dir),
+            "--ckpt-dir", str(ckpt_dir),
+        ]
+        if args.client_chunk is not None:
+            cmd += ["--client-chunk", str(args.client_chunk)]
+        if resume:
+            cmd += ["--resume"]
+        if plan_path is not None:
+            cmd += ["--fault-plan", str(plan_path)]
+        if out is not None and pid == 0:
+            cmd += ["--out", str(out)]
+        if progress is not None and pid == 0:
+            cmd += ["--progress", str(progress)]
+        procs.append(subprocess.Popen(cmd, env=_worker_env(args, pid)))
+    return procs
+
+
+def _read_progress(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # torn tail line from a killed writer
+    return out
+
+
+def _fresh_dir(path: Path) -> Path:
+    if path.exists():
+        shutil.rmtree(path)
+    path.mkdir(parents=True)
+    return path
+
+
+def run_hostchaos(args: argparse.Namespace) -> int:
+    """The kill-and-recover drill: seeded plan fails one of >=2 hosts
+    mid-round; the supervisor detects it, reaps the survivors, re-forms the
+    mesh over the surviving host set, resumes from the newest generation
+    committed by all participants, optionally rejoins the failed host, and
+    writes the ``runs/hostchaos_*.json`` evidence artifact (MTTR, rounds
+    lost <= one block, post-recovery parity vs an unfailed shrunk-mesh run,
+    zero orphans)."""
+    from nanofed_tpu.faults.plan import FaultPlan
+    from nanofed_tpu.observability.telemetry import RunTelemetry
+    from nanofed_tpu.parallel.resilience import (
+        HostMonitor,
+        no_orphans,
+        resilience_metrics,
+    )
+    from nanofed_tpu.persistence import GenerationStore
+
+    if args.num_processes < 2:
+        raise SystemExit("hostchaos needs --num-processes >= 2 (someone must "
+                         "survive to recover)")
+    P, R, B = args.num_processes, args.rounds, args.block_size
+    tmp = Path(args.tmp_dir)
+    tmp.mkdir(parents=True, exist_ok=True)
+    hb_a = _fresh_dir(tmp / "hb_a")
+    hb_c = _fresh_dir(tmp / "hb_c")
+    hb_d = _fresh_dir(tmp / "hb_d")
+    hb_e = _fresh_dir(tmp / "hb_e")
+    ckpt = _fresh_dir(tmp / "ckpt")
+    ref_ckpt = tmp / "ckpt_ref"
+    if ref_ckpt.exists():
+        shutil.rmtree(ref_ckpt)
+
+    if args.plan:
+        plan = FaultPlan.load(args.plan)
+    else:
+        plan = FaultPlan.generate(
+            args.seed, [], R, hosts=P,
+            host_crash_count=1 if args.host_fault == "crash" else 0,
+            host_stall_count=1 if args.host_fault == "stall" else 0,
+        )
+    host_events = [e for e in plan.events
+                   if e.kind in ("host_crash", "host_stall")]
+    if not host_events:
+        raise SystemExit("the hostchaos plan contains no host_crash/"
+                         "host_stall event — nothing to drill")
+    if len(host_events) > 1:
+        # Phase C re-feeds the plan to the recovered mesh (surviving hosts'
+        # remaining dcn events stay live), so a second terminal event would
+        # kill a survivor mid-recovery with nobody supervising.  One terminal
+        # fault per drill; run the harness again for the next one.
+        raise SystemExit(
+            f"the hostchaos drill handles ONE terminal host fault per run; "
+            f"this plan has {len(host_events)} "
+            f"({[e.to_dict() for e in host_events]}) — split it across runs"
+        )
+    max_dcn = max(
+        (e.seconds for e in plan.events if e.kind == "dcn_degrade"),
+        default=0.0,
+    )
+    if max_dcn >= args.watchdog_deadline:
+        # The degraded host widens its OWN deadline by the injected delay,
+        # but its peers cannot know the plan: their collectives absorb the
+        # delay under the base deadline.  The documented contract is that a
+        # degraded-but-alive link must NOT be misread as a dead peer — which
+        # requires sizing the deadline above the worst planned delay.
+        raise SystemExit(
+            f"plan injects dcn_degrade of {max_dcn}s but "
+            f"--watchdog-deadline is {args.watchdog_deadline}s: peers would "
+            "misread the degraded link as a dead host — raise the deadline "
+            "above the worst planned delay"
+        )
+    plan_path = tmp / "hostchaos_plan.json"
+    plan.save(plan_path)
+
+    metrics = resilience_metrics()
+    if args.telemetry_dir is None:
+        # Ours to wipe.  An OPERATOR-supplied dir is never rmtree'd — they may
+        # point it at runs/ next to prior artifacts; records just append.
+        telemetry_dir = _fresh_dir(tmp / "telemetry")
+    else:
+        telemetry_dir = Path(args.telemetry_dir)
+        telemetry_dir.mkdir(parents=True, exist_ok=True)
+    tel = RunTelemetry(telemetry_dir)
+    all_pids: list[int] = []
+    t0 = time.time()
+    hosts = list(range(P))
+
+    # ---- phase A: full mesh under the plan, run until the failure ----------
+    print(f"# hostchaos: {P}-host mesh, plan: "
+          + ", ".join(f"{e.kind}@r{e.round} host {e.host}"
+                      for e in host_events), flush=True)
+    progress_a = tmp / "progress_a.jsonl"
+    progress_a.unlink(missing_ok=True)
+    procs = _spawn_hostchaos(
+        args, hosts, args.port, rounds=R, hb_dir=hb_a, ckpt_dir=ckpt,
+        resume=False, plan_path=plan_path, out=tmp / "hc_a.json",
+        progress=progress_a,
+    )
+    all_pids += [p.pid for p in procs]
+    monitor = HostMonitor(hb_a, stall_timeout_s=args.stall_timeout)
+
+    def _hb_status(host: int) -> str:
+        try:
+            return str(json.loads(
+                (hb_a / f"host_{host}.hb.json").read_text()
+            ).get("status", "?"))
+        except (OSError, json.JSONDecodeError, ValueError):
+            return "?"
+
+    victim: int | None = None
+    kind: str | None = None
+    deadline = time.time() + args.timeout
+    exits: dict[int, int] = {}
+    exit_order: list[int] = []  # indices in the order their exits were seen
+    while victim is None:
+        for i, p in enumerate(procs):
+            rc = p.poll()
+            if rc is not None and i not in exits:
+                exits[i] = rc
+                exit_order.append(i)
+                if rc == HOST_CRASH_RC:
+                    victim, kind = hosts[i], "host_crash"
+                    metrics["host_failures"].inc(kind=kind)
+        if victim is None:
+            stalled = monitor.stalled()
+            if stalled:
+                victim, kind = stalled[0].host, "host_stall"
+        if victim is None and any(
+            rc == PEER_FAILURE_RC for rc in exits.values()
+        ):
+            # At least one worker exited BLAMING a peer (watchdog / gloo
+            # error).  A blaming worker is never the victim; neither is one
+            # whose last heartbeat declared peer_failure (it may have been
+            # killed mid-exit).  Once exactly one blameless worker remains —
+            # still alive (a true stall) or collaterally killed when the
+            # coordination service's leader went down — it is the victim.
+            blaming = {
+                i for i in range(len(procs))
+                if exits.get(i) == PEER_FAILURE_RC
+                or _hb_status(hosts[i]) == "peer_failure"
+            }
+            candidates = [i for i in range(len(procs)) if i not in blaming]
+            all_blamers_exited = all(
+                i in exits for i in range(len(procs)) if i in blaming
+            )
+            if len(candidates) == 1 and all_blamers_exited:
+                i = candidates[0]
+                victim = hosts[i]
+                # Died BEFORE the first blame → it crashed on its own; died
+                # after (or still silently alive) → the stall the blamers
+                # timed out on.
+                first_blame_pos = min(
+                    exit_order.index(j) for j in blaming if j in exits
+                ) if any(j in exits for j in blaming) else len(exit_order)
+                died_first = (
+                    i in exits and exit_order.index(i) < first_blame_pos
+                )
+                kind = "host_crash" if died_first else "host_stall"
+                metrics["host_failures"].inc(kind=kind)
+        if victim is None and len(exits) == len(procs):
+            if all(rc == 0 for rc in exits.values()):
+                _reap(procs)
+                raise SystemExit(
+                    "hostchaos: every worker completed without the planned "
+                    "failure firing — raise --rounds or fix the plan"
+                )
+            # Every process exited.  Attribute only to a worker that failed
+            # on its OWN account (non-zero, non-blaming): if every exit
+            # blames a peer, the failure is systemic (e.g. a round-0 gloo
+            # bring-up error hit everyone) and naming a victim would fabricate
+            # a host_crash, exclude a healthy host, and mask the real cause.
+            organic = [
+                i for i in exit_order
+                if exits[i] not in (0, PEER_FAILURE_RC)
+            ]
+            if not organic:
+                _reap(procs)
+                raise SystemExit(
+                    f"hostchaos: every worker exited blaming a peer "
+                    f"(exit codes {dict(sorted(exits.items()))}) — systemic "
+                    "failure, no victim attributable; check the worker logs"
+                )
+            victim = hosts[organic[0]]
+            kind = "host_crash"
+            metrics["host_failures"].inc(kind=kind)
+        if victim is None and time.time() > deadline:
+            _reap(procs)
+            raise SystemExit(f"hostchaos: no failure detected within "
+                             f"{args.timeout:.0f}s")
+        if victim is None:
+            time.sleep(0.2)
+    t_detect = time.time()
+    victim_hb = hb_a / f"host_{victim}.hb.json"
+    last_beat_wall = None
+    victim_round = None
+    try:
+        payload = json.loads(victim_hb.read_text())
+        last_beat_wall = float(payload.get("wall_t", 0)) or None
+        victim_round = payload.get("round")
+    except (OSError, json.JSONDecodeError, ValueError):
+        pass
+    detection_s = (
+        round(t_detect - last_beat_wall, 3) if last_beat_wall else None
+    )
+    # Kill and REAP everyone — survivors included: the old mesh is dead, and
+    # an orphan blocked in gloo would hold the rendezvous port forever.
+    # (Every detection path above already counted the failure by kind.)
+    _reap(procs)
+    plan_round = next(
+        (e.round for e in host_events if e.host == victim), victim_round
+    )
+    fail_round = plan_round if plan_round is not None else 0
+    print(f"# failure detected: {kind} on host {victim} (round {fail_round}, "
+          f"detection {detection_s}s) — reaped {len(procs)} workers",
+          flush=True)
+    tel.record(
+        "host_failure", kind=kind, host=victim, round=fail_round,
+        detection_s=detection_s,
+        detail=f"exit codes {exits}" if exits else "heartbeat frozen",
+    )
+
+    # Reference snapshot BEFORE the recovered run extends the store: the
+    # unfailed shrunk-mesh run must start from the identical recovery point.
+    shutil.copytree(ckpt, ref_ckpt)
+    rec = GenerationStore(ckpt).latest_complete()
+    resumed_round = rec.round_number if rec is not None else 0
+    resumed_gen = rec.generation if rec is not None else None
+    rounds_lost = fail_round - resumed_round
+    print(f"# recovery point: generation {resumed_gen} (round "
+          f"{resumed_round}); rounds lost = {rounds_lost} (block size {B})",
+          flush=True)
+
+    # ---- phase C: re-form over the survivors, resume, finish the run -------
+    survivors = [h for h in hosts if h != victim]
+    metrics["mesh_reshapes"].inc()
+    progress_c = tmp / "progress_c.jsonl"
+    progress_c.unlink(missing_ok=True)
+    procs = _spawn_hostchaos(
+        args, survivors, args.port + 7, rounds=R, hb_dir=hb_c, ckpt_dir=ckpt,
+        resume=True, plan_path=plan_path, out=tmp / "hc_c.json",
+        progress=progress_c,
+    )
+    all_pids += [p.pid for p in procs]
+    _wait(procs, args.timeout)
+    recovered = json.loads((tmp / "hc_c.json").read_text())
+    prog_c = _read_progress(progress_c)
+    if not prog_c:
+        raise SystemExit("hostchaos: recovered run reported no rounds")
+    mttr_s = round(prog_c[0]["wall_t"] - t_detect, 3)
+    metrics["recovery_seconds"].observe(mttr_s)
+    print(f"# mesh re-formed over hosts {survivors}: first post-recovery "
+          f"round done {mttr_s}s after detection (MTTR)", flush=True)
+    tel.record(
+        "recovery", recovery_s=mttr_s, resumed_generation=resumed_gen,
+        resumed_round=resumed_round, rounds_lost=rounds_lost,
+        hosts_before=P, hosts_after=len(survivors), reshape=True,
+        rejoin=False,
+    )
+
+    # ---- phase D (optional): the failed host rejoins at a generation
+    # boundary, mesh re-grows to the full host set --------------------------
+    rejoin_block = None
+    if args.rejoin_rounds > 0:
+        metrics["mesh_reshapes"].inc()
+        total = R + args.rejoin_rounds
+        procs = _spawn_hostchaos(
+            args, hosts, args.port + 13, rounds=total, hb_dir=hb_d,
+            ckpt_dir=ckpt, resume=True, plan_path=None,
+            out=tmp / "hc_d.json", progress=tmp / "progress_d.jsonl",
+        )
+        all_pids += [p.pid for p in procs]
+        _wait(procs, args.timeout)
+        rejoined = json.loads((tmp / "hc_d.json").read_text())
+        rejoin_block = {
+            "hosts": hosts,
+            "resumed_round": rejoined["start_round"],
+            "rounds": rejoined["rounds"],
+            "losses": rejoined["losses"],
+        }
+        assert rejoined["rounds"] and rejoined["rounds"][-1] == total - 1, (
+            f"rejoined mesh did not reach round {total - 1}: {rejoined}"
+        )
+        print(f"# host {victim} rejoined at round {rejoined['start_round']}: "
+              f"full {P}-host mesh ran to round {total - 1}", flush=True)
+        tel.record(
+            "recovery", resumed_generation=rejoined["start_round"] // B,
+            resumed_round=rejoined["start_round"], rounds_lost=0,
+            hosts_before=len(survivors), hosts_after=P, reshape=True,
+            rejoin=True,
+        )
+
+    # ---- phase E: the parity reference — an UNFAILED run on the same
+    # shrunk mesh from the same recovery point ------------------------------
+    procs = _spawn_hostchaos(
+        args, survivors, args.port + 19, rounds=R, hb_dir=hb_e,
+        ckpt_dir=ref_ckpt, resume=True, plan_path=None,
+        out=tmp / "hc_e.json", progress=None,
+    )
+    all_pids += [p.pid for p in procs]
+    _wait(procs, args.timeout)
+    reference = json.loads((tmp / "hc_e.json").read_text())
+
+    loss_delta = max(
+        (abs(a - b) for a, b in
+         zip(recovered["losses"], reference["losses"])),
+        default=float("inf"),
+    )
+    orphans = no_orphans(all_pids)
+    artifact = {
+        "record_type": "hostchaos",
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "seed": args.seed,
+        "plan": json.loads(plan.to_json()),
+        "rounds": R,
+        "block_size": B,
+        "clients": args.clients,
+        "model": args.model,
+        "topology": {
+            "hosts_before": P,
+            "hosts_after": len(survivors),
+            "devices_per_process": args.devices_per_process,
+            "mesh_before": [P, args.devices_per_process, 1],
+            "mesh_after": [len(survivors), args.devices_per_process, 1],
+        },
+        "failure": {
+            "kind": kind,
+            "host": victim,
+            "round": fail_round,
+            "detection_s": detection_s,
+            "stall_timeout_s": args.stall_timeout,
+            "watchdog_deadline_s": args.watchdog_deadline,
+            "worker_exit_codes": {str(hosts[i]): rc
+                                  for i, rc in sorted(exits.items())},
+        },
+        "recovery": {
+            "mttr_s": mttr_s,
+            "resumed_generation": resumed_gen,
+            "resumed_round": resumed_round,
+            "rounds_lost": rounds_lost,
+            "at_most_one_block": rounds_lost <= B,
+        },
+        "pre_failure_losses": [p["loss"] for p in _read_progress(progress_a)],
+        "recovered": {
+            "rounds": recovered["rounds"], "losses": recovered["losses"],
+        },
+        "reference_unfailed_shrunk": {
+            "rounds": reference["rounds"], "losses": reference["losses"],
+        },
+        "parity": {
+            "max_loss_delta": loss_delta,
+            "tolerance": args.parity_tol,
+            "ok": loss_delta <= args.parity_tol,
+        },
+        "rejoin": rejoin_block,
+        "orphans": orphans,
+        "platform": "cpu",
+        "basis": (
+            "multi-process jax.distributed over loopback (gloo CPU "
+            "collectives), virtual XLA host devices per process; the drill "
+            "measures the RECOVERY MACHINERY — detection, reap, mesh "
+            "re-formation, generation resume — not TPU silicon.  MTTR "
+            "includes process respawn + jax bring-up + recompile on the "
+            "shrunk mesh."
+        ),
+        "harness": "scripts/multihost_harness.py hostchaos",
+        "walltime_s": round(time.time() - t0, 1),
+    }
+    tel.close()
+
+    assert rounds_lost <= B, (
+        f"at-most-one-block violated: lost {rounds_lost} rounds > block {B}"
+    )
+    assert loss_delta <= args.parity_tol, (
+        f"post-recovery trajectory diverged from the unfailed shrunk-mesh "
+        f"run: max loss delta {loss_delta} > {args.parity_tol}"
+    )
+    assert not orphans, f"orphan worker processes survived the run: {orphans}"
+    assert recovered["rounds"][-1] == R - 1, recovered
+
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    path = out_dir / f"hostchaos_{stamp}_{P}h.json"
+    path.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact, indent=2))
+    print(f"# artifact written to {path}")
+    print(f"# telemetry: {telemetry_dir} (digest: python -m nanofed_tpu.cli "
+          f"metrics-summary {telemetry_dir})")
+    print(f"hostchaos OK: {kind} on host {victim} at round {fail_round} -> "
+          f"recovered on {len(survivors)} host(s) in {mttr_s}s, "
+          f"{rounds_lost} round(s) re-run (<= {B}), parity delta "
+          f"{loss_delta:.2e}, zero orphans")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "mode", choices=["smoke", "bench", "worker"],
+        "mode", choices=["smoke", "bench", "hostchaos", "worker"],
         help="smoke: 2-process parity vs 1-D reference; bench: 100k-client "
-        "throughput artifact; worker: internal (one jax.distributed process)",
+        "throughput artifact; hostchaos: seeded kill-and-recover drill with "
+        "elastic mesh re-formation; worker: internal (one jax.distributed "
+        "process)",
     )
     parser.add_argument("--clients", type=int, default=None)
     parser.add_argument("--capacity", type=int, default=8,
@@ -416,21 +1090,69 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=12421)
     parser.add_argument("--timeout", type=float, default=600.0,
                         help="per-phase worker timeout (tier-1-safe)")
-    parser.add_argument("--job", choices=["smoke", "bench"], default="smoke",
+    parser.add_argument("--job", choices=["smoke", "bench", "hostchaos"],
+                        default="smoke",
                         help="(worker) which launcher job this worker serves "
                         "— a FULL flag name: an abbreviated --mod* would "
                         "prefix-match argparse's --model and corrupt it")
     parser.add_argument("--out", default=None, help="(worker) result JSON path")
     parser.add_argument("--out-dir", default="runs")
     parser.add_argument("--tmp-dir", default="/tmp/nanofed_multihost")
+    # hostchaos: supervisor knobs (fault selection, detection windows, parity)
+    parser.add_argument("--plan", default=None,
+                        help="(hostchaos) fault-plan JSON; default: generate "
+                        "one host fault from --seed")
+    parser.add_argument("--host-fault", choices=["crash", "stall"],
+                        default="crash",
+                        help="(hostchaos) which host fault the generated plan "
+                        "draws")
+    parser.add_argument("--block-size", type=int, default=2,
+                        help="rounds per checkpoint generation (the at-most-"
+                        "one-block loss unit)")
+    parser.add_argument("--stall-timeout", type=float, default=15.0,
+                        help="(hostchaos) heartbeat age that flags a host as "
+                        "stalled")
+    parser.add_argument("--watchdog-deadline", type=float, default=20.0,
+                        help="cross-host dispatch deadline (the bounded "
+                        "detection window for a dead/stalled peer)")
+    parser.add_argument("--compile-grace", type=float, default=90.0,
+                        help="extra watchdog allowance for the first dispatch "
+                        "(trace+compile must not read as a dead peer)")
+    parser.add_argument("--parity-tol", type=float, default=SMOKE_TOL,
+                        help="(hostchaos) max post-recovery loss delta vs the "
+                        "unfailed shrunk-mesh reference")
+    parser.add_argument("--rejoin-rounds", type=int, default=2,
+                        help="(hostchaos) extra rounds after the failed host "
+                        "rejoins the mesh (0 disables the rejoin phase)")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="(hostchaos) where the supervisor writes "
+                        "telemetry.jsonl (default: <tmp-dir>/telemetry)")
+    # hostchaos: worker-side identity + wiring (set by the supervisor)
+    parser.add_argument("--fault-plan", default=None,
+                        help="(worker) fault-plan JSON path")
+    parser.add_argument("--host-id", type=int, default=0,
+                        help="(worker) LOGICAL host id — stable across "
+                        "reshapes, unlike the dense process id")
+    parser.add_argument("--hosts-list", default="0",
+                        help="(worker) comma-separated logical host ids of "
+                        "the current mesh (the commit-marker participant set)")
+    parser.add_argument("--hb-dir", default="/tmp/nanofed_multihost/hb")
+    parser.add_argument("--ckpt-dir", default="/tmp/nanofed_multihost/ckpt")
+    parser.add_argument("--progress", default=None,
+                        help="(worker) per-round progress JSONL path")
+    parser.add_argument("--resume", action="store_true",
+                        help="(worker) resume from the newest complete "
+                        "generation in --ckpt-dir")
     args = parser.parse_args(argv)
 
     if args.clients is None:
-        args.clients = 16 if args.mode == "smoke" else 100_000
+        args.clients = 100_000 if args.mode == "bench" else 16
     if args.mode == "worker":
         return run_worker(args)
     if args.mode == "smoke":
         return run_smoke(args)
+    if args.mode == "hostchaos":
+        return run_hostchaos(args)
     return run_bench(args)
 
 

@@ -6,6 +6,8 @@ the second invocation with ZERO compiles."""
 
 import json
 
+import pytest
+
 from nanofed_tpu.cli import main
 from nanofed_tpu.models import get_model
 from nanofed_tpu.trainer import TrainingConfig
@@ -35,6 +37,12 @@ def _sweep(tmp_path, **kwargs):
     )
 
 
+# Tier-1 budget relief (PR 13): the four compile-heavy sweeps below are
+# `slow` — they cost ~75s of the 870s tier-1 budget and are exercised
+# end-to-end by the dedicated autotune-smoke CI job (`make autotune-smoke`
+# runs this whole file with no marker filter).  The cheap assertions
+# (epilogue bytes drop, pinned-knob refusal) stay in tier-1.
+@pytest.mark.slow
 def test_autotune_smoke_winner_artifact_and_cache(tmp_path):
     first = _sweep(tmp_path)
 
@@ -87,6 +95,7 @@ def test_fused_epilogue_bytes_drop_in_catalog_cost_table(tmp_path):
     assert "interpreter" in record["basis"]
 
 
+@pytest.mark.slow
 def test_profile_sweep_cli_prints_table_and_epilogues(tmp_path, capsys, monkeypatch):
     monkeypatch.chdir(tmp_path)  # .jax_cache + runs/ land in the tmp dir
     rc = main([
@@ -102,6 +111,7 @@ def test_profile_sweep_cli_prints_table_and_epilogues(tmp_path, capsys, monkeypa
     assert (tmp_path / "runs").glob("autotune_*.json").__next__().exists()
 
 
+@pytest.mark.slow
 def test_run_autotune_records_tuned_config(tmp_path, capsys, monkeypatch):
     """`run --autotune` end to end: the tuner picks the config (zero round
     executions before the first real round — the sweep lowers candidates with
@@ -135,6 +145,7 @@ def test_run_autotune_refuses_pinned_knobs(capsys):
     assert "--autotune cannot be combined" in capsys.readouterr().err
 
 
+@pytest.mark.slow
 def test_metrics_summary_digests_autotune_records(tmp_path, capsys):
     telemetry_dir = tmp_path / "tel"
     from nanofed_tpu.observability import RunTelemetry

@@ -294,3 +294,30 @@ def test_serve_flag_combinations_fail_fast(capsys):
                "--min-clients", "5", "--max-clients", "3"])
     assert rc == 2
     assert "must be >=" in capsys.readouterr().err
+
+
+def test_chaos_plan_generates_host_and_client_faults(tmp_path, capsys):
+    # stdout form: a valid, seeded plan with the requested host fault.
+    rc = main(["chaos-plan", "--seed", "9", "--hosts", "3",
+               "--host-crashes", "1", "--rounds", "6"])
+    assert rc == 0
+    plan = json.loads(capsys.readouterr().out)
+    assert plan["seed"] == 9
+    assert [e["kind"] for e in plan["events"]] == ["host_crash"]
+    assert "host" in plan["events"][0]
+
+    # file form round-trips through the loader serve/hostchaos use.
+    from nanofed_tpu.faults import FaultPlan
+
+    out = tmp_path / "plan.json"
+    rc = main(["chaos-plan", "--seed", "1", "--clients", "8",
+               "--crash-fraction", "0.25", "--hosts", "2",
+               "--host-stalls", "1", "--out", str(out)])
+    assert rc == 0
+    loaded = FaultPlan.load(out)
+    kinds = sorted(e.kind for e in loaded.events)
+    assert kinds == ["crash", "crash", "host_stall"]
+
+    # misconfiguration and empty plans are refusals, not silent successes.
+    assert main(["chaos-plan", "--host-crashes", "1"]) == 2
+    assert main(["chaos-plan", "--clients", "8"]) == 2

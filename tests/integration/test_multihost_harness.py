@@ -1,0 +1,94 @@
+"""Launcher-side units of scripts/multihost_harness.py — no JAX workers, just
+real subprocesses: the orphan-reaping contract of ``_wait``/``_reap`` (a
+failed parity run must never leave a worker holding the rendezvous port) and
+the supervisor's progress/plan plumbing."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from nanofed_tpu.parallel.resilience import no_orphans
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+
+@pytest.fixture(scope="module")
+def harness():
+    spec = importlib.util.spec_from_file_location(
+        "multihost_harness", REPO / "scripts" / "multihost_harness.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _sleeper(seconds=60):
+    return subprocess.Popen([sys.executable, "-c",
+                             f"import time; time.sleep({seconds})"])
+
+
+def _crasher(rc=3, after_s=0.0):
+    return subprocess.Popen([sys.executable, "-c",
+                             f"import sys, time; time.sleep({after_s}); "
+                             f"sys.exit({rc})"])
+
+
+def test_wait_reaps_survivors_when_a_worker_crashes(harness):
+    # One worker crashes fast while its peer would happily block for a
+    # minute (the jax.distributed-rendezvous shape of the bug): _wait must
+    # surface the crash rc AND terminate+reap the survivor before raising.
+    survivor = _sleeper()
+    crasher = _crasher(rc=3, after_s=0.2)
+    procs = [survivor, crasher]
+    with pytest.raises(SystemExit, match="rc=3"):
+        harness._wait(procs, timeout_s=30.0)
+    # Reaped, not just signalled: returncode is set (wait() happened), and
+    # the pid no longer exists in the process table.
+    assert all(p.returncode is not None for p in procs)
+    assert no_orphans([p.pid for p in procs]) == []
+
+
+def test_wait_reaps_everyone_on_timeout(harness):
+    procs = [_sleeper(), _sleeper()]
+    t0 = time.monotonic()
+    with pytest.raises(SystemExit, match="timed out"):
+        harness._wait(procs, timeout_s=0.5)
+    assert time.monotonic() - t0 < 10
+    assert all(p.returncode is not None for p in procs)
+    assert no_orphans([p.pid for p in procs]) == []
+
+
+def test_wait_returns_when_all_exit_cleanly(harness):
+    procs = [_crasher(rc=0), _crasher(rc=0)]
+    harness._wait(procs, timeout_s=30.0)
+    assert [p.returncode for p in procs] == [0, 0]
+
+
+def test_reap_escalates_sigterm_to_sigkill(harness):
+    # A worker that ignores SIGTERM (a hung gloo collective does) must still
+    # die within the grace window.
+    stubborn = subprocess.Popen([sys.executable, "-c",
+                                 "import signal, time; "
+                                 "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                                 "time.sleep(60)"])
+    time.sleep(0.3)  # let the handler install
+    harness._reap([stubborn], grace_s=0.5)
+    assert stubborn.returncode is not None
+    assert no_orphans([stubborn.pid]) == []
+
+
+def test_read_progress_skips_torn_tail(harness, tmp_path):
+    p = tmp_path / "progress.jsonl"
+    p.write_text(
+        json.dumps({"round": 0, "loss": 2.0, "wall_t": 1.0}) + "\n"
+        + json.dumps({"round": 1, "loss": 1.9, "wall_t": 2.0}) + "\n"
+        + '{"round": 2, "los'  # killed mid-write
+    )
+    rows = harness._read_progress(p)
+    assert [r["round"] for r in rows] == [0, 1]
+    assert harness._read_progress(tmp_path / "missing.jsonl") == []

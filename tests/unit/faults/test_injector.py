@@ -1,0 +1,183 @@
+"""Direct unit coverage for the fault injectors (nanofed_tpu.faults.injector
+and .host_injector): the one-shot consumption edges a chaos run's correctness
+rests on — count exhaustion across retries, multiple kinds firing in the same
+round against one client, per-kind metric labels — plus the ChaosClient
+boundary actions against a stub client (no aiohttp, no server)."""
+
+import asyncio
+
+import pytest
+
+from nanofed_tpu.faults import (
+    FAULT_KINDS,
+    ChaosSchedule,
+    FaultEvent,
+    FaultPlan,
+    HostChaosInjector,
+)
+from nanofed_tpu.faults.injector import ChaosClient, _flip_bits
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.utils.clock import VirtualClock
+
+
+class StubClient:
+    """The HTTPClient surface ChaosClient drives, minus the network: records
+    every boundary action so the test can assert what a real client would
+    have put on the wire."""
+
+    def __init__(self, client_id="c0"):
+        self.client_id = client_id
+        self.wire_filter = None
+        self.current_round = None
+        self.submits = []
+        self.resends = 0
+
+    async def submit_update(self, params, metrics):
+        # Capture what the wire filter would do to this submit's body.
+        body = b"x" * 200
+        if self.wire_filter is not None:
+            body = self.wire_filter("/update", body)
+        self.submits.append((params, self.current_round, body))
+        return True
+
+    async def resend_last_update(self):
+        self.resends += 1
+        return True
+
+
+def _schedule(*events, registry=None):
+    return ChaosSchedule(
+        FaultPlan(events=tuple(events)), registry=registry or MetricsRegistry()
+    )
+
+
+def test_wire_fault_count_exhaustion_across_retries():
+    # A drop with count=3 severs exactly three attempts of the SAME logical
+    # submit; the fourth retry passes — the semantics RetryPolicy is proven
+    # against.  ack_drop events for other clients are untouched.
+    schedule = _schedule(
+        FaultEvent(kind="drop", round=2, client="c0", count=3),
+        FaultEvent(kind="ack_drop", round=2, client="c1"),
+    )
+    for _ in range(3):
+        assert schedule.wire_fault("c0", "2").kind == "drop"
+    assert schedule.wire_fault("c0", "2") is None  # retry #4 gets through
+    assert schedule.wire_fault("c1", "2").kind == "ack_drop"
+    assert schedule.wire_fault("c1", "2") is None
+    assert schedule.counts() == {"drop": 3, "ack_drop": 1}
+
+
+def test_wire_fault_ignores_malformed_round_header():
+    schedule = _schedule(FaultEvent(kind="drop", round=1, client="c0"))
+    # A garbage round header cannot be matched per-round; the event still
+    # applies (rnd None matches any round of that client).
+    assert schedule.wire_fault("c0", "not-a-round").kind == "drop"
+    assert schedule.wire_fault("c0", "1") is None
+
+
+def test_multiple_kinds_firing_in_the_same_round():
+    # One client, one round, four client-boundary kinds at once: every event
+    # fires exactly once, and the wire kinds stay independent of them.
+    schedule = _schedule(
+        FaultEvent(kind="delay", round=1, client="c0", seconds=0.25),
+        FaultEvent(kind="skew", round=1, client="c0", seconds=1),
+        FaultEvent(kind="corrupt", round=1, client="c0"),
+        FaultEvent(kind="duplicate", round=1, client="c0", count=2),
+        FaultEvent(kind="drop", round=1, client="c0"),
+    )
+    events = schedule.client_events("c0", 1)
+    assert sorted(e.kind for e in events) == [
+        "corrupt", "delay", "duplicate", "skew"
+    ]
+    assert schedule.client_events("c0", 1) == []  # all consumed
+    assert schedule.wire_fault("c0", "1").kind == "drop"  # untouched by above
+    assert schedule.counts() == {
+        "delay": 1, "skew": 1, "corrupt": 1, "duplicate": 1, "drop": 1,
+    }
+
+
+def test_metric_labels_for_every_kind():
+    # One event of EVERY kind, all consumed: the metrics registry must carry
+    # one labeled sample per kind — the accounting a chaos run's telemetry
+    # snapshot shows.
+    reg = MetricsRegistry()
+    schedule = _schedule(
+        FaultEvent(kind="crash", round=0, client="c0"),
+        FaultEvent(kind="delay", round=0, client="c1", seconds=0.1),
+        FaultEvent(kind="skew", round=0, client="c2", seconds=1),
+        FaultEvent(kind="corrupt", round=0, client="c3"),
+        FaultEvent(kind="duplicate", round=0, client="c4"),
+        FaultEvent(kind="drop", round=0, client="c5"),
+        FaultEvent(kind="ack_drop", round=0, client="c6"),
+        FaultEvent(kind="server_kill", round=0),
+        FaultEvent(kind="host_crash", round=0, host=0),
+        FaultEvent(kind="host_stall", round=0, host=1),
+        FaultEvent(kind="dcn_degrade", round=0, host=2, seconds=0.1),
+        registry=reg,
+    )
+    assert schedule.crashed("c0", 0)
+    for cid in ("c1", "c2", "c3", "c4"):
+        assert schedule.client_events(cid, 0)
+    assert schedule.wire_fault("c5", "0")
+    assert schedule.wire_fault("c6", "0")
+    assert schedule.take_server_kill(0)
+    assert schedule.take_host_fault(0, 0)
+    assert schedule.take_host_fault(1, 0)
+    assert schedule.dcn_delay(2, 0) > 0
+    assert schedule.counts() == {kind: 1 for kind in FAULT_KINDS}
+    text = reg.render_prometheus()
+    for kind in FAULT_KINDS:
+        assert f'nanofed_faults_injected_total{{kind="{kind}"}} 1' in text
+
+
+def test_chaos_client_applies_all_boundary_actions():
+    clock = VirtualClock()
+    schedule = _schedule(
+        FaultEvent(kind="crash", round=3, client="c0"),
+        FaultEvent(kind="delay", round=1, client="c0", seconds=5.0),
+        FaultEvent(kind="skew", round=1, client="c0", seconds=1),
+        FaultEvent(kind="corrupt", round=1, client="c0"),
+        FaultEvent(kind="duplicate", round=1, client="c0", count=2),
+    )
+    stub = StubClient()
+    chaos = ChaosClient(stub, schedule, clock=clock)
+
+    async def main():
+        assert chaos.alive(0)
+        t0 = clock.time()
+        ok = await chaos.submit({"w": 1}, {}, 1)
+        assert ok
+        # delay rode the injected clock, not the wall.
+        assert clock.time() - t0 == pytest.approx(5.0)
+        return True
+
+    assert asyncio.run(main())
+    # skew: the submit carried a round header one back.
+    assert stub.submits[0][1] == 0
+    # corrupt: the wire filter flipped bits, and was restored afterwards.
+    assert stub.submits[0][2] == _flip_bits(b"x" * 200)
+    assert stub.wire_filter is None
+    # duplicate: the retry storm re-POSTed count extra times.
+    assert stub.resends == 2
+    # crash: permanent from its round.
+    assert chaos.alive(2) and not chaos.alive(3) and not chaos.alive(9)
+
+
+def test_host_injector_consumes_and_delays():
+    schedule = _schedule(
+        FaultEvent(kind="host_crash", round=2, host=1),
+        FaultEvent(kind="dcn_degrade", round=0, host=0, seconds=0.3, count=2),
+    )
+    ours = HostChaosInjector(schedule, host=0)
+    theirs = HostChaosInjector(schedule, host=1)
+    # maybe_fail is a no-op for an untargeted host (never exits the test!).
+    ours.maybe_fail(0)
+    assert ours.take_fault(5) is None
+    assert ours.dcn_delay_s(0) == pytest.approx(0.3)
+    assert ours.dcn_delay_s(1) == pytest.approx(0.3)
+    assert ours.dcn_delay_s(2) == 0.0
+    # The targeted host's fault is visible (take_fault — the query maybe_fail
+    # acts on) and consumed exactly once.
+    event = theirs.take_fault(3)
+    assert event is not None and event.kind == "host_crash"
+    assert theirs.take_fault(3) is None

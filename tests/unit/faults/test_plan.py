@@ -109,3 +109,82 @@ def test_client_events_collects_this_rounds_faults():
     assert [e.kind for e in schedule.client_events("c1", 1)] == ["duplicate"]
     # duplicate is counted: consumed after its count is spent.
     assert schedule.client_events("c1", 1) == []
+
+
+# ---------------------------------------------------------------------------
+# Host-targeted kinds (PR 13: host_crash / host_stall / dcn_degrade)
+# ---------------------------------------------------------------------------
+
+
+def test_host_event_validation():
+    with pytest.raises(ValueError, match="needs a target host"):
+        FaultEvent(kind="host_crash", round=1)
+    with pytest.raises(ValueError, match="host must be"):
+        FaultEvent(kind="host_stall", round=1, host=-1)
+    with pytest.raises(ValueError, match="not a per-client"):
+        FaultEvent(kind="host_crash", round=1, host=0, client="c0")
+    with pytest.raises(ValueError, match="does not take a host"):
+        FaultEvent(kind="crash", round=1, client="c0", host=0)
+
+
+def test_host_events_json_round_trip():
+    plan = FaultPlan(seed=11, events=(
+        FaultEvent(kind="host_crash", round=2, host=1),
+        FaultEvent(kind="host_stall", round=3, host=0),
+        FaultEvent(kind="dcn_degrade", round=1, host=2, seconds=0.25, count=3),
+    ))
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    raw = json.loads(plan.to_json())
+    assert {e["host"] for e in raw["events"]} == {0, 1, 2}
+
+
+def test_generate_draws_host_faults_from_the_seed():
+    a = FaultPlan.generate(5, [], 8, hosts=4, host_crash_count=1,
+                           host_stall_count=1, dcn_degrade_fraction=0.5,
+                           dcn_delay_s=0.3)
+    b = FaultPlan.generate(5, [], 8, hosts=4, host_crash_count=1,
+                           host_stall_count=1, dcn_degrade_fraction=0.5,
+                           dcn_delay_s=0.3)
+    assert a == b
+    kinds = sorted(e.kind for e in a.events)
+    assert kinds == ["dcn_degrade", "dcn_degrade", "host_crash", "host_stall"]
+    # Terminal host faults never hit the same host twice (a quorum must
+    # survive to recover into), and land mid-run like client crashes.
+    terminal = [e for e in a.events if e.kind in ("host_crash", "host_stall")]
+    assert len({e.host for e in terminal}) == 2
+    assert all(1 <= e.round <= 4 for e in terminal)
+    with pytest.raises(ValueError, match="hosts >= 1"):
+        FaultPlan.generate(0, [], 8, host_crash_count=1)
+    with pytest.raises(ValueError, match="at most once"):
+        FaultPlan.generate(0, [], 8, hosts=2, host_crash_count=2,
+                           host_stall_count=1)
+
+
+def test_take_host_fault_is_permanent_and_consumed_once():
+    schedule = ChaosSchedule(
+        FaultPlan(events=(FaultEvent(kind="host_crash", round=2, host=1),)),
+        registry=MetricsRegistry(),
+    )
+    assert schedule.take_host_fault(1, 0) is None
+    assert schedule.take_host_fault(0, 5) is None  # other hosts unaffected
+    event = schedule.take_host_fault(1, 4)  # at-or-before semantics
+    assert event is not None and event.kind == "host_crash"
+    assert schedule.take_host_fault(1, 5) is None  # consumed exactly once
+    assert schedule.counts() == {"host_crash": 1}
+
+
+def test_dcn_delay_covers_count_rounds_and_is_metered():
+    reg = MetricsRegistry()
+    schedule = ChaosSchedule(
+        FaultPlan(events=(
+            FaultEvent(kind="dcn_degrade", round=1, host=0, seconds=0.2,
+                       count=2),
+        )),
+        registry=reg,
+    )
+    assert schedule.dcn_delay(0, 0) == 0.0
+    assert schedule.dcn_delay(1, 1) == 0.0  # other host untouched
+    assert schedule.dcn_delay(0, 1) == 0.2
+    assert schedule.dcn_delay(0, 2) == 0.2
+    assert schedule.dcn_delay(0, 3) == 0.0  # window over (count spent)
+    assert 'kind="dcn_degrade"} 2' in reg.render_prometheus()

@@ -158,3 +158,37 @@ def test_tracer_threads_nest_independently():
         t.join()
     # Both spans overlap in time but neither is the other's child.
     assert all(r.depth == 0 and r.parent_id is None for r in tracer.records)
+
+
+def test_summarize_digests_host_failure_and_recovery_records(tmp_path):
+    """The hostchaos supervisor's telemetry (parallel.resilience): detected
+    host failures by kind and elastic recoveries with an MTTR digest."""
+    p = tmp_path / "telemetry.jsonl"
+    p.write_text(
+        json.dumps({"type": "host_failure", "kind": "host_crash", "host": 1,
+                    "round": 3, "detection_s": 0.08, "detail": "rc=31"})
+        + "\n"
+        + json.dumps({"type": "host_failure", "kind": "host_stall", "host": 0,
+                      "round": 5, "detection_s": 6.2})
+        + "\n"
+        + json.dumps({"type": "recovery", "recovery_s": 9.7,
+                      "resumed_generation": 1, "resumed_round": 2,
+                      "rounds_lost": 1, "hosts_before": 3, "hosts_after": 2,
+                      "reshape": True, "rejoin": False})
+        + "\n"
+        + json.dumps({"type": "recovery", "resumed_generation": 3,
+                      "resumed_round": 6, "rounds_lost": 0,
+                      "hosts_before": 2, "hosts_after": 3, "reshape": True,
+                      "rejoin": True})
+        + "\n"
+    )
+    summary = summarize_telemetry(p)
+    assert summary["host_failures"]["by_kind"] == {
+        "host_crash": 1, "host_stall": 1,
+    }
+    assert summary["host_failures"]["events"][0]["host"] == 1
+    rec = summary["recoveries"]
+    assert rec["count"] == 2
+    assert rec["mttr"]["count"] == 1  # the rejoin record carries no MTTR
+    assert rec["mttr"]["p50_s"] == 9.7
+    assert rec["events"][1]["rejoin"] is True

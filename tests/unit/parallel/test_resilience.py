@@ -1,0 +1,182 @@
+"""parallel.resilience: the mesh tier's fault model.
+
+The acceptance-bar test lives here: a ``host_stall`` — a peer that hangs
+rather than crashes — is detected within the configured watchdog deadline, on
+the VIRTUAL clock, by a dispatch that would otherwise hang FOREVER (the gloo
+cross-host psum has no deadline of its own).  Plus: heartbeat/monitor
+semantics (sequence-number freshness on the monitor's own clock, no
+cross-host wall-clock comparison), sync watchdog bracketing with the
+keep-alive tick, and the typed-failure/recoverability contract.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from nanofed_tpu.parallel.resilience import (
+    CollectiveWatchdog,
+    Heartbeat,
+    HostFailure,
+    HostMonitor,
+    no_orphans,
+)
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.persistence import is_recoverable
+from nanofed_tpu.utils.clock import VirtualClock
+
+
+def test_host_failure_is_typed_and_recoverable():
+    exc = HostFailure("host_stall", host=2, round_number=7, detail="frozen")
+    assert exc.kind == "host_stall" and exc.host == 2
+    assert "host 2" in str(exc) and "round 7" in str(exc)
+    # The recovery contract: a host loss retries like a server crash —
+    # NanoFedError config bugs do not, HostFailure must.
+    assert is_recoverable(exc)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat + HostMonitor
+# ---------------------------------------------------------------------------
+
+
+def test_stall_detection_rides_the_monitors_clock(tmp_path):
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    hb0 = Heartbeat(tmp_path, 0)
+    hb1 = Heartbeat(tmp_path, 1)
+    monitor = HostMonitor(tmp_path, stall_timeout_s=10, clock=clock,
+                          registry=reg)
+    hb0.beat(round_number=0)
+    hb1.beat(round_number=0)
+    assert monitor.stalled() == []
+    clock.advance(8)
+    hb0.beat(round_number=1)  # host 0 advances; host 1 freezes
+    assert monitor.stalled() == []  # 8s < timeout for host 1
+    clock.advance(4)  # host 1 now 12s frozen, host 0 only 4s
+    failures = monitor.stalled()
+    assert [f.host for f in failures] == [1]
+    assert failures[0].kind == "host_stall"
+    # Flagged once, counted once — until recovery clears the verdict.
+    assert monitor.stalled() == []
+    counter = reg.counter("nanofed_host_failures_total", "", labels=("kind",))
+    assert counter.value(kind="host_stall") == 1
+    monitor.clear(1)
+    hb1.beat(round_number=1)
+    assert monitor.stalled() == []
+
+
+def test_monitor_skips_torn_heartbeat_files(tmp_path):
+    clock = VirtualClock()
+    Heartbeat(tmp_path, 0).beat(round_number=3, generation=1)
+    (tmp_path / "host_9.hb.json").write_text("{torn")
+    monitor = HostMonitor(tmp_path, stall_timeout_s=5, clock=clock,
+                          registry=MetricsRegistry())
+    states = monitor.poll()
+    assert list(states) == [0]
+    assert states[0].round_number == 3 and states[0].generation == 1
+
+
+def test_heartbeat_seq_increases_and_publishes_atomically(tmp_path):
+    hb = Heartbeat(tmp_path, 4)
+    hb.beat(round_number=0)
+    hb.beat(round_number=1, status="committed")
+    monitor = HostMonitor(tmp_path, stall_timeout_s=5, clock=VirtualClock(),
+                          registry=MetricsRegistry())
+    state = monitor.poll()[4]
+    assert state.seq == 2 and state.status == "committed"
+    assert not list(tmp_path.glob("*.tmp"))  # tmp never left behind
+
+
+# ---------------------------------------------------------------------------
+# CollectiveWatchdog — THE acceptance test: a stalled peer's hang is bounded
+# ---------------------------------------------------------------------------
+
+
+def test_stalled_peer_detected_within_deadline_on_virtual_clock():
+    """Without the watchdog this dispatch hangs FOREVER (the stalled peer
+    never arrives at the collective; awaiting it = awaiting a sleep to the
+    end of time).  With it, the hang surfaces as a typed HostFailure at
+    exactly the deadline — in virtual time, i.e. milliseconds of real time —
+    and a recovery dispatch on the surviving mesh then succeeds."""
+    clock = VirtualClock()
+    watchdog = CollectiveWatchdog(30.0, clock=clock, registry=MetricsRegistry())
+
+    async def dispatch_with_stalled_peer():
+        await clock.sleep(10**9)  # the peer never shows up
+
+    async def dispatch_on_survivors():
+        await clock.sleep(1.0)
+        return "round-result"
+
+    async def main():
+        t0 = clock.time()
+        with pytest.raises(HostFailure) as err:
+            await watchdog.guard(dispatch_with_stalled_peer(), round_number=5)
+        assert err.value.kind == "collective_timeout"
+        assert err.value.round_number == 5
+        # Bounded detection: the failure fired AT the deadline, not at the
+        # stalled peer's sleep horizon.
+        assert clock.time() - t0 == pytest.approx(30.0)
+        # The mesh re-forms and the next dispatch completes.
+        assert await watchdog.guard(dispatch_on_survivors()) == "round-result"
+
+    asyncio.run(main())
+
+
+def test_guard_passes_results_and_dcn_grace():
+    clock = VirtualClock()
+    watchdog = CollectiveWatchdog(2.0, clock=clock, registry=MetricsRegistry())
+
+    async def degraded_dispatch():
+        await clock.sleep(2.5)  # over the base deadline, within the grace
+        return 7
+
+    async def main():
+        return await watchdog.guard(degraded_dispatch(), dcn_grace_s=1.0)
+
+    assert asyncio.run(main()) == 7
+
+
+def test_sync_run_times_out_and_keeps_ticking():
+    ticks = []
+    release = threading.Event()
+    watchdog = CollectiveWatchdog(0.4, registry=MetricsRegistry())
+    with pytest.raises(HostFailure) as err:
+        watchdog.run(
+            lambda: release.wait(10), round_number=2,
+            tick=lambda: ticks.append(time.monotonic()), tick_interval_s=0.1,
+        )
+    assert err.value.kind == "collective_timeout"
+    # The keep-alive tick fired while blocked: a host WAITING on a collective
+    # must keep heartbeating or the monitor misreads it as the stalled one.
+    assert len(ticks) >= 2
+    release.set()  # let the abandoned thread exit
+
+
+def test_sync_run_propagates_dispatch_errors_unchanged():
+    watchdog = CollectiveWatchdog(5.0, registry=MetricsRegistry())
+
+    def exploding():
+        raise ValueError("gloo says no")
+
+    with pytest.raises(ValueError, match="gloo says no"):
+        watchdog.run(exploding)
+    assert watchdog.run(lambda: 3) == 3
+
+
+def test_no_orphans_probe(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    assert no_orphans([]) == []
+    p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        assert no_orphans([p.pid]) == [p.pid]
+    finally:
+        p.kill()
+        p.wait()
+    assert no_orphans([p.pid]) == []
+    assert os.getpid() in no_orphans([os.getpid()])

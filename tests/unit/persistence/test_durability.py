@@ -1,0 +1,107 @@
+"""Crash-durability of the atomic checkpoint publish (persistence.serialization).
+
+``tmp.replace(path)`` alone survives a PROCESS crash (the rename is atomic)
+but not a HOST crash: without an fsync of the file before the rename the new
+name can point at pages still in the page cache, and without an fsync of the
+parent directory after it the rename itself can be lost — the exact failure
+``host_crash`` injects the moment after "checkpoint written".  These tests
+drive the publish through an injected os-level fault double and assert the
+ordering contract: fsync(file) BEFORE replace, fsync(parent dir) AFTER, and a
+failed file-fsync never publishes a path the marker protocol would then trust.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.persistence.serialization import (
+    load_pytree_npz,
+    load_state_pickle,
+    save_pytree_npz,
+    save_state_pickle,
+)
+
+TREE = {"layer": {"w": np.ones((2, 2), dtype=np.float32)}}
+
+
+class FsyncRecorder:
+    """Fault double for the os layer: records every fsync (file fds vs
+    directory fds) and the rename, so ordering is assertable; optionally
+    raises on the file fsync to simulate the dying-disk path."""
+
+    def __init__(self, monkeypatch, fail_file_fsync=False):
+        import pathlib
+
+        self.calls = []
+        self.fail_file_fsync = fail_file_fsync
+        self._real_fsync = os.fsync
+        real_replace = pathlib.Path.replace
+        rec = self
+
+        def patched_replace(path_self, target):
+            rec.calls.append("replace")
+            return real_replace(path_self, target)
+
+        monkeypatch.setattr(os, "fsync", self._fsync)
+        # pathlib binds os.replace at class-creation time; intercept the
+        # Path method (the seam the publish actually calls).
+        monkeypatch.setattr(pathlib.Path, "replace", patched_replace)
+
+    def _fsync(self, fd):
+        import stat
+
+        is_dir = stat.S_ISDIR(os.fstat(fd).st_mode)
+        self.calls.append("fsync_dir" if is_dir else "fsync_file")
+        if self.fail_file_fsync and not is_dir:
+            raise OSError(28, "No space left on device")
+        return self._real_fsync(fd)
+
+
+
+@pytest.mark.parametrize("save,load,name", [
+    (save_state_pickle, load_state_pickle, "state.pkl"),
+    (save_pytree_npz, load_pytree_npz, "params.npz"),
+])
+def test_publish_fsyncs_file_before_and_dir_after_rename(
+    tmp_path, monkeypatch, save, load, name
+):
+    rec = FsyncRecorder(monkeypatch)
+    path = tmp_path / name
+    save(path, TREE)
+    assert "fsync_file" in rec.calls and "fsync_dir" in rec.calls
+    assert rec.calls.index("fsync_file") < rec.calls.index("replace")
+    assert rec.calls.index("replace") < rec.calls.index("fsync_dir")
+    loaded = load(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layer"]["w"]), TREE["layer"]["w"]
+    )
+
+
+def test_failed_file_fsync_never_publishes(tmp_path, monkeypatch):
+    # If the data cannot be made durable, the checkpoint must not appear at
+    # its final name: a commit marker written next would otherwise vouch for
+    # state that a host crash can still lose.
+    FsyncRecorder(monkeypatch, fail_file_fsync=True)
+    path = tmp_path / "state.pkl"
+    with pytest.raises(OSError, match="No space left"):
+        save_state_pickle(path, TREE)
+    assert not path.exists()
+
+
+def test_failed_dir_fsync_degrades_without_error(tmp_path, monkeypatch):
+    # Directory fds reject fsync on some filesystems; the publish must not
+    # fail there — it degrades to pre-fsync durability.
+    real_fsync = os.fsync
+
+    def flaky(fd):
+        import stat
+
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            raise OSError(22, "Invalid argument")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky)
+    path = tmp_path / "state.pkl"
+    save_state_pickle(path, TREE)
+    assert path.exists()

@@ -1,0 +1,111 @@
+"""persistence.generation_store: the multi-host commit-by-all recovery rule
+and the at-most-one-block loss guarantee it exists to provide."""
+
+import json
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.exceptions import CheckpointError
+from nanofed_tpu.persistence import GenerationStore
+
+
+PARAMS = {"dense": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+STATE = {"momentum": np.zeros(3, dtype=np.float32)}
+
+
+def _commit(base, host, gen, rnd, hosts, scale=1.0):
+    store = GenerationStore(base, host=host)
+    params = {"dense": {"w": PARAMS["dense"]["w"] * scale}}
+    store.commit(gen, rnd, params, STATE, hosts=hosts)
+    return store
+
+
+def test_generation_complete_only_when_all_participants_committed(tmp_path):
+    _commit(tmp_path, 0, 1, 2, hosts=[0, 1])
+    store = GenerationStore(tmp_path)
+    assert not store.is_complete(1)  # host 1 still writing
+    assert store.latest_complete() is None
+    _commit(tmp_path, 1, 1, 2, hosts=[0, 1])
+    assert store.is_complete(1)
+    rec = store.latest_complete()
+    assert rec.generation == 1 and rec.round_number == 2
+    assert rec.hosts == (0, 1)
+    np.testing.assert_array_equal(rec.params["dense"]["w"], PARAMS["dense"]["w"])
+
+
+def test_recovery_skips_torn_newest_generation(tmp_path):
+    # Gen 1 complete; gen 2 torn (one host died mid-boundary): recovery must
+    # take gen 1 — resuming a half-committed generation would fork the model.
+    for h in (0, 1):
+        _commit(tmp_path, h, 1, 2, hosts=[0, 1])
+    _commit(tmp_path, 0, 2, 4, hosts=[0, 1])
+    rec = GenerationStore(tmp_path, host=1).latest_complete()
+    assert rec.generation == 1 and rec.round_number == 2
+
+
+def test_at_most_one_block_loss(tmp_path):
+    # The guarantee, end to end: block size B, failure at round r — recovery
+    # resumes at most B rounds back, whatever r is.
+    B = 3
+    for fail_round in range(1, 10):
+        base = tmp_path / f"case_{fail_round}"
+        completed_boundaries = fail_round // B  # commits that happened
+        for g in range(1, completed_boundaries + 1):
+            for h in (0, 1):
+                _commit(base, h, g, g * B, hosts=[0, 1])
+        rec = GenerationStore(base).latest_complete()
+        resumed = rec.round_number if rec else 0
+        assert 0 <= fail_round - resumed < B + 1
+        assert fail_round - resumed == fail_round % B
+
+
+def test_restore_prefers_own_shard_but_any_survivor_works(tmp_path):
+    _commit(tmp_path, 0, 1, 2, hosts=[0, 1], scale=1.0)
+    _commit(tmp_path, 1, 1, 2, hosts=[0, 1], scale=1.0)
+    # A read-only reader (the supervisor) and a surviving host both restore.
+    assert GenerationStore(tmp_path).latest_complete().generation == 1
+    assert GenerationStore(tmp_path, host=1).latest_complete().generation == 1
+    # A rejoining host that never wrote gen 1 restores from a peer's file.
+    assert GenerationStore(tmp_path, host=7).latest_complete().generation == 1
+
+
+def test_shrunk_participant_set_is_a_legal_recovery_point(tmp_path):
+    # Full mesh commits gen 1; host 0 dies; the SHRUNK set commits gen 2
+    # with hosts=[1].  Recovery resumes gen 2 — the elastic-reshape case.
+    for h in (0, 1):
+        _commit(tmp_path, h, 1, 2, hosts=[0, 1])
+    _commit(tmp_path, 1, 2, 4, hosts=[1])
+    rec = GenerationStore(tmp_path).latest_complete()
+    assert rec.generation == 2 and rec.hosts == (1,)
+
+
+def test_disagreeing_participant_sets_are_not_complete(tmp_path):
+    # Two hosts committed the same generation under DIFFERENT participant
+    # sets: a torn reshape.  Not a recovery point.
+    _commit(tmp_path, 0, 1, 2, hosts=[0, 1])
+    _commit(tmp_path, 1, 1, 2, hosts=[1])
+    store = GenerationStore(tmp_path)
+    assert not store.is_complete(1)
+    assert store.latest_complete() is None
+
+
+def test_marker_without_state_file_is_incomplete(tmp_path):
+    _commit(tmp_path, 0, 1, 2, hosts=[0])
+    (tmp_path / "generations" / "gen_1" / "host_0.state.pkl").unlink()
+    assert not GenerationStore(tmp_path).is_complete(1)
+
+
+def test_writer_validation(tmp_path):
+    with pytest.raises(CheckpointError, match="read-only"):
+        GenerationStore(tmp_path).commit(1, 2, PARAMS, STATE, hosts=[0])
+    with pytest.raises(CheckpointError, match="generation"):
+        GenerationStore(tmp_path, host=0).commit(-1, 2, PARAMS, STATE, hosts=[0])
+
+
+def test_marker_is_json_an_operator_can_read(tmp_path):
+    _commit(tmp_path, 0, 3, 6, hosts=[0, 2])
+    marker = json.loads(
+        (tmp_path / "generations" / "gen_3" / "host_0.commit.json").read_text()
+    )
+    assert marker == {"host": 0, "generation": 3, "round": 6, "hosts": [0, 2]}
