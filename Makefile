@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke multihost-smoke multihost-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -54,6 +54,27 @@ hostchaos-smoke:
 # Tier-1-safe: virtual time, seconds of real time, seeded determinism.
 loadtest-smoke:
 	python -m pytest tests/integration/test_loadtest_smoke.py -q
+
+# Tenants smoke (nanofed_tpu.service): two tenants — different models,
+# different serving paths — run CONCURRENTLY on one shared transport and one
+# VirtualClock while a seeded wire-fault storm (drops, lost-ACK duplicate
+# retry storms, delays) targets exactly one of them; the untargeted tenant must
+# complete every round with zero lost submits, the chaos counters must show
+# the storm hit the targeted tenant only, and metrics-summary must digest
+# the per-tenant telemetry records.  The slow-marked 3-tenant
+# concurrent-vs-sequential leg runs here too (tier-1 excludes it).
+tenants-smoke:
+	python -m pytest tests/integration/test_tenant_service.py -q -p no:cacheprovider
+
+# The multi-tenant evidence artifact: >= 3 concurrent tenants (distinct
+# models/algorithms), aggregate rounds/sec vs the sequential baseline, and
+# per-tenant p99 submit latency while a chaos storm targets one tenant ->
+# runs/tenants_*.json.  Exit 1 if any untargeted tenant lost rounds/submits.
+# SYSTEM clock on purpose: the concurrency win is real overlapped waiting —
+# a VirtualClock compresses the very idle time the service exists to overlap.
+tenants-bench:
+	python -m nanofed_tpu.cli tenants --tenants 3 --rounds 4 --clients 80 \
+	  --arrival uniform --rate 30 --seed 14
 
 # Autotune smoke (nanofed_tpu.tuning): sweep a tiny MLP config space on CPU
 # with the compiler's cost model — a winner must be chosen via AOT analysis

@@ -691,6 +691,47 @@ def _cmd_loadtest(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def _cmd_tenants(args: argparse.Namespace) -> int:
+    """Run the multi-tenant service harness and print the artifact (also
+    written under --out-dir).  Exit 1 when an untargeted tenant lost rounds
+    or submits — the isolation claim IS the exit code."""
+    from nanofed_tpu.service import run_tenant_service
+
+    chaos: bool | str | None
+    if args.chaos_tenant == "none":
+        chaos = None
+    elif args.chaos_tenant == "first":
+        chaos = True
+    else:
+        chaos = args.chaos_tenant
+    artifact = run_tenant_service(
+        tenants=args.tenants,
+        rounds=args.rounds,
+        clients_per_tenant=args.clients,
+        submits_per_client=args.submits_per_client,
+        async_buffer_k=args.async_buffer,
+        arrival=args.arrival,
+        arrival_rate=args.rate,
+        chaos_tenant=chaos,
+        chaos_seed=args.chaos_seed,
+        virtual_clock=args.virtual_clock,
+        sequential_baseline=not args.no_sequential,
+        hbm_budget_bytes=(
+            int(args.hbm_budget) if args.hbm_budget is not None else None
+        ),
+        seed=args.seed,
+        out_dir=args.out_dir,
+        telemetry_dir=args.telemetry_dir,
+        tag=args.tag,
+    )
+    print(json.dumps(artifact, indent=2))
+    ok = (
+        artifact["isolation"]["zero_rounds_lost"]
+        and artifact["isolation"]["zero_failed_submits"]
+    )
+    return 0 if ok else 1
+
+
 def _cmd_bench(args: argparse.Namespace) -> int:
     from nanofed_tpu.benchmarks import BENCHMARKS, run_benchmark
 
@@ -1128,6 +1169,61 @@ def main(argv: list[str] | None = None) -> int:
         "(read back with `nanofed-tpu metrics-summary`)",
     )
 
+    tenants = sub.add_parser(
+        "tenants",
+        help="multi-tenant federation service harness (nanofed_tpu.service): "
+        "run N concurrent tenant jobs (distinct models/algorithms) over one "
+        "device pool behind one listener, drive a swarm per tenant, target a "
+        "chaos storm at one tenant, and record aggregate rounds/sec vs the "
+        "sequential baseline + per-tenant p99 + the isolation proof as a "
+        "runs/tenants_*.json artifact",
+    )
+    tenants.add_argument("--tenants", type=int, default=3,
+                         help="concurrent tenant jobs (models/algorithms "
+                         "cycle through the default roster)")
+    tenants.add_argument("--rounds", type=int, default=4,
+                         help="aggregations (fedbuff) / rounds (fedavg) per "
+                         "tenant")
+    tenants.add_argument("--clients", type=int, default=40,
+                         help="swarm clients per tenant")
+    tenants.add_argument("--submits-per-client", type=int, default=2)
+    tenants.add_argument("--async-buffer", type=int, default=16, metavar="K")
+    tenants.add_argument(
+        "--arrival", default="poisson", choices=["poisson", "uniform", "burst"],
+    )
+    tenants.add_argument("--rate", type=float, default=500.0,
+                         help="mean arrival rate, submits/sec per tenant")
+    tenants.add_argument(
+        "--chaos-tenant", default="first",
+        help="tenant the wire-fault storm targets: a name, 'first' "
+        "(default), or 'none' for a clean run",
+    )
+    tenants.add_argument("--chaos-seed", type=int, default=7)
+    tenants.add_argument(
+        "--no-sequential", action="store_true",
+        help="skip the one-tenant-at-a-time baseline runs",
+    )
+    tenants.add_argument(
+        "--virtual-clock", action="store_true",
+        help="run arrivals/backoffs/timeouts on a VirtualClock "
+        "(deterministic, seconds of real time — what the CI smoke uses)",
+    )
+    tenants.add_argument(
+        "--hbm-budget", type=float, default=None, metavar="BYTES",
+        help="per-device memory budget for the scheduler's admission "
+        "bin-pack (default: the autotuner's provenance chain — env, "
+        "runtime bytes_limit, published HBM table, else unbounded)",
+    )
+    tenants.add_argument("--seed", type=int, default=0)
+    tenants.add_argument("--tag", default=None,
+                         help="artifact name suffix (default: UTC stamp)")
+    tenants.add_argument("--out-dir", default="runs")
+    tenants.add_argument(
+        "--telemetry-dir", default=None,
+        help="also append per-tenant 'tenant' telemetry records here "
+        "(read back with `nanofed-tpu metrics-summary`)",
+    )
+
     bench = sub.add_parser("bench", help="run a named benchmark (BASELINE.json suite)")
     bench.add_argument("name", nargs="?", default="mnist_iid")
     bench.add_argument("--list", action="store_true", help="list benchmark names")
@@ -1152,6 +1248,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_profile(args)
     if args.cmd == "loadtest":
         return _cmd_loadtest(args)
+    if args.cmd == "tenants":
+        return _cmd_tenants(args)
     return _cmd_run(args)
 
 

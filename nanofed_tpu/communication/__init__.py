@@ -21,6 +21,9 @@ from nanofed_tpu.communication.codec import (
 _NET_EXPORTS = {
     "HTTPServer": "http_server",
     "ServerEndpoints": "http_server",
+    "HTTPTransport": "transport",
+    "HEADER_TENANT": "transport",
+    "tenant_base_url": "transport",
     "HTTPClient": "http_client",
     "ClientEndpoints": "http_client",
     "NetworkCoordinator": "network_coordinator",
@@ -49,6 +52,9 @@ __all__ = [
     "ENCODING_TOPK8",
     "HTTPClient",
     "HTTPServer",
+    "HTTPTransport",
+    "HEADER_TENANT",
+    "tenant_base_url",
     "NetworkCoordinator",
     "NetworkRoundConfig",
     "decode_delta_q8",

@@ -14,6 +14,14 @@ Differences by design (SURVEY.md §7 stage 9):
   server owns the buffer and exposes ``num_updates`` / ``drain_updates``.
 * The simulator path (``nanofed_tpu.parallel``) never touches this module; it exists for
   true cross-device federation.
+
+Since the transport/session split (multi-tenant federation service), this class is
+the per-tenant SESSION: routing, tenant resolution, and lifecycle live in
+``communication.transport.HTTPTransport``, while everything here — round/version
+buffers, admission counters, submit-key dedup windows, secure-aggregation rosters,
+chaos application — is per-session state a shared transport multiplexes N of.  A
+standalone ``HTTPServer`` (no ``transport=``) owns a private transport and behaves
+byte-identically to the pre-split server.
 """
 
 from __future__ import annotations
@@ -32,6 +40,10 @@ from nanofed_tpu.communication.codec import (
     ENCODING_TOPK8,
     decode_params,
     encode_params,
+)
+from nanofed_tpu.communication.transport import (
+    HTTPTransport,
+    read_body_bounded,
 )
 from nanofed_tpu.core.types import ModelUpdate, Params
 from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
@@ -92,6 +104,8 @@ class HTTPServer:
         chaos: Any | None = None,
         clock: Clock | None = None,
         ingest: Any | None = None,
+        transport: HTTPTransport | None = None,
+        tenant: str | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -142,7 +156,21 @@ class HTTPServer:
         their own buffer — masked vectors cannot be batch-reduced before
         unmasking — but their CPU-bound decode rides the same bounded pool.
         The idempotent-key, stale-round, and signature contracts are
-        identical on both paths."""
+        identical on both paths.
+
+        ``transport`` mounts this session on a SHARED
+        :class:`~nanofed_tpu.communication.transport.HTTPTransport` under the
+        given ``tenant`` name (the multi-tenant federation service's shape:
+        one listener, N per-tenant sessions; the transport resolves tenant
+        identity from the ``/t/<tenant>`` path prefix or the
+        ``X-NanoFed-Tenant`` header and this session never sees another
+        tenant's requests).  ``transport=None`` (the single-tenant default)
+        creates a PRIVATE transport and mounts this session as its default —
+        the pre-split wire behavior, byte-identical.  On a shared transport
+        the transport's lifecycle and ``client_max_size`` govern;
+        ``host``/``port``/``max_request_size`` here are ignored and
+        ``start()`` must not be called (the service starts the transport
+        once)."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
         if max_inflight is not None and max_inflight < 0:
@@ -230,28 +258,42 @@ class HTTPServer:
             "nanofed_read_timeouts_total",
             "Request bodies that failed to arrive within read_timeout_s (408)",
         )
-        middlewares = []
-        if chaos is not None:
-            @web.middleware
-            async def chaos_mw(request: web.Request, handler: Any) -> Any:
-                return await self._apply_chaos(request, handler)
-
-            middlewares.append(chaos_mw)
-        self._app = web.Application(
-            client_max_size=max_request_size, middlewares=middlewares
-        )
-        self._app.router.add_get(self.endpoints.model, self._handle_get_model)
-        self._app.router.add_post(self.endpoints.update, self._handle_submit_update)
-        self._app.router.add_get(self.endpoints.status, self._handle_status)
-        self._app.router.add_get(self.endpoints.test, self._handle_test)
-        self._app.router.add_get(self.endpoints.metrics, self._handle_metrics)
-        self._app.router.add_post(self.endpoints.secagg_register, self._handle_secagg_register)
-        self._app.router.add_get(self.endpoints.secagg_roster, self._handle_secagg_roster)
-        self._app.router.add_post(self.endpoints.secagg_shares, self._handle_secagg_shares_post)
-        self._app.router.add_get(self.endpoints.secagg_shares, self._handle_secagg_shares_get)
-        self._app.router.add_get(self.endpoints.secagg_unmask, self._handle_unmask_get)
-        self._app.router.add_post(self.endpoints.secagg_unmask, self._handle_unmask_post)
-        self._runner: web.AppRunner | None = None
+        # Logical-path route table: the transport resolves the TENANT and
+        # hands this session the endpoint path; everything behind it —
+        # admission, dedup windows, chaos, quota state — is session-scoped.
+        ep = self.endpoints
+        self._routes: dict[tuple[str, str], Any] = {
+            ("GET", ep.model): self._handle_get_model,
+            ("POST", ep.update): self._handle_submit_update,
+            ("GET", ep.status): self._handle_status,
+            ("GET", ep.test): self._handle_test,
+            ("GET", ep.metrics): self._handle_metrics,
+            ("POST", ep.secagg_register): self._handle_secagg_register,
+            ("GET", ep.secagg_roster): self._handle_secagg_roster,
+            ("POST", ep.secagg_shares): self._handle_secagg_shares_post,
+            ("GET", ep.secagg_shares): self._handle_secagg_shares_get,
+            ("GET", ep.secagg_unmask): self._handle_unmask_get,
+            ("POST", ep.secagg_unmask): self._handle_unmask_post,
+        }
+        self.tenant = tenant
+        self._owns_transport = transport is None
+        if transport is None and tenant is not None:
+            # A tenant name without a shared transport would silently mount
+            # as a private transport's DEFAULT session — /t/<name> requests
+            # would 404 while the name LOOKS configured.  Refuse loudly.
+            raise ValueError(
+                f"tenant={tenant!r} requires a shared transport= to mount "
+                "under; a standalone server is the anonymous default session"
+            )
+        if transport is None:
+            transport = HTTPTransport(
+                host=host, port=port, max_request_size=max_request_size,
+                registry=self.metrics_registry,
+            )
+            transport.add_session(self)  # default session: pre-split wire shape
+        else:
+            transport.add_session(self, tenant=tenant)
+        self.transport = transport
 
     # ------------------------------------------------------------------
     # Round-engine API (what the reference's coordinator did via _updates reach-in)
@@ -575,22 +617,51 @@ class HTTPServer:
         return self._ingest_pipeline
 
     # ------------------------------------------------------------------
-    # Fault injection (chaos middleware) + bounded reads
+    # Transport dispatch, fault injection, bounded reads
     # ------------------------------------------------------------------
+
+    async def dispatch(
+        self, path: str, request: web.Request
+    ) -> web.StreamResponse:
+        """Transport entry point: route the LOGICAL endpoint path (tenant
+        prefix already stripped by the transport) to this session's handler,
+        applying this session's chaos schedule to its update endpoint.  The
+        method/path table replaces the pre-split aiohttp router, so custom
+        ``ServerEndpoints`` keep working and a missing path 404s here — inside
+        the resolved tenant, never across tenants."""
+        handler = self._routes.get((request.method, path))
+        if handler is None and request.method == "HEAD":
+            # Parity with the pre-split aiohttp router's automatic HEAD
+            # support on GET routes (load-balancer health probes HEAD
+            # /status); the protocol layer suppresses the body.
+            handler = self._routes.get(("GET", path))
+        if handler is None:
+            if any(p == path for _, p in self._routes):
+                return web.json_response(
+                    {"status": "error",
+                     "message": f"method {request.method} not allowed on {path}"},
+                    status=405,
+                )
+            return web.json_response(
+                {"status": "error", "message": f"no endpoint {path}"},
+                status=404,
+            )
+        if self._chaos is not None and path == self.endpoints.update:
+            return await self._apply_chaos(request, handler)
+        return await handler(request)
 
     async def _apply_chaos(self, request: web.Request, handler: Any) -> Any:
         """Apply the chaos schedule's wire fault to this request, if any.
 
         Only the update endpoint is faulted (the model/status/secagg paths have
-        their own failure modes driven from the client side): ``drop`` severs
+        their own failure modes driven from the client side — ``dispatch``
+        gates on the logical path): ``drop`` severs
         the connection BEFORE the handler — the submit never happened;
         ``ack_drop`` runs the handler (the update IS buffered) and severs the
         connection before the response — the lost ACK that makes idempotent
         submit keys necessary; ``delay`` holds the request for its seconds.
         One-shot events are consumed by the schedule, so a retry eventually
         gets through."""
-        if self._chaos is None or request.path != self.endpoints.update:
-            return await handler(request)
         event = self._chaos.wire_fault(
             request.headers.get(HEADER_CLIENT), request.headers.get(HEADER_ROUND)
         )
@@ -625,11 +696,13 @@ class HTTPServer:
         return await asyncio.to_thread(fn, *args, **kwargs)
 
     async def _read_body(self, request: web.Request) -> bytes:
-        """Read the request body with a TIME bound (``client_max_size`` bounds
-        the size): a slowloris peer trickling bytes must not hold this handler
-        — and its admission slot — open past ``read_timeout_s``."""
+        """Read the request body via the transport's bounded-read primitive
+        (``client_max_size`` bounds the size): a slowloris peer trickling
+        bytes must not hold this handler — and its admission slot — open past
+        ``read_timeout_s``.  The timeout and its 408 metric are per-session:
+        one tenant's slowloris storm counts against that tenant only."""
         try:
-            return await asyncio.wait_for(request.read(), timeout=self.read_timeout_s)
+            return await read_body_bounded(request, self.read_timeout_s)
         except asyncio.TimeoutError:
             self._m_read_timeouts.inc()
             raise web.HTTPRequestTimeout(
@@ -1641,16 +1714,28 @@ class HTTPServer:
     # Lifecycle (parity: server.py:319-340)
     # ------------------------------------------------------------------
 
+    @property
+    def _app(self) -> web.Application:
+        """The underlying aiohttp application (owned by the transport since
+        the transport/session split); kept for in-process test harnesses
+        (``aiohttp.test_utils.TestServer(server._app)``)."""
+        return self.transport.app
+
     async def start(self) -> None:
-        self._runner = web.AppRunner(self._app)
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
-        self._log.info("HTTP server on %s:%d", self.host, self.port)
+        """Start listening.  Only valid on a session that OWNS its transport
+        (the single-tenant shape); sessions mounted on a shared transport are
+        started once, by the service, via ``transport.start()``."""
+        if not self._owns_transport:
+            raise RuntimeError(
+                "this session rides a shared transport; start the transport "
+                "(once) instead of each session"
+            )
+        await self.transport.start()
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        """Release this session's resources; stops the transport too when this
+        session owns it (shared transports are stopped by the service)."""
+        if self._owns_transport:
+            await self.transport.stop()
         if self._ingest_pipeline is not None:
             self._ingest_pipeline.close()

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import math
 import time
+from contextlib import asynccontextmanager
 from dataclasses import dataclass, replace
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
@@ -245,6 +246,7 @@ class NetworkCoordinator:
         state_store: "FileStateStore | None" = None,
         chaos: Any | None = None,
         clock: Clock | None = None,
+        device_gate: Any | None = None,
     ):
         """``robust`` (a ``RobustAggregationConfig``) swaps the weighted FedAvg of
         drained updates for the coordinate-wise trimmed mean — the network path is
@@ -277,7 +279,16 @@ class NetworkCoordinator:
         harness rebuilds server + coordinator from ``state_store`` exactly as
         an operator's process supervisor would.  ``clock`` injects the time
         source for every deadline and poll sleep (tests pass a
-        ``VirtualClock`` so timeout behavior is load-independent)."""
+        ``VirtualClock`` so timeout behavior is load-independent).
+
+        ``device_gate`` (a zero-arg factory returning an async context
+        manager) brackets every DEVICE-dispatching aggregation section.  The
+        multi-tenant federation service passes its
+        :class:`~nanofed_tpu.service.RoundScheduler`'s lease here, so N
+        tenants sharing one device pool serialize their device steps in
+        weighted-fair order while each tenant's host-side waiting, decode and
+        publish overlap the others' device time.  None (the default) is the
+        single-tenant behavior: no gate, no overhead."""
         if robust is not None and secure is not None:
             raise ValueError(
                 "robust= cannot be combined with secure=: the server only ever "
@@ -344,6 +355,7 @@ class NetworkCoordinator:
         self.robust = robust
         self.state_store = state_store
         self.chaos = chaos
+        self._device_gate = device_gate
         self.history: list[dict[str, Any]] = []
         self._clock = clock or SYSTEM_CLOCK
         self._log = Logger()
@@ -403,6 +415,16 @@ class NetworkCoordinator:
             "nanofed_straggler_evictions_total",
             "Clients evicted from the sync round barrier after consecutive misses",
         )
+
+    @asynccontextmanager
+    async def _device_section(self):
+        """The device-step critical section: a no-op without a gate; under the
+        service scheduler, waits for the weighted-fair device lease."""
+        if self._device_gate is None:
+            yield
+            return
+        async with self._device_gate():
+            yield
 
     async def _wait_for_clients(self, required: int) -> bool:
         """Poll the update buffer until ``required`` updates arrive or timeout
@@ -755,9 +777,10 @@ class NetworkCoordinator:
                 record["evicted_stragglers"] = newly_evicted
             self.history.append(record)
             return record
-        with self._tracer.span("aggregate", round=round_number,
-                               num_clients=len(updates)):
-            record = self._aggregate_round(round_number, updates, num_rejected)
+        async with self._device_section():
+            with self._tracer.span("aggregate", round=round_number,
+                                   num_clients=len(updates)):
+                record = self._aggregate_round(round_number, updates, num_rejected)
         record["required"] = required
         if newly_evicted:
             record["evicted_stragglers"] = newly_evicted
@@ -775,8 +798,9 @@ class NetworkCoordinator:
         against the round's shared base IS the weighted mean of params); the
         round record keeps the per-submit shape so telemetry consumers and the
         straggler-eviction accounting see no difference."""
-        with self._tracer.span("aggregate", round=round_number, ingest=True):
-            new_flat, metas = await self.server.drain_ingest_fedavg()
+        async with self._device_section():
+            with self._tracer.span("aggregate", round=round_number, ingest=True):
+                new_flat, metas = await self.server.drain_ingest_fedavg()
         newly_evicted = self._note_participation({m.client_id for m in metas})
         if not ok or len(metas) < required:
             self._log.warning(
@@ -915,15 +939,16 @@ class NetworkCoordinator:
                     # fedbuff_combine to float tolerance, without K host-side
                     # tree traversals per aggregation.
                     try:
-                        with self._tracer.span("aggregate", aggregation=agg_i,
-                                               num_clients=got, ingest=True):
-                            new_flat, live, stats = (
-                                await self.server.drain_ingest_fedbuff(
-                                    k, version,
-                                    staleness_exponent=self.config.staleness_exponent,
-                                    server_lr=self.config.async_server_lr,
+                        async with self._device_section():
+                            with self._tracer.span("aggregate", aggregation=agg_i,
+                                                   num_clients=got, ingest=True):
+                                new_flat, live, stats = (
+                                    await self.server.drain_ingest_fedbuff(
+                                        k, version,
+                                        staleness_exponent=self.config.staleness_exponent,
+                                        server_lr=self.config.async_server_lr,
+                                    )
                                 )
-                            )
                     except ValueError as e:
                         record = self._async_stale_drain_record(agg_i, version, e)
                     else:
@@ -952,14 +977,15 @@ class NetworkCoordinator:
                     # truth for which bases are still reconstructable — no
                     # coordinator-side copy whose pruning could silently diverge.
                     try:
-                        with self._tracer.span("aggregate", aggregation=agg_i,
-                                               num_clients=len(updates)):
-                            new_params, stats = fedbuff_combine(
-                                self.params, updates, self.server.published_versions,
-                                version,
-                                staleness_exponent=self.config.staleness_exponent,
-                                server_lr=self.config.async_server_lr,
-                            )
+                        async with self._device_section():
+                            with self._tracer.span("aggregate", aggregation=agg_i,
+                                                   num_clients=len(updates)):
+                                new_params, stats = fedbuff_combine(
+                                    self.params, updates,
+                                    self.server.published_versions, version,
+                                    staleness_exponent=self.config.staleness_exponent,
+                                    server_lr=self.config.async_server_lr,
+                                )
                     except ValueError as e:
                         record = self._async_stale_drain_record(agg_i, version, e)
                     else:

@@ -1,0 +1,227 @@
+"""HTTP transport layer: routing, tenant resolution, and lifecycle.
+
+Split out of ``http_server.py`` (the multi-tenant federation service needs N
+per-tenant sessions behind ONE listener): this module owns everything about
+the wire that is NOT per-tenant state — the aiohttp application, the route
+table, tenant resolution, and the bounded body-read primitive — while
+:class:`~nanofed_tpu.communication.http_server.HTTPServer` keeps exactly the
+per-session state and handlers (round/version buffers, quotas, admission
+counters, secure-aggregation rosters).
+
+Tenant identity travels on the wire two equivalent ways:
+
+* **path prefix** — ``/t/<tenant>/update`` routes to tenant ``<tenant>``'s
+  session; this is what multi-tenant swarm clients use (a base URL of
+  ``http://host:port/t/<tenant>`` makes every existing client tenant-aware
+  without code changes);
+* **header** — ``X-NanoFed-Tenant: <tenant>`` on an unprefixed path routes
+  the same way (reverse proxies that rewrite paths keep working).
+
+An unknown tenant is a **404** (never a 403: tenant names are not secrets,
+and a deleted tenant's stragglers must see a terminal answer, not a retryable
+one).  Everything past resolution — admission 429s, quota state, submit-key
+dedup windows, chaos injection — happens inside the resolved session, so one
+tenant's overload or chaos plan is structurally invisible to every other
+tenant's requests.
+
+A single-tenant ``HTTPServer`` (the pre-service shape every existing test and
+CLI path constructs) owns a private transport and registers itself as the
+DEFAULT session: unprefixed, headerless requests route to it and the wire
+protocol is byte-identical to before the split.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Protocol
+
+from aiohttp import web
+
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = [
+    "HEADER_TENANT",
+    "HTTPTransport",
+    "TENANT_PATH_PREFIX",
+    "free_port",
+    "read_body_bounded",
+    "tenant_base_url",
+]
+
+#: Tenant identity header (the path-prefix form is ``/t/<tenant>/...``).
+HEADER_TENANT = "X-NanoFed-Tenant"
+
+#: Path prefix for tenant-addressed requests: ``/t/<tenant>/<endpoint>``.
+TENANT_PATH_PREFIX = "/t"
+
+MAX_REQUEST_SIZE = 100 * 1024 * 1024  # parity with the pre-split server cap
+
+Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
+
+
+class TransportSession(Protocol):
+    """What the transport needs from a session: logical-path dispatch.
+
+    ``dispatch`` receives the LOGICAL endpoint path (tenant prefix already
+    stripped) and the raw request; the session applies its own chaos
+    schedule, admission control, and handler."""
+
+    async def dispatch(
+        self, path: str, request: web.Request
+    ) -> web.StreamResponse: ...
+
+
+async def read_body_bounded(
+    request: web.Request, timeout_s: float
+) -> bytes:
+    """Read a request body with a TIME bound (``client_max_size`` bounds the
+    size): a slowloris peer trickling bytes must not hold a handler — and its
+    admission slot — open past ``timeout_s``.  Raises
+    ``asyncio.TimeoutError``; the caller owns the 408 answer and its metric
+    (each session counts its own read timeouts)."""
+    return await asyncio.wait_for(request.read(), timeout=timeout_s)
+
+
+def _json_error(message: str, status: int) -> web.Response:
+    return web.json_response({"status": "error", "message": message},
+                             status=status)
+
+
+class HTTPTransport:
+    """One listener multiplexing N tenant sessions (plus an optional default).
+
+    Routing is a catch-all pair — ``/t/{tenant}/{tail}`` and ``/{tail}`` —
+    resolved here and dispatched to the session's logical-path table, so
+    adding a tenant is a dict insert, not a router mutation (aiohttp routers
+    freeze at startup; a live service must admit tenants after ``start``)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        max_request_size: int = MAX_REQUEST_SIZE,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self._log = Logger()
+        self._sessions: dict[str, TransportSession] = {}
+        self._default: TransportSession | None = None
+        self.metrics_registry = registry or get_registry()
+        self._m_unknown_tenant = self.metrics_registry.counter(
+            "nanofed_unknown_tenant_total",
+            "Requests addressed to a tenant this transport does not host (404)",
+        )
+        self._app = web.Application(client_max_size=max_request_size)
+        self._app.router.add_route(
+            "*", TENANT_PATH_PREFIX + "/{tenant}/{tail:.+}",
+            self._dispatch_tenant_path,
+        )
+        self._app.router.add_route("*", "/{tail:.+}", self._dispatch_root_path)
+        self._runner: web.AppRunner | None = None
+
+    @property
+    def app(self) -> web.Application:
+        return self._app
+
+    # -- session registry -------------------------------------------------
+
+    def add_session(
+        self, session: TransportSession, tenant: str | None = None
+    ) -> None:
+        """Mount a session.  ``tenant=None`` mounts it as the DEFAULT (the
+        single-tenant shape: unprefixed, headerless requests); a named tenant
+        answers under ``/t/<tenant>/...`` and the tenant header.  Replacing a
+        live name is refused — a tenant is removed first, never silently
+        swapped under in-flight requests."""
+        if tenant is None:
+            if self._default is not None and self._default is not session:
+                raise ValueError("a default session is already mounted")
+            self._default = session
+            return
+        if not tenant or "/" in tenant:
+            raise ValueError(f"invalid tenant name {tenant!r}")
+        if self._sessions.get(tenant) not in (None, session):
+            raise ValueError(f"tenant {tenant!r} is already mounted")
+        self._sessions[tenant] = session
+
+    def remove_session(self, tenant: str) -> None:
+        """Unmount a tenant; its in-flight handlers finish, later requests
+        404.  Unknown names are a no-op (removal must be idempotent for a
+        supervisor retrying a teardown)."""
+        self._sessions.pop(tenant, None)
+
+    def tenants(self) -> list[str]:
+        return sorted(self._sessions)
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch_tenant_path(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        tenant = request.match_info["tenant"]
+        session = self._sessions.get(tenant)
+        if session is None:
+            self._m_unknown_tenant.inc()
+            return _json_error(f"unknown tenant {tenant!r}", 404)
+        return await session.dispatch(
+            "/" + request.match_info["tail"], request
+        )
+
+    async def _dispatch_root_path(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        tenant = request.headers.get(HEADER_TENANT)
+        if tenant is not None:
+            session = self._sessions.get(tenant)
+            if session is None:
+                self._m_unknown_tenant.inc()
+                return _json_error(f"unknown tenant {tenant!r}", 404)
+        else:
+            session = self._default
+            if session is None:
+                # A tenant-only transport has no anonymous surface: the
+                # caller forgot its tenant identity, say so.
+                self._m_unknown_tenant.inc()
+                return _json_error(
+                    "no default session: address a tenant via "
+                    f"{TENANT_PATH_PREFIX}/<tenant>/... or the "
+                    f"{HEADER_TENANT} header",
+                    404,
+                )
+        return await session.dispatch("/" + request.match_info["tail"], request)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._log.info(
+            "HTTP transport on %s:%d (%d tenant sessions%s)",
+            self.host, self.port, len(self._sessions),
+            ", default mounted" if self._default is not None else "",
+        )
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def tenant_base_url(base: str, tenant: str) -> str:
+    """The tenant-prefixed base URL swarm/HTTP clients point at:
+    ``http://host:port`` + tenant -> ``http://host:port/t/<tenant>``."""
+    return base.rstrip("/") + f"{TENANT_PATH_PREFIX}/{tenant}"
+
+
+def free_port() -> int:
+    """An ephemeral localhost port (in-process harnesses; the canonical copy —
+    loadgen and the federation service both import it)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -18,12 +18,12 @@ from __future__ import annotations
 
 import asyncio
 import json
-import socket
 import time
 from pathlib import Path
 from typing import Any
 
 from nanofed_tpu.communication.http_server import HTTPServer
+from nanofed_tpu.communication.transport import free_port as _free_port
 from nanofed_tpu.communication.network_coordinator import (
     NetworkCoordinator,
     NetworkRoundConfig,
@@ -41,12 +41,6 @@ _LOG = Logger()
 #: the swarm has drained (virtual-clock runs expire their virtual timeouts in
 #: milliseconds of real time, so this is a backstop, not a schedule).
 _COORDINATOR_GRACE_S = 60.0
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _counter_total(snapshot: dict[str, Any], name: str) -> float:

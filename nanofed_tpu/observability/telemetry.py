@@ -184,6 +184,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     topology: dict[str, Any] | None = None
     host_failures: list[dict[str, Any]] = []
     recoveries: list[dict[str, Any]] = []
+    tenants: dict[str, dict[str, Any]] = {}
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -267,6 +268,21 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 })
+            elif rtype == "tenant":
+                # Multi-tenant service layer (nanofed_tpu.service): one
+                # tenant's headline numbers, keyed by tenant name; last
+                # record per tenant wins (a re-run supersedes) — same
+                # policy as loadtest/program_profile.
+                tenants[str(rec.get("tenant", "?"))] = {
+                    k: rec[k]
+                    for k in (
+                        "model", "algorithm", "rounds_completed",
+                        "rounds_failed", "rounds_per_sec", "p99_s",
+                        "http_429_total", "chaos_injected_total",
+                        "failed_submits",
+                    )
+                    if k in rec
+                }
             elif rtype == "loadtest":
                 # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
                 # by serving path; last record per mode wins (a re-run
@@ -313,6 +329,11 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Autotuner layer (nanofed_tpu.tuning): the winner config, scoring
         # basis, and sweep economics per swept configuration.
         out["autotunes"] = dict(sorted(autotunes.items()))
+    if tenants:
+        # Multi-tenant service layer (nanofed_tpu.service): per-tenant
+        # rounds, p99 submit latency, 429s, and chaos hits — the isolation
+        # story in one block.
+        out["tenants"] = dict(sorted(tenants.items()))
     if host_failures:
         # Host fault-tolerance layer (parallel.resilience): every detected
         # host failure, by kind, plus the recovery outcomes with MTTR — a

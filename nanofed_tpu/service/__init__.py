@@ -1,0 +1,69 @@
+"""Multi-tenant federation service: many concurrent jobs, one device pool.
+
+Production traffic is not one federation — it is many concurrent
+model/experiment jobs sharing one accelerator pool.  This package is the
+layer that multiplexes them:
+
+* :class:`~nanofed_tpu.service.tenant.TenantSession` — one tenant's fully
+  isolated state: its own HTTP session (mounted on the shared transport under
+  ``/t/<name>``), round/version buffers, ingest buffer, metrics registry,
+  telemetry stream, program catalog, quota, and chaos schedule.
+* :class:`~nanofed_tpu.service.scheduler.RoundScheduler` — packs the
+  tenants' round programs onto the device pool: HBM bin-packing at admission
+  (compiler peak bytes vs the budget, the autotuner's provenance chain) and
+  start-time-fair-queueing device leases at runtime (measured seconds over
+  fair-share weight — one heavy tenant cannot starve light ones).
+* :class:`~nanofed_tpu.service.service.FederationService` — the composition:
+  one listener, N tenant round engines as asyncio tasks, device steps
+  serialized through the lease while host-side decode/ingest/publish overlap.
+* :func:`~nanofed_tpu.service.harness.run_tenant_service` — the measured
+  experiment: N tenants concurrent vs sequential, per-tenant p99 under a
+  chaos storm targeting one tenant, isolation proof, one ``runs/tenants_*``
+  artifact.
+
+See ``docs/multitenancy.md`` for the tenant model, scheduling policy, and
+isolation semantics.
+"""
+
+from nanofed_tpu.service.scheduler import (
+    AdmissionError,
+    RoundScheduler,
+    TenantFootprint,
+)
+from nanofed_tpu.service.tenant import TenantQuota, TenantSession, TenantSpec
+
+_LAZY_EXPORTS = {
+    # aiohttp-dependent pieces load lazily, matching the communication
+    # package's pattern (the simulator path must import without [net]).
+    "FederationService": "service",
+    "free_port": "service",
+    "default_tenant_specs": "harness",
+    "run_tenant_service": "harness",
+    "tenant_storm_plan": "harness",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"nanofed_tpu.service.{_LAZY_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AdmissionError",
+    "FederationService",
+    "RoundScheduler",
+    "TenantFootprint",
+    "TenantQuota",
+    "TenantSession",
+    "TenantSpec",
+    "default_tenant_specs",
+    "free_port",
+    "run_tenant_service",
+    "tenant_storm_plan",
+]

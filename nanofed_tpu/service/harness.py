@@ -1,0 +1,416 @@
+"""The packaged multi-tenant experiment: N tenants, measured, vs sequential.
+
+``run_tenant_service`` hosts a :class:`~nanofed_tpu.service.FederationService`
+with N tenants (distinct models, algorithms, serving paths), drives one
+synthetic swarm per tenant against its ``/t/<name>`` prefix, and reduces the
+outcome to the numbers the multi-tenant tentpole stands on:
+
+* **aggregate rounds/sec, concurrent vs sequential** — the same jobs run once
+  concurrently (one service, scheduler-interleaved) and once back to back
+  (one tenant at a time); concurrency wins exactly as much host/device
+  overlap as the scheduler actually buys, and the artifact records both.
+* **per-tenant p99 submit latency under chaos** — a seeded wire-fault storm
+  (drops, lost-ACK duplicate retry storms, delays) targets EXACTLY ONE
+  tenant; every
+  tenant's p99 is measured through it.
+* **isolation** — the untargeted tenants must lose ZERO rounds and ZERO
+  submits while the storm runs; the artifact carries the per-tenant proof.
+
+One ``runs/tenants_*.json`` artifact holds all three, plus per-tenant
+``tenant`` telemetry records (what ``nanofed-tpu metrics-summary`` digests
+into its ``tenants`` block).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from pathlib import Path
+from typing import Any
+
+from nanofed_tpu.communication.transport import tenant_base_url
+from nanofed_tpu.faults.plan import FaultEvent, FaultPlan
+from nanofed_tpu.loadgen.swarm import SwarmConfig, latency_digest, run_swarm
+from nanofed_tpu.service.service import FederationService, free_port
+from nanofed_tpu.service.tenant import TenantQuota, TenantSpec
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock, VirtualClock
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = [
+    "default_tenant_specs",
+    "run_tenant_service",
+    "tenant_storm_plan",
+]
+
+_LOG = Logger()
+
+#: Real-time grace for round engines to finish tail aggregations after the
+#: swarms drain (virtual-clock runs expire their virtual timeouts in
+#: milliseconds of real time, so this is a backstop, not a schedule).
+_SERVICE_GRACE_S = 120.0
+
+#: Distinct (model, algorithm, serving-path) combinations the default tenant
+#: roster cycles through — three genuinely different jobs, not three copies.
+_DEFAULT_JOBS: tuple[dict[str, Any], ...] = (
+    {"model": "digits_mlp", "algorithm": "fedbuff", "ingest_capacity": 128},
+    {"model": "mlp", "algorithm": "fedbuff", "ingest_capacity": 0},
+    {"model": "linear", "algorithm": "fedavg", "ingest_capacity": 0},
+)
+
+_NAMES = ("alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+          "hotel")
+
+
+def default_tenant_specs(
+    tenants: int = 3,
+    *,
+    rounds: int = 4,
+    async_buffer_k: int = 16,
+    min_clients: int = 8,
+    round_timeout_s: float = 120.0,
+    max_inflight: int | None = 256,
+    seed: int = 0,
+) -> list[TenantSpec]:
+    """N distinct tenant jobs cycling through the default (model, algorithm,
+    path) roster — tenant 0 is batched-ingest FedBuff on the CNN-sized MLP,
+    tenant 1 per-submit FedBuff on a different MLP, tenant 2 synchronous
+    FedAvg on the linear model."""
+    specs = []
+    for i in range(tenants):
+        job = _DEFAULT_JOBS[i % len(_DEFAULT_JOBS)]
+        name = _NAMES[i] if i < len(_NAMES) else f"tenant{i}"
+        specs.append(TenantSpec(
+            name=name,
+            model=job["model"],
+            algorithm=job["algorithm"],
+            rounds=rounds,
+            async_buffer_k=async_buffer_k,
+            min_clients=min_clients,
+            round_timeout_s=round_timeout_s,
+            seed=seed + i,
+            quota=TenantQuota(
+                max_inflight=max_inflight,
+                ingest_capacity=job["ingest_capacity"],
+            ),
+        ))
+    return specs
+
+
+def tenant_storm_plan(
+    seed: int,
+    num_clients: int,
+    rounds: int,
+    *,
+    drop_fraction: float = 0.15,
+    ack_drop_fraction: float = 0.10,
+    delay_fraction: float = 0.10,
+    delay_s: float = 0.2,
+) -> FaultPlan:
+    """A seeded wire-fault storm against ONE tenant's swarm population.
+
+    Three server-boundary kinds, all consumable by the tenant session's wire
+    middleware: ``drop`` (severed pre-handler — the submit never happened,
+    the client retries), ``ack_drop`` (the update IS buffered, the ACK is
+    severed — the client re-sends the SAME idempotency key, exercising the
+    dedup window as a real duplicate retry storm), and ``delay``.  Unlike
+    :meth:`FaultPlan.generate` (which draws each fault at one seeded round),
+    the storm covers EVERY round/version a sampled client might stamp — an
+    asynchronous tenant's version counter advances with load, so a
+    single-round fault would mostly miss.  Every drawn client meets its fault
+    on whatever round it actually submits; unfired events are simply never
+    consumed."""
+    rng = random.Random(seed)
+    ids = [f"swarm_{i}" for i in range(num_clients)]
+    events: list[FaultEvent] = []
+    # +2: version headers can reach `rounds` (the final publish) and a
+    # straggler's refresh can stamp one past it.
+    span = rounds + 2
+
+    def pick(fraction: float) -> list[str]:
+        k = round(fraction * len(ids))
+        return rng.sample(ids, k) if k else []
+
+    for cid in pick(drop_fraction):
+        for r in range(span):
+            events.append(FaultEvent(kind="drop", round=r, client=cid))
+    for cid in pick(ack_drop_fraction):
+        for r in range(span):
+            events.append(FaultEvent(kind="ack_drop", round=r, client=cid))
+    for cid in pick(delay_fraction):
+        for r in range(span):
+            events.append(FaultEvent(kind="delay", round=r, client=cid,
+                                     seconds=delay_s))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
+async def _drive(
+    specs: list[TenantSpec],
+    *,
+    clock: Clock,
+    swarm_configs: dict[str, SwarmConfig],
+    hbm_budget_bytes: int | None,
+    profile_programs: bool,
+    telemetry_dir: Any | None,
+) -> dict[str, Any]:
+    """One service hosting ``specs`` concurrently + one swarm per tenant;
+    returns tenant summaries, swarm digests, the wall, and scheduler stats."""
+    service = FederationService(
+        port=free_port(),
+        clock=clock,
+        hbm_budget_bytes=hbm_budget_bytes,
+        telemetry_dir=telemetry_dir,
+        profile_programs=profile_programs,
+    )
+    sessions = {spec.name: service.add_tenant(spec) for spec in specs}
+    await service.start()
+    base = f"http://127.0.0.1:{service.transport.port}"
+    try:
+        t0 = time.perf_counter()
+        run_task = asyncio.create_task(service.run())
+        swarm_results = await asyncio.gather(*(
+            run_swarm(
+                tenant_base_url(base, spec.name),
+                sessions[spec.name].params,
+                swarm_configs[spec.name],
+                clock=clock,
+                registry=sessions[spec.name].registry,
+            )
+            for spec in specs
+        ))
+        try:
+            summaries = await asyncio.wait_for(
+                asyncio.shield(run_task), timeout=_SERVICE_GRACE_S
+            )
+        except asyncio.TimeoutError:
+            _LOG.warning(
+                "tenant service still running %.0fs after the swarms "
+                "drained; cancelling (tail rounds dropped)", _SERVICE_GRACE_S,
+            )
+            run_task.cancel()
+            try:
+                await run_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            summaries = {
+                spec.name: sessions[spec.name].summary() for spec in specs
+            }
+        wall = time.perf_counter() - t0
+    finally:
+        await service.stop()
+    swarms = {}
+    for spec, res in zip(specs, swarm_results):
+        swarms[spec.name] = {
+            "submit_latency_s": latency_digest(res.latencies_s),
+            "accepted": res.accepted,
+            "duplicates": res.duplicates,
+            "rejected_429": res.rejected_429,
+            "retries": res.retries,
+            "stale_refreshes": res.stale_refreshes,
+            "failed_submits": res.failed,
+            "terminated_early": res.terminated_early,
+        }
+    return {
+        "tenants": summaries,
+        "swarms": swarms,
+        "wall_s": round(wall, 4),
+        "scheduler": service.scheduler.stats(),
+    }
+
+
+def run_tenant_service(
+    specs: list[TenantSpec] | None = None,
+    *,
+    tenants: int = 3,
+    rounds: int = 4,
+    clients_per_tenant: int = 40,
+    submits_per_client: int = 2,
+    async_buffer_k: int = 16,
+    arrival: str = "poisson",
+    arrival_rate: float = 500.0,
+    chaos_tenant: str | None | bool = True,
+    chaos_seed: int = 7,
+    virtual_clock: bool = True,
+    sequential_baseline: bool = True,
+    hbm_budget_bytes: int | None = None,
+    profile_programs: bool = True,
+    seed: int = 0,
+    out_dir: str | Path | None = "runs",
+    telemetry_dir: str | Path | None = None,
+    tag: str | None = None,
+) -> dict[str, Any]:
+    """Run the full multi-tenant experiment and write ONE artifact.
+
+    ``chaos_tenant=True`` (default) targets the storm at the FIRST tenant;
+    pass a name to aim it, or ``None``/``False`` for a clean run.
+    ``sequential_baseline=True`` re-runs the same jobs one tenant at a time
+    (fresh clock, fresh service each) and records both aggregate rates."""
+    import jax
+
+    if specs is None:
+        specs = default_tenant_specs(
+            tenants, rounds=rounds, async_buffer_k=async_buffer_k,
+            min_clients=min(8, clients_per_tenant), seed=seed,
+        )
+    if chaos_tenant is True:
+        chaos_tenant = specs[0].name
+    elif chaos_tenant is False:
+        chaos_tenant = None
+    if chaos_tenant is not None:
+        names = [s.name for s in specs]
+        if chaos_tenant not in names:
+            raise ValueError(
+                f"chaos_tenant {chaos_tenant!r} is not a tenant ({names})"
+            )
+        specs = [
+            s if s.name != chaos_tenant else _with_chaos(
+                s, tenant_storm_plan(
+                    chaos_seed, clients_per_tenant, s.rounds,
+                )
+            )
+            for s in specs
+        ]
+    swarm_configs = {
+        s.name: SwarmConfig(
+            num_clients=clients_per_tenant,
+            submits_per_client=submits_per_client,
+            arrival=arrival,
+            arrival_rate=arrival_rate,
+            seed=seed + i,
+        )
+        for i, s in enumerate(specs)
+    }
+
+    def _clock() -> Clock:
+        return VirtualClock() if virtual_clock else SYSTEM_CLOCK
+
+    _LOG.info("tenant service: %d tenants concurrent ...", len(specs))
+    concurrent = asyncio.run(_drive(
+        specs, clock=_clock(), swarm_configs=swarm_configs,
+        hbm_budget_bytes=hbm_budget_bytes,
+        profile_programs=profile_programs,
+        telemetry_dir=telemetry_dir,
+    ))
+    sequential: dict[str, Any] | None = None
+    if sequential_baseline:
+        per_tenant: dict[str, Any] = {}
+        seq_wall = 0.0
+        seq_completed = 0
+        for spec in specs:
+            _LOG.info("tenant service: sequential baseline %s ...", spec.name)
+            one = asyncio.run(_drive(
+                [spec], clock=_clock(),
+                swarm_configs={spec.name: swarm_configs[spec.name]},
+                hbm_budget_bytes=hbm_budget_bytes,
+                profile_programs=profile_programs,
+                telemetry_dir=None,
+            ))
+            per_tenant[spec.name] = {
+                "wall_s": one["wall_s"],
+                "rounds_completed":
+                    one["tenants"][spec.name]["rounds_completed"],
+            }
+            seq_wall += one["wall_s"]
+            seq_completed += one["tenants"][spec.name]["rounds_completed"]
+        sequential = {
+            "wall_s": round(seq_wall, 4),
+            "rounds_completed": seq_completed,
+            "aggregate_rounds_per_sec": (
+                round(seq_completed / seq_wall, 4) if seq_wall > 0 else None
+            ),
+            "per_tenant": per_tenant,
+        }
+    conc_completed = sum(
+        t["rounds_completed"] for t in concurrent["tenants"].values()
+    )
+    conc_rps = (
+        round(conc_completed / concurrent["wall_s"], 4)
+        if concurrent["wall_s"] > 0 else None
+    )
+    untargeted = [s.name for s in specs if s.name != chaos_tenant]
+    isolation = {
+        name: {
+            "rounds_lost": (
+                concurrent["tenants"][name]["rounds_target"]
+                - concurrent["tenants"][name]["rounds_completed"]
+            ),
+            "failed_submits": concurrent["swarms"][name]["failed_submits"],
+        }
+        for name in untargeted
+    }
+    artifact: dict[str, Any] = {
+        "record_type": "tenants",
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "env": {
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+        },
+        "clock": "virtual" if virtual_clock else "system",
+        "clients_per_tenant": clients_per_tenant,
+        "submits_per_client": submits_per_client,
+        "chaos_tenant": chaos_tenant,
+        "tenants": {
+            name: {**summary, **concurrent["swarms"][name]}
+            for name, summary in concurrent["tenants"].items()
+        },
+        "scheduler": concurrent["scheduler"],
+        "concurrent": {
+            "wall_s": concurrent["wall_s"],
+            "rounds_completed": conc_completed,
+            "aggregate_rounds_per_sec": conc_rps,
+        },
+        "isolation": {
+            "untargeted": isolation,
+            "zero_rounds_lost": all(
+                v["rounds_lost"] == 0 for v in isolation.values()
+            ),
+            "zero_failed_submits": all(
+                v["failed_submits"] == 0 for v in isolation.values()
+            ),
+        },
+    }
+    if sequential is not None:
+        artifact["sequential"] = sequential
+        if conc_rps and sequential["aggregate_rounds_per_sec"]:
+            artifact["concurrent_over_sequential"] = round(
+                conc_rps / sequential["aggregate_rounds_per_sec"], 4
+            )
+    if out_dir is not None:
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        stamp = tag or time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        path = out / f"tenants_{stamp}.json"
+        path.write_text(json.dumps(artifact, indent=2) + "\n")
+        artifact["artifact_path"] = str(path)
+        _LOG.info("tenants artifact: %s", path)
+    if telemetry_dir is not None:
+        from nanofed_tpu.observability.telemetry import RunTelemetry
+
+        tel = RunTelemetry(telemetry_dir)
+        try:
+            for name, rec in artifact["tenants"].items():
+                lat = rec["submit_latency_s"]
+                tel.record(
+                    "tenant",
+                    tenant=name,
+                    model=rec["model"],
+                    algorithm=rec["algorithm"],
+                    rounds_completed=rec["rounds_completed"],
+                    rounds_failed=rec["rounds_failed"],
+                    rounds_per_sec=rec["rounds_per_sec"],
+                    p99_s=lat["p99_s"],
+                    http_429_total=rec["http_429_total"],
+                    chaos_injected_total=rec["chaos_injected_total"],
+                    failed_submits=rec["failed_submits"],
+                )
+        finally:
+            tel.close()
+    return artifact
+
+
+def _with_chaos(spec: TenantSpec, plan: FaultPlan) -> TenantSpec:
+    from dataclasses import replace
+
+    return replace(spec, chaos_plan=plan)

@@ -1,0 +1,342 @@
+"""Weighted-fair device scheduling + HBM bin-packing for N tenants on one pool.
+
+Two decisions make a multi-tenant federation service more than N processes
+behind one port, and this module owns both:
+
+* **Admission (space):** can this tenant's working set coexist with the
+  already-admitted tenants on the device pool at all?  Device memory is the
+  non-statistical resource — time overcommits gracefully, HBM does not.  The
+  feasibility rule is a bin-pack against the per-device budget: the sum of
+  every admitted tenant's RESIDENT bytes (params, published copies, ingest
+  buffer — state that lives on device BETWEEN rounds) plus the LARGEST single
+  tenant's transient program peak (device steps are serialized by the lease
+  below, so at most one tenant's temporaries exist at a time) must fit the
+  budget.  Peaks come from the compiler (``ProgramCostReport.peak_bytes``,
+  the same ``memory_analysis`` the autotuner rejects candidates with) when
+  the tenant's aggregation program has been profiled, else from an analytic
+  bound — either way the basis is recorded, never fabricated.  The budget
+  resolves through the autotuner's provenance chain
+  (:func:`~nanofed_tpu.tuning.autotuner.resolve_hbm_budget`): explicit >
+  env > runtime ``bytes_limit`` > published HBM table > honestly unbounded.
+
+* **Ordering (time):** which ready tenant's round program runs next?
+  Start-time fair queueing over VIRTUAL time: each tenant carries a virtual
+  ``pass``; a lease request enqueues at the tenant's current pass, the lowest
+  pass is granted when the device frees, and a released lease charges
+  ``measured_duration / weight`` to the tenant's pass.  A heavy tenant
+  (expensive program, high cadence) therefore accumulates pass quickly and
+  yields the device to light tenants between its steps — one 10x-heavier
+  job cannot starve nine light ones, it just runs ~1/10th as often per unit
+  of its demand.  An idle tenant's pass is clamped UP to the global virtual
+  time when it returns, so sleeping never banks credit (the classic SFQ
+  start-time rule).  Charges are MEASURED device-section seconds — the cost
+  model seeds expectations and feasibility, the realized walltime settles the
+  bill (the autotuner's ``tie_break`` lesson: the AOT model cannot see the
+  host tax).
+
+Single-event-loop use only (like everything in ``communication``): no
+internal locking — every mutation happens on the service's event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+
+__all__ = [
+    "AdmissionError",
+    "RoundScheduler",
+    "TenantFootprint",
+]
+
+
+class AdmissionError(ValueError):
+    """A tenant whose footprint cannot be packed onto the device pool."""
+
+
+@dataclass(frozen=True)
+class TenantFootprint:
+    """One tenant's device-memory shape, with the basis of each number.
+
+    ``resident_bytes`` lives on the device BETWEEN rounds (params, the
+    published copy, the preallocated ingest buffer) and therefore SUMS across
+    tenants; ``peak_extra_bytes`` exists only WHILE the tenant's aggregation
+    program runs (stacked updates, temporaries) and — because the lease
+    serializes device steps — only the maximum across tenants counts."""
+
+    resident_bytes: int
+    peak_extra_bytes: int
+    basis: str = "analytic"
+
+    def __post_init__(self) -> None:
+        if self.resident_bytes < 0 or self.peak_extra_bytes < 0:
+            raise ValueError("footprint bytes must be >= 0")
+
+
+class _Lease:
+    """One granted device section: async context manager measuring its own
+    duration and settling the tenant's virtual-time bill on exit."""
+
+    def __init__(self, scheduler: "RoundScheduler", tenant: str) -> None:
+        self._scheduler = scheduler
+        self._tenant = tenant
+        self._t0 = 0.0
+
+    async def __aenter__(self) -> "_Lease":
+        await self._scheduler._acquire(self._tenant)
+        self._t0 = time.perf_counter()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        self._scheduler._release(
+            self._tenant, time.perf_counter() - self._t0
+        )
+
+
+class RoundScheduler:
+    """Packs N tenants' round programs onto one device pool (see module doc).
+
+    ``admit`` is the space decision (raises :class:`AdmissionError` with both
+    sides of the inequality), ``lease`` the time decision (an async context
+    manager the tenants' round engines bracket their device sections with —
+    wired in as ``NetworkCoordinator(device_gate=...)``)."""
+
+    def __init__(
+        self,
+        hbm_budget_bytes: int | None = None,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        from nanofed_tpu.tuning.autotuner import resolve_hbm_budget
+
+        self.hbm_budget_bytes, self.hbm_budget_basis = resolve_hbm_budget(
+            hbm_budget_bytes
+        )
+        self._weights: dict[str, float] = {}
+        self._footprints: dict[str, TenantFootprint] = {}
+        self._cost_hints: dict[str, float | None] = {}
+        self._pass: dict[str, float] = {}
+        self._vt = 0.0  # global virtual time: pass of the last granted tenant
+        self._busy: str | None = None  # tenant holding the device, if any
+        self._seq = 0
+        # (pass-at-enqueue, seq, tenant, wake future)
+        self._waiters: list[tuple[float, int, str, Any]] = []
+        self._leases: dict[str, int] = {}
+        self._device_seconds: dict[str, float] = {}
+        self._wait_seconds: dict[str, float] = {}
+        self._enqueued_at: dict[int, float] = {}
+        self.metrics_registry = registry or get_registry()
+        self._m_leases = self.metrics_registry.counter(
+            "nanofed_sched_leases_total",
+            "Device leases granted by the round scheduler, by tenant",
+            labels=("tenant",),
+        )
+        self._m_device_seconds = self.metrics_registry.counter(
+            "nanofed_sched_device_seconds_total",
+            "Measured device-section seconds charged to each tenant",
+            labels=("tenant",),
+        )
+        self._m_wait = self.metrics_registry.histogram(
+            "nanofed_sched_wait_seconds",
+            "Time a ready tenant waited for the device lease",
+            labels=("tenant",),
+        )
+        self._m_queue = self.metrics_registry.gauge(
+            "nanofed_sched_queue_depth",
+            "Tenants currently waiting for the device lease",
+        )
+        self._m_rejects = self.metrics_registry.counter(
+            "nanofed_sched_admission_rejects_total",
+            "Tenants refused admission by the HBM bin-pack check",
+        )
+        self._m_resident = self.metrics_registry.gauge(
+            "nanofed_tenant_resident_bytes",
+            "Admitted device-resident bytes per tenant",
+            labels=("tenant",),
+        )
+
+    # -- admission (space) -------------------------------------------------
+
+    def admit(
+        self,
+        tenant: str,
+        footprint: TenantFootprint,
+        weight: float = 1.0,
+        cost_hint_s: float | None = None,
+    ) -> None:
+        """Admit a tenant, or raise :class:`AdmissionError` with the packing
+        math.  ``weight`` is the fair-share weight (2.0 = entitled to twice
+        the device time of a weight-1 tenant under contention);
+        ``cost_hint_s`` is the cost model's expected device-section walltime
+        (roofline lower bound), recorded for the stats surface — realized
+        charges always use measured durations."""
+        if weight <= 0:
+            raise ValueError("weight must be > 0")
+        if tenant in self._footprints:
+            raise AdmissionError(f"tenant {tenant!r} is already admitted")
+        if self.hbm_budget_bytes is not None:
+            resident = footprint.resident_bytes + sum(
+                f.resident_bytes for f in self._footprints.values()
+            )
+            peak = max(
+                [footprint.peak_extra_bytes]
+                + [f.peak_extra_bytes for f in self._footprints.values()]
+            )
+            if resident + peak > self.hbm_budget_bytes:
+                self._m_rejects.inc()
+                raise AdmissionError(
+                    f"tenant {tenant!r} does not fit the device pool: "
+                    f"resident {resident:,} B (all tenants incl. this one) + "
+                    f"max program peak {peak:,} B = {resident + peak:,} B > "
+                    f"budget {self.hbm_budget_bytes:,} B "
+                    f"({self.hbm_budget_basis}); footprint basis: "
+                    f"{footprint.basis}"
+                )
+        self._footprints[tenant] = footprint
+        self._weights[tenant] = float(weight)
+        self._cost_hints[tenant] = cost_hint_s
+        # Join at the current virtual time: no credit for not existing yet.
+        self._pass[tenant] = self._vt
+        self._m_resident.set(footprint.resident_bytes, tenant=tenant)
+
+    def remove(self, tenant: str) -> None:
+        """Release a tenant's reservation (idempotent).  A lease it HOLDS
+        finishes normally; a lease request still QUEUED fails with a typed
+        RuntimeError at grant time (the waiter must not hang forever on a
+        reservation that no longer exists), and the device moves on to the
+        next waiter."""
+        self._footprints.pop(tenant, None)
+        self._weights.pop(tenant, None)
+        self._cost_hints.pop(tenant, None)
+        self._pass.pop(tenant, None)
+        # Accounting goes too: a re-admitted name is a NEW job (its stats
+        # must not inherit a dead incarnation's totals), and a service that
+        # churns tenant names must not grow these dicts without bound.
+        self._leases.pop(tenant, None)
+        self._device_seconds.pop(tenant, None)
+        self._wait_seconds.pop(tenant, None)
+        self._m_resident.set(0, tenant=tenant)
+
+    def admitted(self) -> list[str]:
+        return sorted(self._footprints)
+
+    # -- the lease (time) --------------------------------------------------
+
+    def lease(self, tenant: str) -> _Lease:
+        """The device-section context manager for ``tenant`` — pass
+        ``lambda: scheduler.lease(name)`` as a coordinator's
+        ``device_gate``."""
+        return _Lease(self, tenant)
+
+    async def _acquire(self, tenant: str) -> None:
+        if tenant not in self._weights:
+            raise RuntimeError(
+                f"tenant {tenant!r} requested the device without admission"
+            )
+        # SFQ start-time rule: an idle tenant re-enters at the global virtual
+        # time, so idling never banks priority.
+        self._pass[tenant] = max(self._pass[tenant], self._vt)
+        if self._busy is None and not self._waiters:
+            self._grant(tenant)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        seq = self._seq
+        heapq.heappush(
+            self._waiters, (self._pass[tenant], seq, tenant, fut)
+        )
+        self._enqueued_at[seq] = time.perf_counter()
+        self._m_queue.set(len(self._waiters))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # Lost-wakeup guard (the asyncio.Lock pattern): if the grant
+            # already landed on this future before the cancellation was
+            # delivered, the device is marked busy for a task that will
+            # never run its section — hand the lease to the next waiter,
+            # then let the cancellation propagate.
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                self._busy = None
+                self._grant_next()
+            raise
+
+    def _grant(self, tenant: str) -> None:
+        self._busy = tenant
+        # .get: the tenant may have been remove()d while queued — _grant is
+        # only reached for such a waiter via the typed-refusal path below,
+        # but the grant bookkeeping must never KeyError mid-release.
+        self._vt = max(self._vt, self._pass.get(tenant, self._vt))
+        self._leases[tenant] = self._leases.get(tenant, 0) + 1
+        self._m_leases.inc(tenant=tenant)
+
+    def _release(self, tenant: str, duration_s: float) -> None:
+        # The realized bill: measured seconds over the fair-share weight.
+        charge = max(0.0, duration_s) / self._weights.get(tenant, 1.0)
+        if tenant in self._pass:
+            self._pass[tenant] += charge
+        if tenant in self._footprints:
+            # A tenant remove()d while holding the lease must not be
+            # re-inserted into the accounting dicts its removal just cleared
+            # (the no-unbounded-growth guarantee under name churn).
+            self._device_seconds[tenant] = (
+                self._device_seconds.get(tenant, 0.0) + max(0.0, duration_s)
+            )
+        self._m_device_seconds.inc(max(0.0, duration_s), tenant=tenant)
+        self._busy = None
+        self._grant_next()
+
+    def _grant_next(self) -> None:
+        """Hand the free device to the lowest-pass live waiter.  Waiters
+        whose tenant was ``remove()``d while queued fail with a typed error
+        (never a silent hang) and the scan continues."""
+        while self._waiters:
+            _, seq, waiter, fut = heapq.heappop(self._waiters)
+            self._m_queue.set(len(self._waiters))
+            if fut.done():
+                self._enqueued_at.pop(seq, None)
+                continue
+            if waiter not in self._weights:
+                self._enqueued_at.pop(seq, None)
+                fut.set_exception(RuntimeError(
+                    f"tenant {waiter!r} was removed while waiting for the "
+                    "device lease"
+                ))
+                continue
+            waited = time.perf_counter() - self._enqueued_at.pop(
+                seq, time.perf_counter()
+            )
+            self._wait_seconds[waiter] = (
+                self._wait_seconds.get(waiter, 0.0) + waited
+            )
+            self._m_wait.observe(waited, tenant=waiter)
+            self._grant(waiter)
+            fut.set_result(None)
+            return
+
+    # -- reporting ---------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """The artifact-facing view: per-tenant leases, device/wait seconds,
+        virtual passes, and the packing state with its basis."""
+        return {
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "hbm_budget_basis": self.hbm_budget_basis,
+            "tenants": {
+                t: {
+                    "weight": self._weights[t],
+                    "resident_bytes": self._footprints[t].resident_bytes,
+                    "peak_extra_bytes": self._footprints[t].peak_extra_bytes,
+                    "footprint_basis": self._footprints[t].basis,
+                    "cost_hint_s": self._cost_hints.get(t),
+                    "leases": self._leases.get(t, 0),
+                    "device_seconds": round(self._device_seconds.get(t, 0.0), 6),
+                    "wait_seconds": round(self._wait_seconds.get(t, 0.0), 6),
+                    "virtual_pass": round(self._pass.get(t, 0.0), 6),
+                }
+                for t in sorted(self._footprints)
+            },
+        }

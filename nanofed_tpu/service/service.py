@@ -1,0 +1,185 @@
+"""The federation service: N concurrent tenants over one device pool.
+
+:class:`FederationService` composes the pieces the rest of the repo already
+built — the shared :class:`~nanofed_tpu.communication.transport.HTTPTransport`
+(one listener, tenant resolution), per-tenant
+:class:`~nanofed_tpu.service.tenant.TenantSession` state, and the
+:class:`~nanofed_tpu.service.scheduler.RoundScheduler` (HBM bin-pack
+admission + weighted-fair device leases) — into one process serving many
+concurrent federation jobs.  Execution model: every tenant's round engine
+runs as its own asyncio task; DEVICE steps serialize through the scheduler's
+lease in weighted-fair order, while each tenant's host-side work — polling
+its round barrier, decoding submits on its bounded pool, publishing models —
+overlaps the other tenants' device time.
+
+Observability: each tenant's instruments live in its OWN registry (scraped
+at ``GET /t/<tenant>/metrics``); the service mirrors headline per-tenant
+numbers into ``tenant``-labeled gauges on the SERVICE registry after each
+tenant finishes, so one scrape ranks tenants without ever sharing a counter
+between them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from nanofed_tpu.communication.transport import HTTPTransport, free_port
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.service.scheduler import RoundScheduler
+from nanofed_tpu.service.tenant import TenantSession, TenantSpec
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = ["FederationService", "free_port"]
+
+
+class FederationService:
+    """One listener, one device pool, N tenants (see module docstring)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        clock: Clock | None = None,
+        registry: MetricsRegistry | None = None,
+        hbm_budget_bytes: int | None = None,
+        telemetry_dir: Any | None = None,
+        profile_programs: bool = True,
+    ) -> None:
+        """``registry`` is the SERVICE-level registry (scheduler metrics,
+        unknown-tenant 404s, per-tenant mirror gauges); defaults to a private
+        one so concurrent services in one process (tests) stay independent.
+        ``profile_programs`` compiles each tenant's aggregation program at
+        admission so the bin-pack uses the compiler's peak bytes — one small
+        AOT compile per tenant; disable for compile-free construction (the
+        analytic footprint bound applies instead)."""
+        self.clock = clock or SYSTEM_CLOCK
+        self.registry = registry or MetricsRegistry()
+        self.transport = HTTPTransport(
+            host=host, port=port, registry=self.registry
+        )
+        self.scheduler = RoundScheduler(
+            hbm_budget_bytes=hbm_budget_bytes, registry=self.registry
+        )
+        self.telemetry_dir = telemetry_dir
+        self.profile_programs = profile_programs
+        self._tenants: dict[str, TenantSession] = {}
+        self._log = Logger()
+        self._m_tenants = self.registry.gauge(
+            "nanofed_service_tenants", "Tenant sessions currently mounted"
+        )
+        self._m_rounds = self.registry.gauge(
+            "nanofed_tenant_rounds_completed",
+            "Rounds/aggregations completed per tenant (mirrored from the "
+            "tenant registry at summary time)",
+            labels=("tenant",),
+        )
+        self._m_429 = self.registry.gauge(
+            "nanofed_tenant_http_429",
+            "Admission-control 429s per tenant (mirrored)",
+            labels=("tenant",),
+        )
+        self._m_chaos = self.registry.gauge(
+            "nanofed_tenant_chaos_injected",
+            "Chaos faults injected against each tenant (mirrored)",
+            labels=("tenant",),
+        )
+
+    # -- tenant lifecycle --------------------------------------------------
+
+    def add_tenant(self, spec: TenantSpec) -> TenantSession:
+        """Admit and mount one tenant.  Raises
+        :class:`~nanofed_tpu.service.scheduler.AdmissionError` when the
+        tenant's footprint cannot be packed onto the device pool (nothing is
+        mounted in that case), ``ValueError`` on a duplicate name."""
+        if spec.name in self._tenants:
+            raise ValueError(f"tenant {spec.name!r} already exists")
+        session = None
+        try:
+            # Construction mounts the HTTP session on the shared transport,
+            # so ANY failure past that point — a bad round config as much as
+            # an admission refusal — must unmount it, or the name stays
+            # occupied by a half-configured session serving live traffic.
+            session = TenantSession(
+                spec,
+                transport=self.transport,
+                scheduler=self.scheduler,
+                clock=self.clock,
+                telemetry_dir=self.telemetry_dir,
+                profile_programs=self.profile_programs,
+            )
+            self.scheduler.admit(
+                spec.name,
+                session.footprint(),
+                weight=spec.quota.weight,
+                cost_hint_s=session.cost_hint_s(),
+            )
+        except Exception:
+            self.transport.remove_session(spec.name)
+            if session is not None:
+                session.close()
+            raise
+        self._tenants[spec.name] = session
+        self._m_tenants.set(len(self._tenants))
+        self._log.info(
+            "tenant %s admitted: model=%s algorithm=%s rounds=%d weight=%g",
+            spec.name, spec.model, spec.algorithm, spec.rounds,
+            spec.quota.weight,
+        )
+        return session
+
+    def remove_tenant(self, name: str) -> None:
+        """Unmount a tenant: later requests 404, its scheduler reservation is
+        released, its decode pool closes.  Idempotent."""
+        session = self._tenants.pop(name, None)
+        self.transport.remove_session(name)
+        self.scheduler.remove(name)
+        if session is not None:
+            session.close()
+        self._m_tenants.set(len(self._tenants))
+
+    def tenant(self, name: str) -> TenantSession:
+        return self._tenants[name]
+
+    def tenants(self) -> list[str]:
+        return sorted(self._tenants)
+
+    # -- lifecycle / execution ---------------------------------------------
+
+    async def start(self) -> None:
+        await self.transport.start()
+
+    async def stop(self) -> None:
+        for session in self._tenants.values():
+            session.close()
+        await self.transport.stop()
+
+    async def run(self) -> dict[str, dict[str, Any]]:
+        """Run every mounted tenant's rounds CONCURRENTLY to completion;
+        returns ``{tenant: summary}``.  One tenant's round-loop crash is its
+        own summary's ``error`` — never another tenant's problem (the other
+        tasks keep running to completion)."""
+        names = self.tenants()
+        results = await asyncio.gather(
+            *(self._tenants[n].run() for n in names), return_exceptions=True
+        )
+        summaries: dict[str, dict[str, Any]] = {}
+        for name, result in zip(names, results):
+            if isinstance(result, BaseException):
+                summary = self._tenants[name].summary()
+                summary["error"] = repr(result)
+                summaries[name] = summary
+            else:
+                summaries[name] = result
+            self._mirror(name, summaries[name])
+        return summaries
+
+    def _mirror(self, name: str, summary: dict[str, Any]) -> None:
+        """Mirror one tenant's headline numbers into the service registry's
+        ``tenant``-labeled gauges (the cross-tenant ranking surface)."""
+        self._m_rounds.set(summary.get("rounds_completed", 0), tenant=name)
+        self._m_429.set(summary.get("http_429_total", 0), tenant=name)
+        self._m_chaos.set(
+            summary.get("chaos_injected_total", 0), tenant=name
+        )

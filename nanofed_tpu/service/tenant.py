@@ -1,0 +1,324 @@
+"""Per-tenant session state: one federation job, fully isolated.
+
+A :class:`TenantSession` is everything ONE tenant's federation consists of,
+carved out of the former single-tenant monolith: its own
+:class:`~nanofed_tpu.communication.http_server.HTTPServer` session (mounted
+on the service's shared transport under ``/t/<name>``), its own
+``NetworkCoordinator`` round/version state, its own
+:class:`~nanofed_tpu.observability.registry.MetricsRegistry` (isolation by
+construction: there is no shared counter another tenant could pollute — the
+service mirrors headline numbers into ``tenant``-labeled service metrics),
+its own :class:`~nanofed_tpu.observability.profiling.ProgramCatalog` holding
+its aggregation program's cost report, its own ingest buffer and admission
+quota, and its own chaos schedule.  The isolation claims the service makes —
+a 429 storm, submit-key dedup window, retry storm, or chaos plan aimed at
+tenant A cannot touch tenant B — are structural consequences of this layout,
+not filtering logic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.service.scheduler import TenantFootprint
+from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from nanofed_tpu.utils.logger import Logger
+
+__all__ = ["TenantQuota", "TenantSpec", "TenantSession"]
+
+_LOG = Logger()
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's resource envelope.
+
+    ``weight`` is the fair-share weight in the round scheduler (2.0 = twice
+    the device time of a weight-1 tenant under contention).  ``max_inflight``
+    is the admission-control bound — submits past it answer 429 FROM THIS
+    TENANT'S SESSION ONLY (the other tenants' counters never move).
+    ``ingest_capacity`` > 0 switches the tenant to the batched device-resident
+    ingest path with that many preallocated slots (its device bytes count
+    toward the tenant's resident footprint in the bin-pack)."""
+
+    weight: float = 1.0
+    max_inflight: int | None = 256
+    ingest_capacity: int = 0
+    ingest_batch: int = 32
+    decode_workers: int = 2
+    read_timeout_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.weight <= 0:
+            raise ValueError("weight must be > 0")
+        if self.ingest_capacity < 0:
+            raise ValueError("ingest_capacity must be >= 0")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's job: model, algorithm, cadence, quota, chaos.
+
+    ``algorithm`` is ``"fedbuff"`` (asynchronous buffered aggregation — the
+    load-shaped protocol, aggregations fire on buffer fill) or ``"fedavg"``
+    (synchronous cohort rounds).  ``rounds`` counts aggregations in fedbuff
+    mode and cohort rounds in fedavg mode.  ``chaos_plan`` (a
+    ``faults.FaultPlan``) scopes ENTIRELY to this tenant: its schedule is
+    instantiated against this tenant's session and counted in this tenant's
+    registry."""
+
+    name: str
+    model: str = "digits_mlp"
+    algorithm: str = "fedbuff"
+    rounds: int = 4
+    async_buffer_k: int = 16
+    min_clients: int = 1
+    completion_rate: float = 1.0
+    staleness_window: int = 4
+    round_timeout_s: float = 120.0
+    poll_interval_s: float = 0.01
+    seed: int = 0
+    quota: TenantQuota = field(default_factory=TenantQuota)
+    chaos_plan: Any | None = None
+
+    def __post_init__(self) -> None:
+        if not self.name or "/" in self.name:
+            raise ValueError(f"invalid tenant name {self.name!r}")
+        if self.algorithm not in ("fedavg", "fedbuff"):
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r} (fedavg | fedbuff)"
+            )
+        if self.rounds < 1:
+            raise ValueError("rounds must be >= 1")
+
+
+def _flat_param_count(params: Any) -> int:
+    from nanofed_tpu.utils.trees import tree_size
+
+    return int(tree_size(params))
+
+
+class TenantSession:
+    """One tenant's live state on the service (see module docstring).
+
+    Constructed by ``FederationService.add_tenant``; everything here is
+    per-tenant — registry, server session, coordinator, catalog, chaos."""
+
+    def __init__(
+        self,
+        spec: TenantSpec,
+        transport: Any,
+        scheduler: Any,
+        clock: Clock | None = None,
+        telemetry_dir: Any | None = None,
+        profile_programs: bool = True,
+    ) -> None:
+        import jax
+
+        from nanofed_tpu.communication.http_server import HTTPServer
+        from nanofed_tpu.communication.network_coordinator import (
+            NetworkCoordinator,
+            NetworkRoundConfig,
+        )
+        from nanofed_tpu.models import get_model
+        from nanofed_tpu.observability.profiling import ProgramCatalog
+
+        self.spec = spec
+        self.clock = clock or SYSTEM_CLOCK
+        # ISOLATION BY CONSTRUCTION: every instrument this tenant's server,
+        # coordinator, chaos schedule, and swarm write lives in a registry no
+        # other tenant holds a reference to.
+        self.registry = MetricsRegistry()
+        self.params = get_model(spec.model).init(jax.random.key(spec.seed))
+        self.param_count = _flat_param_count(self.params)
+        chaos = None
+        if spec.chaos_plan is not None:
+            from nanofed_tpu.faults import ChaosSchedule
+
+            chaos = ChaosSchedule(spec.chaos_plan, registry=self.registry)
+        self.chaos = chaos
+        ingest = None
+        if spec.quota.ingest_capacity > 0:
+            from nanofed_tpu.ingest import IngestConfig
+
+            ingest = IngestConfig(
+                capacity=spec.quota.ingest_capacity,
+                batch_size=min(spec.quota.ingest_batch,
+                               spec.quota.ingest_capacity),
+                decode_workers=spec.quota.decode_workers,
+            )
+        asynchronous = spec.algorithm == "fedbuff"
+        self.server = HTTPServer(
+            transport=transport,
+            tenant=spec.name,
+            registry=self.registry,
+            max_inflight=spec.quota.max_inflight,
+            read_timeout_s=spec.quota.read_timeout_s,
+            staleness_window=spec.staleness_window if asynchronous else 0,
+            chaos=chaos,
+            clock=self.clock,
+            ingest=ingest,
+        )
+        config = NetworkRoundConfig(
+            num_rounds=spec.rounds,
+            min_clients=spec.min_clients,
+            min_completion_rate=spec.completion_rate,
+            round_timeout_s=spec.round_timeout_s,
+            poll_interval_s=spec.poll_interval_s,
+            async_buffer_k=spec.async_buffer_k if asynchronous else None,
+            staleness_window=spec.staleness_window,
+        )
+        self.coordinator = NetworkCoordinator(
+            self.server,
+            self.params,
+            config,
+            registry=self.registry,
+            clock=self.clock,
+            telemetry_dir=(
+                None if telemetry_dir is None
+                else str(telemetry_dir) + f"/{spec.name}"
+            ),
+            device_gate=lambda: scheduler.lease(spec.name),
+        )
+        # Per-tenant ProgramCatalog: the tenant's batched aggregation program
+        # ([K, P] stack -> base + coefs @ stack, the same shape the ingest
+        # drain reduce compiles) registered with lazy ShapeDtypeStruct args —
+        # profiling it gives the scheduler the COMPILER's peak bytes and
+        # roofline walltime for this tenant instead of an analytic guess.
+        self.catalog = ProgramCatalog(registry=self.registry)
+        k = spec.async_buffer_k if asynchronous else max(1, spec.min_clients)
+        self._agg_k = int(k)
+        self._register_aggregate_program()
+        self.cost_report = None
+        if profile_programs:
+            try:
+                self.cost_report = self.catalog.profile(
+                    f"tenant_aggregate[{spec.name}]"
+                )
+            except Exception as e:  # pragma: no cover - degraded, not fatal
+                _LOG.warning(
+                    "tenant %s: aggregation-program profile failed (%s); "
+                    "falling back to the analytic footprint", spec.name, e,
+                )
+        self.history: list[dict[str, Any]] = []
+        self.wall_s = 0.0
+
+    # -- cost model --------------------------------------------------------
+
+    def _register_aggregate_program(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        p, k = self.param_count, self._agg_k
+
+        # fedlint: disable=FED004 (cost-model program, lowered but never executed; the [K,P] stack models the RESIDENT ingest buffer, which survives the reduce by design — donating it would understate the real peak)
+        @jax.jit
+        def _aggregate(base_flat, stack, coefs):
+            return base_flat + coefs @ stack
+
+        def _args() -> tuple[tuple, dict]:
+            f32 = jnp.float32
+            return (
+                (
+                    jax.ShapeDtypeStruct((p,), f32),
+                    jax.ShapeDtypeStruct((k, p), f32),
+                    jax.ShapeDtypeStruct((k,), f32),
+                ),
+                {},
+            )
+
+        self.catalog.register(
+            f"tenant_aggregate[{self.spec.name}]",
+            _aggregate,
+            args_factory=_args,
+            attrs={"tenant": self.spec.name, "model": self.spec.model,
+                   "k": k, "params": p},
+        )
+
+    def footprint(self) -> TenantFootprint:
+        """This tenant's device-memory shape for the scheduler's bin-pack.
+
+        Resident: current + published params (float32) plus the preallocated
+        ingest buffer.  Peak-extra: the compiler's ``peak_bytes`` for the
+        aggregation program when profiled, else the analytic stack bound
+        ``(K + 2) * P * 4`` (the [K, P] update stack plus base and output)."""
+        param_bytes = self.param_count * 4
+        resident = 2 * param_bytes
+        if self.spec.quota.ingest_capacity > 0:
+            resident += self.spec.quota.ingest_capacity * self.param_count * 4
+        if self.cost_report is not None:
+            return TenantFootprint(
+                resident_bytes=resident,
+                peak_extra_bytes=int(self.cost_report.peak_bytes),
+                basis=("resident analytic (2x params + ingest buffer); peak "
+                       "from compiled memory_analysis"),
+            )
+        return TenantFootprint(
+            resident_bytes=resident,
+            peak_extra_bytes=(self._agg_k + 2) * param_bytes,
+            basis="analytic: 2x params + ingest buffer; peak (K+2)*P*4",
+        )
+
+    def cost_hint_s(self) -> float | None:
+        """The cost model's expected device-section walltime: the roofline
+        lower bound when a peaks basis exists (TPU), else None — the
+        scheduler charges measured durations either way."""
+        if self.cost_report is None:
+            return None
+        return self.cost_report.lower_bound_s
+
+    # -- run ---------------------------------------------------------------
+
+    async def run(self) -> dict[str, Any]:
+        """Drive this tenant's rounds to completion; returns the tenant
+        summary (rounds, outcome counts, walltime, headline counters)."""
+        t0 = time.perf_counter()
+        try:
+            self.history = await self.coordinator.run()
+        finally:
+            self.wall_s = time.perf_counter() - t0
+        return self.summary()
+
+    def summary(self) -> dict[str, Any]:
+        completed = sum(
+            1 for h in self.history if h.get("status") == "COMPLETED"
+        )
+        failed = len(self.history) - completed
+        snapshot = self.registry.snapshot()
+
+        def _total(name: str) -> float:
+            values = snapshot.get(name, {}).get("values", {})
+            return float(sum(values.values())) if isinstance(values, dict) else 0.0
+
+        updates = snapshot.get("nanofed_updates_total", {}).get("values", {})
+        accepted = float(sum(
+            v for k, v in updates.items()
+            if isinstance(k, str) and k.endswith("accepted")
+        )) if isinstance(updates, dict) else 0.0
+        rps = completed / self.wall_s if self.wall_s > 0 else None
+        return {
+            "tenant": self.spec.name,
+            "model": self.spec.model,
+            "algorithm": self.spec.algorithm,
+            "rounds_target": self.spec.rounds,
+            "rounds_completed": completed,
+            "rounds_failed": failed,
+            "rounds_per_sec": round(rps, 4) if rps is not None else None,
+            "wall_s": round(self.wall_s, 4),
+            "http_429_total": _total("nanofed_http_429_total"),
+            "updates_accepted": accepted,
+            "chaos_injected_total": _total("nanofed_faults_injected_total"),
+            "chaos_by_kind": (
+                self.chaos.counts() if self.chaos is not None else {}
+            ),
+            "params": self.param_count,
+        }
+
+    def close(self) -> None:
+        """Release per-tenant resources (ingest pipeline decode pool)."""
+        pipeline = self.server.ingest_pipeline
+        if pipeline is not None:
+            pipeline.close()

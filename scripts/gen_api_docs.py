@@ -51,6 +51,7 @@ MODULES = [
     ("orchestration", ["nanofed_tpu.orchestration.types",
                        "nanofed_tpu.orchestration.coordinator"]),
     ("communication", ["nanofed_tpu.communication.codec",
+                       "nanofed_tpu.communication.transport",
                        "nanofed_tpu.communication.http_server",
                        "nanofed_tpu.communication.http_client",
                        "nanofed_tpu.communication.retry",
@@ -62,6 +63,10 @@ MODULES = [
                 "nanofed_tpu.ingest.pipeline"]),
     ("loadgen", ["nanofed_tpu.loadgen.swarm",
                  "nanofed_tpu.loadgen.harness"]),
+    ("service", ["nanofed_tpu.service.scheduler",
+                 "nanofed_tpu.service.tenant",
+                 "nanofed_tpu.service.service",
+                 "nanofed_tpu.service.harness"]),
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry",

@@ -1,0 +1,183 @@
+"""Multi-tenant federation service end to end (tier-1-safe smoke + slow leg).
+
+``test_tenants_smoke`` is the `make tenants-smoke` / CI gate: two tenants
+(different models, different serving paths) run CONCURRENTLY on one shared
+transport and one VirtualClock while a seeded wire-fault storm targets
+exactly one of them — the untargeted tenant must complete every round and
+lose zero submits, and the chaos counters must show the storm actually fired
+against the targeted tenant only.  The 3-tenant leg (three distinct models)
+is marked ``slow`` per the tier-1 budget policy and runs in the tenants-smoke
+CI job instead."""
+
+import json
+import math
+
+import pytest
+
+from nanofed_tpu.observability.telemetry import summarize_telemetry
+from nanofed_tpu.service import (
+    TenantQuota,
+    TenantSpec,
+    default_tenant_specs,
+    run_tenant_service,
+)
+
+
+def _specs_2tenant(rounds=3):
+    return [
+        TenantSpec(
+            name="alpha", model="digits_mlp", algorithm="fedbuff",
+            rounds=rounds, async_buffer_k=8,
+            quota=TenantQuota(ingest_capacity=32, ingest_batch=8),
+        ),
+        TenantSpec(
+            name="bravo", model="mlp", algorithm="fedbuff",
+            rounds=rounds, async_buffer_k=8,
+        ),
+    ]
+
+
+def test_tenants_smoke(tmp_path):
+    telemetry_dir = tmp_path / "telemetry"
+    # One submit per client: the update buffers are latest-wins PER CLIENT,
+    # so distinct clients (not repeat submits) are the aggregatable supply —
+    # 32 clients comfortably feed 3 aggregations of K=8.
+    artifact = run_tenant_service(
+        _specs_2tenant(),
+        clients_per_tenant=32,
+        submits_per_client=1,
+        chaos_tenant="alpha",
+        virtual_clock=True,
+        sequential_baseline=False,
+        out_dir=tmp_path,
+        telemetry_dir=telemetry_dir,
+        tag="smoke",
+    )
+    # The artifact landed and parses.
+    on_disk = json.loads((tmp_path / "tenants_smoke.json").read_text())
+    assert on_disk["record_type"] == "tenants"
+
+    alpha = artifact["tenants"]["alpha"]
+    bravo = artifact["tenants"]["bravo"]
+    # The storm fired against alpha — and ONLY alpha.
+    assert alpha["chaos_injected_total"] > 0
+    assert bravo["chaos_injected_total"] == 0
+    # Isolation: the untargeted tenant completed EVERY round and lost no
+    # submits while its neighbor absorbed a drop/ack-drop/delay storm.
+    assert bravo["rounds_completed"] == bravo["rounds_target"]
+    assert bravo["failed_submits"] == 0
+    assert artifact["isolation"]["zero_rounds_lost"]
+    assert artifact["isolation"]["zero_failed_submits"]
+    # The targeted tenant still made progress (drops are retried past).
+    assert alpha["rounds_completed"] > 0
+    # Finite p99 on both tenants.
+    for t in (alpha, bravo):
+        assert t["submit_latency_s"]["p99_s"] is not None
+        assert math.isfinite(t["submit_latency_s"]["p99_s"])
+    # The scheduler actually multiplexed the pool: both tenants held leases.
+    sched = artifact["scheduler"]["tenants"]
+    assert sched["alpha"]["leases"] > 0
+    assert sched["bravo"]["leases"] > 0
+
+    # metrics-summary digests the per-tenant telemetry records.
+    summary = summarize_telemetry(telemetry_dir / "telemetry.jsonl")
+    assert set(summary["tenants"]) == {"alpha", "bravo"}
+    assert summary["tenants"]["bravo"]["rounds_completed"] == \
+        bravo["rounds_completed"]
+    assert summary["tenants"]["alpha"]["chaos_injected_total"] > 0
+
+
+def test_fedavg_sync_tenant_completes(tmp_path):
+    """A synchronous FedAvg tenant (cohort barrier) behind the same service
+    machinery: rounds complete from swarm traffic alone."""
+    # Uniform arrivals at a low rate spread the population across both
+    # cohort rounds: the barrier closes on count, so late arrivals stamp —
+    # and fill — round 1.
+    artifact = run_tenant_service(
+        [TenantSpec(name="sync", model="linear", algorithm="fedavg",
+                    rounds=2, min_clients=3)],
+        clients_per_tenant=12,
+        submits_per_client=2,
+        arrival="uniform",
+        arrival_rate=100.0,
+        chaos_tenant=None,
+        virtual_clock=True,
+        sequential_baseline=False,
+        out_dir=None,
+        profile_programs=False,
+    )
+    t = artifact["tenants"]["sync"]
+    assert t["rounds_completed"] == 2
+    assert t["failed_submits"] == 0
+
+
+def test_admission_error_surfaces_at_add_tenant():
+    """A tenant whose footprint cannot pack onto the pool is refused at
+    admission — with the packing math — and nothing is mounted."""
+    import asyncio
+
+    from nanofed_tpu.service import AdmissionError, FederationService
+
+    async def scenario():
+        service = FederationService(
+            port=0, hbm_budget_bytes=1024, profile_programs=False
+        )
+        with pytest.raises(AdmissionError) as e:
+            service.add_tenant(TenantSpec(name="fat", model="digits_mlp"))
+        assert "budget 1,024 B" in str(e.value)
+        assert service.tenants() == []
+        assert service.transport.tenants() == []
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_failed_construction_unmounts_the_tenant():
+    """A spec that fails AFTER the HTTP session mounted (bad round config)
+    must not leave a half-configured session occupying the tenant name."""
+    import asyncio
+
+    from nanofed_tpu.service import FederationService
+
+    async def scenario():
+        service = FederationService(port=0, profile_programs=False)
+        with pytest.raises(ValueError):
+            # async_buffer_k=0 passes TenantSpec validation but fails
+            # NetworkRoundConfig's post-init — after the session mounted.
+            service.add_tenant(TenantSpec(name="alpha", algorithm="fedbuff",
+                                          async_buffer_k=0))
+        assert service.transport.tenants() == []
+        # The name is free again: a corrected retry mounts cleanly.
+        service.add_tenant(TenantSpec(name="alpha", rounds=1))
+        assert service.tenants() == ["alpha"]
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+@pytest.mark.slow
+def test_three_tenants_concurrent_vs_sequential(tmp_path):
+    """Three distinct (model, algorithm, path) tenants concurrent vs the
+    sequential baseline — the artifact's full shape.  Slow (compiles the
+    ingest ladder + profiles three aggregation programs); the tenants-smoke
+    CI job covers it un-filtered."""
+    # Sizing rule (sync tenants): clients >= ~2 x rounds x min_clients with
+    # spread arrivals, since update buffers are latest-wins per client.
+    artifact = run_tenant_service(
+        default_tenant_specs(3, rounds=3, async_buffer_k=8, min_clients=4),
+        clients_per_tenant=24,
+        submits_per_client=2,
+        arrival="uniform",
+        arrival_rate=100.0,
+        chaos_tenant=True,
+        virtual_clock=True,
+        sequential_baseline=True,
+        out_dir=tmp_path,
+        tag="3t",
+    )
+    assert len(artifact["tenants"]) == 3
+    models = {t["model"] for t in artifact["tenants"].values()}
+    algos = {t["algorithm"] for t in artifact["tenants"].values()}
+    assert len(models) == 3  # genuinely distinct jobs
+    assert algos == {"fedbuff", "fedavg"}
+    assert artifact["isolation"]["zero_rounds_lost"]
+    assert artifact["sequential"]["aggregate_rounds_per_sec"] is not None
+    assert artifact["concurrent"]["aggregate_rounds_per_sec"] is not None

@@ -1,0 +1,225 @@
+"""Transport/session split: tenant routing, 404s, per-tenant admission
+scoping, and cross-tenant dedup isolation (aiohttp test client, no sockets).
+
+The isolation claims here are the wire half of the multi-tenant service's
+contract: an unknown tenant is a 404 at the TRANSPORT, a 429 is scoped to the
+over-quota tenant's session only, and idempotency-key windows live per
+session so the same (client, key) pair never collides across tenants."""
+
+import asyncio
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from nanofed_tpu.communication.codec import encode_params
+from nanofed_tpu.communication.http_server import (
+    HEADER_CLIENT,
+    HEADER_ROUND,
+    HEADER_SUBMIT,
+    HTTPServer,
+)
+from nanofed_tpu.communication.transport import (
+    HEADER_TENANT,
+    HTTPTransport,
+    tenant_base_url,
+)
+from nanofed_tpu.observability.registry import MetricsRegistry
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _params():
+    return {"w": np.ones((4, 2), np.float32), "b": np.zeros((2,), np.float32)}
+
+
+async def _two_tenant_client(fn, *, a_kwargs=None, b_kwargs=None):
+    """A shared transport hosting tenants 'a' and 'b' (each with its own
+    registry), driven through one aiohttp test client."""
+    transport = HTTPTransport(port=0, registry=MetricsRegistry())
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    a = HTTPServer(transport=transport, tenant="a", registry=reg_a,
+                   **(a_kwargs or {}))
+    b = HTTPServer(transport=transport, tenant="b", registry=reg_b,
+                   **(b_kwargs or {}))
+    client = TestClient(TestServer(transport.app))
+    await client.start_server()
+    try:
+        return await fn(transport, a, b, client)
+    finally:
+        await client.close()
+
+
+def test_unknown_tenant_404_path_and_header():
+    async def scenario(transport, a, b, client):
+        resp = await client.get("/t/ghost/status")
+        assert resp.status == 404
+        body = await resp.json()
+        assert "unknown tenant" in body["message"]
+        resp = await client.get("/status", headers={HEADER_TENANT: "ghost"})
+        assert resp.status == 404
+        # No default session on a tenant-only transport: anonymous requests
+        # are told how to address a tenant, not silently routed anywhere.
+        resp = await client.get("/status")
+        assert resp.status == 404
+        assert transport.metrics_registry.counter(
+            "nanofed_unknown_tenant_total"
+        ).value() == 3.0
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_tenant_routing_path_and_header_hit_the_same_session():
+    async def scenario(transport, a, b, client):
+        await a.publish_model(_params(), 3)
+        await b.publish_model(_params(), 7)
+        via_path = await (await client.get("/t/a/status")).json()
+        via_header = await (
+            await client.get("/status", headers={HEADER_TENANT: "a"})
+        ).json()
+        assert via_path["round"] == via_header["round"] == 3
+        assert (await (await client.get("/t/b/status")).json())["round"] == 7
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_method_mismatch_is_405_inside_the_tenant():
+    async def scenario(transport, a, b, client):
+        resp = await client.get("/t/a/update")  # update is POST-only
+        assert resp.status == 405
+        resp = await client.post("/t/a/nosuch")
+        assert resp.status == 404
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_head_on_get_endpoints_keeps_router_parity():
+    """The pre-split aiohttp router auto-served HEAD on GET routes
+    (load-balancer health probes HEAD /status); dispatch must too."""
+
+    async def scenario(transport, a, b, client):
+        resp = await client.head("/t/a/status")
+        assert resp.status == 200
+        assert await resp.read() == b""  # protocol layer suppresses the body
+        resp = await client.head("/t/a/update")  # POST-only stays 405
+        assert resp.status == 405
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_429_scoped_to_the_saturated_tenant_same_tick():
+    """Tenant A at max_inflight=0 sheds every submit with 429 while tenant
+    B's submit — fired in the same event-loop gather — is accepted."""
+
+    async def scenario(transport, a, b, client):
+        params = _params()
+        await a.publish_model(params, 0)
+        await b.publish_model(params, 0)
+        body = encode_params(params)
+        headers = {HEADER_CLIENT: "c1", HEADER_ROUND: "0",
+                   HEADER_SUBMIT: "k1"}
+        resp_a, resp_b = await asyncio.gather(
+            client.post("/t/a/update", data=body, headers=headers),
+            client.post("/t/b/update", data=body, headers=headers),
+        )
+        assert resp_a.status == 429
+        assert resp_a.headers["Retry-After"]
+        assert resp_b.status == 200
+        # The 429 landed in A's registry ONLY.
+        assert a.metrics_registry.counter(
+            "nanofed_http_429_total", labels=("endpoint",)
+        ).value(endpoint="update") == 1.0
+        assert b.metrics_registry.counter(
+            "nanofed_http_429_total", labels=("endpoint",)
+        ).value(endpoint="update") == 0.0
+
+    _run(_two_tenant_client(scenario, a_kwargs={"max_inflight": 0}))
+
+
+def test_submit_key_windows_never_collide_across_tenants():
+    """The SAME (client id, idempotency key) pair submitted to two tenants is
+    a fresh accept on each — and only a true re-submit to the SAME tenant
+    dedupes."""
+
+    async def scenario(transport, a, b, client):
+        params = _params()
+        await a.publish_model(params, 0)
+        await b.publish_model(params, 0)
+        body = encode_params(params)
+        headers = {HEADER_CLIENT: "c1", HEADER_ROUND: "0",
+                   HEADER_SUBMIT: "shared-key"}
+        first_a = await client.post("/t/a/update", data=body, headers=headers)
+        assert first_a.status == 200
+        assert not (await first_a.json()).get("duplicate")
+        # Same client, same key, OTHER tenant: a fresh logical submit there.
+        first_b = await client.post("/t/b/update", data=body, headers=headers)
+        assert first_b.status == 200
+        assert not (await first_b.json()).get("duplicate")
+        # Same tenant again: NOW it is the retry-storm duplicate.
+        retry_a = await client.post("/t/a/update", data=body, headers=headers)
+        assert retry_a.status == 200
+        assert (await retry_a.json()).get("duplicate") is True
+        assert a.num_updates() == 1
+        assert b.num_updates() == 1
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_default_session_preserves_single_tenant_wire_shape():
+    """A plain HTTPServer (no shared transport) answers unprefixed paths
+    exactly as before the split — and its _app stays test-client mountable."""
+
+    async def scenario():
+        server = HTTPServer(port=0)
+        client = TestClient(TestServer(server._app))
+        await client.start_server()
+        try:
+            await server.publish_model(_params(), 5)
+            status = await (await client.get("/status")).json()
+            assert status["round"] == 5
+            resp = await client.get("/model")
+            assert resp.status == 200
+            assert resp.headers[HEADER_ROUND] == "5"
+        finally:
+            await client.close()
+
+    _run(scenario())
+
+
+def test_shared_session_refuses_direct_start():
+    async def scenario(transport, a, b, client):
+        try:
+            await a.start()
+        except RuntimeError as e:
+            assert "shared transport" in str(e)
+        else:
+            raise AssertionError("start() on a shared session must refuse")
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_remove_session_turns_tenant_into_404():
+    async def scenario(transport, a, b, client):
+        assert (await client.get("/t/a/test")).status == 200
+        transport.remove_session("a")
+        assert (await client.get("/t/a/test")).status == 404
+        assert (await client.get("/t/b/test")).status == 200
+
+    _run(_two_tenant_client(scenario))
+
+
+def test_tenant_base_url():
+    assert tenant_base_url("http://h:1/", "x") == "http://h:1/t/x"
+
+
+def test_duplicate_tenant_mount_refused():
+    transport = HTTPTransport(port=0, registry=MetricsRegistry())
+    HTTPServer(transport=transport, tenant="a", registry=MetricsRegistry())
+    try:
+        HTTPServer(transport=transport, tenant="a",
+                   registry=MetricsRegistry())
+    except ValueError as e:
+        assert "already mounted" in str(e)
+    else:
+        raise AssertionError("mounting a live tenant name twice must refuse")
