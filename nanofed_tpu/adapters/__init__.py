@@ -1,0 +1,45 @@
+"""Parameter-efficient federation: LoRA adapters over frozen base models.
+
+The subsystem has three layers:
+
+* :mod:`nanofed_tpu.adapters.lora` — the adapter algebra: ``AdapterSpec``
+  (which leaves, what rank), ``init_adapters`` (A random, B zero — identity
+  start), ``merge_adapters``/``unmerge_adapters`` (adapters <-> ordinary
+  params, for eval/checkpointing), ``adapter_delta`` (the dense delta an
+  adapter tree represents), and ``make_adapter_apply`` (bind a frozen base
+  into the zoo apply signature);
+* the round-program hook — :class:`nanofed_tpu.parallel.round_step.FrozenBase`
+  carries the base through the shard_map boundary as a read-only, model-
+  sharded input, so ``build_round_step``/``build_round_block`` train and
+  aggregate ONLY the adapter tree while the base stays device-resident;
+* the orchestration surface — ``Coordinator(adapter=AdapterSpec(...))``, CLI
+  ``run --adapter-rank``, the autotuner's rank axis, and the wire path where
+  only adapter deltas cross HTTP (riding the existing q8/topk codec and the
+  fused dequant-accumulate epilogue).
+
+See docs/performance.md "When adapters pay" for the sizing math.
+"""
+
+from nanofed_tpu.adapters.lora import (
+    AdapterSpec,
+    adapter_delta,
+    adapter_param_count,
+    adapter_wire_ratio,
+    init_adapters,
+    make_adapter_apply,
+    merge_adapters,
+    target_paths,
+    unmerge_adapters,
+)
+
+__all__ = [
+    "AdapterSpec",
+    "adapter_delta",
+    "adapter_param_count",
+    "adapter_wire_ratio",
+    "init_adapters",
+    "make_adapter_apply",
+    "merge_adapters",
+    "target_paths",
+    "unmerge_adapters",
+]

@@ -95,6 +95,7 @@ def check_round_step(
     weights: Any,
     rngs: Any,
     lr_scale: Any = 1.0,
+    frozen_base: Any = None,
 ) -> dict[str, Any]:
     """Validate a ``build_round_step`` program against the round-engine contract.
 
@@ -108,16 +109,33 @@ def check_round_step(
     * ``result.client_metrics`` / ``result.update_sq_norms`` carry the step's
       client width (``weights.shape[0]``) as their leading dimension.
 
+    ``frozen_base`` (the frozen-base/adapter split, ``parallel.round_step.
+    FrozenBase`` programs): the base tree enters the traced signature as the
+    third argument but is DELIBERATELY absent from the fixed-point check —
+    the base is read-only boundary data, not round state, and the program
+    returns no base output for an equality to even anchor on.  ``params``
+    is then the TRAINABLE (adapter) tree, and the fixed point covers exactly
+    what the Coordinator threads from round to round.
+
     Returns a small report dict (checked leaf counts) for logging/tests;
     raises :class:`ContractViolation` with the offending leaf path otherwise.
     """
     n_clients = int(weights.shape[0])
-    out = jax.eval_shape(
-        step, _abstract(params), _abstract(server_opt_state), _abstract(data),
-        _abstract(weights), _abstract(rngs),
+    lr_abs = (
         jax.ShapeDtypeStruct((), jax.numpy.float32)
-        if isinstance(lr_scale, (int, float)) else _abstract(lr_scale),
+        if isinstance(lr_scale, (int, float)) else _abstract(lr_scale)
     )
+    if frozen_base is not None:
+        out = jax.eval_shape(
+            step, _abstract(params), _abstract(server_opt_state),
+            _abstract(frozen_base), _abstract(data), _abstract(weights),
+            _abstract(rngs), lr_abs,
+        )
+    else:
+        out = jax.eval_shape(
+            step, _abstract(params), _abstract(server_opt_state),
+            _abstract(data), _abstract(weights), _abstract(rngs), lr_abs,
+        )
     _assert_tree_matches(out.params, _abstract(params), "params")
     _assert_tree_matches(
         out.server_opt_state, _abstract(server_opt_state), "server_opt_state"
@@ -135,6 +153,8 @@ def check_round_step(
         "params_leaves": len(jax.tree.leaves(params)),
         "metrics": sorted(out.metrics),
         "clients": n_clients,
+        **({"frozen_base_leaves": len(jax.tree.leaves(frozen_base))}
+           if frozen_base is not None else {}),
     }
 
 
@@ -148,14 +168,17 @@ def check_round_block(
     lr_scales: Any,
     cohort_idx: Any = None,
     cohort_mask: Any = None,
+    frozen_base: Any = None,
 ) -> dict[str, Any]:
     """Validate a fused ``build_round_block`` program (R scanned rounds).
 
     Same contract as :func:`check_round_step`, lifted over the block: params /
     server state are a fixed point of the whole block, per-round metrics stack
     ``[R]``, survivors is an ``[R]`` integer vector, and the optional
-    per-client detail stacks lead with R.  Raises :class:`ContractViolation`
-    with the offending leaf path; returns a report dict.
+    per-client detail stacks lead with R.  ``frozen_base`` is the adapter
+    mode's read-only base (absent from the fixed point — see
+    :func:`check_round_step`).  Raises :class:`ContractViolation` with the
+    offending leaf path; returns a report dict.
     """
     rounds = int(base_keys.shape[0])
     args = [
@@ -163,6 +186,7 @@ def check_round_block(
         _abstract(num_samples), _abstract(base_keys), _abstract(lr_scales),
         None if cohort_idx is None else _abstract(cohort_idx),
         None if cohort_mask is None else _abstract(cohort_mask),
+        None if frozen_base is None else _abstract(frozen_base),
     ]
     out = jax.eval_shape(block, *args)
     _assert_tree_matches(out.params, _abstract(params), "params")
@@ -207,6 +231,7 @@ def check_input_shardings(
     axis_name: str = "clients",
     model_axis: str = "model",
     host_axis: str = "hosts",
+    base_params: Any = None,
 ) -> None:
     """Spot-check the parallel layout on CONCRETE inputs.
 
@@ -224,6 +249,12 @@ def check_input_shardings(
     sharded param leaf would make every client train a different slice of the
     model, and a host-sharded one would desynchronize the global model across
     hosts — the exact failure hierarchical aggregation exists to prevent).
+
+    ``base_params`` (adapter mode's frozen base) is audited with the SAME rule
+    as params: the frozen-base + trainable-adapter split changes what enters
+    the fixed point, not what layouts are legal — a client-sharded adapter (or
+    base) leaf would make every client train a different slice of the model
+    and is rejected identically.
 
     Leaves that carry no ``NamedSharding`` (host arrays, abstract values,
     single-device placements) are skipped — this is a layout audit, not a
@@ -253,21 +284,29 @@ def check_input_shardings(
                     f"spec {spec}) — a client's batch rides each model column "
                     "whole"
                 )
-    for path, leaf in _leaves_with_paths(params):
-        sharding = getattr(leaf, "sharding", None)
-        if not isinstance(sharding, NamedSharding):
-            continue
-        if sharding.is_fully_replicated:
-            continue
-        sharded_axes = [a for entry in sharding.spec for a in _spec_axes(entry)]
-        if any(a != model_axis for a in sharded_axes) or len(sharded_axes) > 1:
-            raise ContractViolation(
-                f"params{path}: expected replicated placement or a single "
-                f"dimension sharded over {model_axis!r}, got spec "
-                f"{sharding.spec} — params ride every device whole (1-D) or "
-                "split over the model axis only (FSDP layout); client- or "
-                "host-sharded params are never valid"
-            )
+
+    def _audit_model_state(tree: Any, what: str) -> None:
+        for path, leaf in _leaves_with_paths(tree):
+            sharding = getattr(leaf, "sharding", None)
+            if not isinstance(sharding, NamedSharding):
+                continue
+            if sharding.is_fully_replicated:
+                continue
+            sharded_axes = [
+                a for entry in sharding.spec for a in _spec_axes(entry)
+            ]
+            if any(a != model_axis for a in sharded_axes) or len(sharded_axes) > 1:
+                raise ContractViolation(
+                    f"{what}{path}: expected replicated placement or a single "
+                    f"dimension sharded over {model_axis!r}, got spec "
+                    f"{sharding.spec} — model state rides every device whole "
+                    "(1-D) or split over the model axis only (FSDP layout); "
+                    "client- or host-sharded model state is never valid"
+                )
+
+    _audit_model_state(params, "params")
+    if base_params is not None:
+        _audit_model_state(base_params, "base_params")
 
 
 @contextlib.contextmanager

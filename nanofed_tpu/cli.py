@@ -196,6 +196,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         strict=args.strict,
         profile_programs=args.profile_programs,
         autotune=args.autotune,
+        adapter_rank=args.adapter_rank,
+        adapter_alpha=args.adapter_alpha,
     )
     print(json.dumps(metrics, indent=2, default=str))
     return 0
@@ -232,6 +234,11 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
     )
     pop = PopulationSpec.from_client_data(client_data)
     num_rounds = max(args.rounds_per_block, 8)
+    adapter = None
+    if args.adapter_rank is not None:
+        from nanofed_tpu.adapters import AdapterSpec
+
+        adapter = AdapterSpec(rank=args.adapter_rank)
     # Explicit --client-chunk / --model-shards pin that axis of the sweep to a
     # single value (the same "pin via a single-valued space" mechanism
     # Coordinator.from_autotune documents) — never silently ignored.
@@ -248,12 +255,13 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
 
         import jax
 
-        # TuningSpace.default owns the multi-process hosts-axis rule, so a
-        # pin on one knob cannot silently flatten the hosts axis of the
-        # others.
+        # TuningSpace.default owns the multi-process hosts-axis rule AND the
+        # adapter-rank ladder, so a pin on one knob cannot silently flatten
+        # the other axes.
         space = dataclasses.replace(
             TuningSpace.default(
-                pop, len(jax.devices()), training.batch_size, num_rounds
+                pop, len(jax.devices()), training.batch_size, num_rounds,
+                adapter_rank=args.adapter_rank,
             ),
             **pins,
         )
@@ -270,6 +278,7 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
             space=space,
             telemetry=telemetry,
             force=args.force_sweep,
+            adapter=adapter,
         )
     except AutotuneError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -338,6 +347,12 @@ def _cmd_profile(args: argparse.Namespace) -> int:
         learning_rate=args.lr, compute_dtype=args.dtype,
     )
 
+    adapter = None
+    if args.adapter_rank is not None:
+        from nanofed_tpu.adapters import AdapterSpec
+
+        adapter = AdapterSpec(rank=args.adapter_rank)
+
     def build(scaffold: bool, rounds_per_block: int) -> Coordinator:
         # save_metrics=False: profiling must leave no run artifacts behind
         # (telemetry lands only where --telemetry-dir points).  num_rounds
@@ -353,13 +368,15 @@ def _cmd_profile(args: argparse.Namespace) -> int:
             training=training, scaffold=scaffold,
             client_chunk=args.client_chunk, mesh_shape=mesh_shape,
             telemetry_dir=args.telemetry_dir,
+            adapter=None if scaffold else adapter,
         )
 
     reports = []
     coordinators = [build(scaffold=False, rounds_per_block=args.rounds_per_block)]
-    if not args.no_scaffold:
+    if not args.no_scaffold and adapter is None:
         # The SCAFFOLD program is a different ROUND program (control-variate
         # state flows through it), so it gets its own coordinator + report.
+        # Skipped in adapter mode: adapter SCAFFOLD is refused by construction.
         coordinators.append(build(scaffold=True, rounds_per_block=1))
     for coord in coordinators:
         reports.extend(coord.profile_programs())
@@ -679,6 +696,7 @@ def _cmd_loadtest(args: argparse.Namespace) -> int:
         round_timeout_s=args.timeout,
         virtual_clock=args.virtual_clock,
         seed=args.seed,
+        adapter_rank=args.adapter_rank,
     )
     print(json.dumps(artifact, indent=2))
     # A loadtest that lost submits outright (not 429-shed — those retry) is a
@@ -782,6 +800,21 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument(
         "--dtype", default=None, choices=["bfloat16", "float32"],
         help="local-training compute dtype (mixed precision when bfloat16)",
+    )
+    run.add_argument(
+        "--adapter-rank", type=int, default=None, metavar="R",
+        help="parameter-efficient federation (nanofed_tpu.adapters): freeze "
+        "the base model device-resident and federate only rank-R LoRA A/B "
+        "deltas on the 2-D kernel leaves — training, aggregation, "
+        "checkpoints, and wire payloads are adapter-sized (the full model "
+        "only materializes at eval/versioned-model merges). Composes with "
+        "--model-shards (the frozen base shards over the model axis) and "
+        "with --autotune (R seeds the tuner's rank-ladder sweep)",
+    )
+    run.add_argument(
+        "--adapter-alpha", type=float, default=None,
+        help="LoRA alpha: the merged delta is (alpha/rank) * A @ B "
+        "(default: alpha = rank, i.e. scale 1.0)",
     )
     run.add_argument(
         "--model-shards", type=int, default=1, metavar="N",
@@ -1082,6 +1115,14 @@ def main(argv: list[str] | None = None) -> int:
         "(1 = single-step only)",
     )
     profile.add_argument("--client-chunk", type=int, default=None)
+    profile.add_argument(
+        "--adapter-rank", type=int, default=None, metavar="R",
+        help="with --sweep: sweep the parameter-efficient axis — every "
+        "candidate lowers the frozen-base LoRA round program, the rank "
+        "ladder {R/2, R, 2R} joins the space, and the epilogue cost table "
+        "is sized to the adapter payload; the ranked table grows a 'lora' "
+        "column",
+    )
     profile.add_argument("--model-shards", type=int, default=1, metavar="N",
                          help="profile the 2-D clients x model (FSDP) programs")
     profile.add_argument(
@@ -1130,6 +1171,13 @@ def main(argv: list[str] | None = None) -> int:
         "rounds/sec ratio",
     )
     loadtest.add_argument("--model", default="digits_mlp")
+    loadtest.add_argument(
+        "--adapter-rank", type=int, default=None, metavar="R",
+        help="parameter-efficient wire mode (nanofed_tpu.adapters): the "
+        "federated tree — model fetches, canned submit payloads, the "
+        "engine's aggregation — is the rank-R LoRA adapter tree; the "
+        "artifact records measured full-vs-adapter payload bytes",
+    )
     loadtest.add_argument(
         "--async-buffer", type=int, default=64, metavar="K",
         help="FedBuff aggregation size K (the round engine runs in async "

@@ -8,6 +8,7 @@ from nanofed_tpu.data.datasets import (
     load_digits_dataset,
     load_mnist,
     synthetic_classification,
+    synthetic_token_streams,
 )
 from nanofed_tpu.data.partition import (
     dirichlet_partition,
@@ -29,4 +30,5 @@ __all__ = [
     "pack_eval",
     "subset_iid",
     "synthetic_classification",
+    "synthetic_token_streams",
 ]

@@ -21,6 +21,18 @@ def load_datasets_for(
     """Pick train/test datasets matching a model's input shape (MNIST-shaped, CIFAR-shaped,
     or synthetic for anything else)."""
     test_size = (train_size or 0) // 6 or None
+    if getattr(mdl, "token_stream", False):
+        from nanofed_tpu.data import synthetic_token_streams
+
+        seq_len = mdl.input_shape[0]
+        train = synthetic_token_streams(
+            train_size or 4096, vocab=mdl.num_classes, seq_len=seq_len, seed=seed
+        )
+        test = synthetic_token_streams(
+            test_size or 1024, vocab=mdl.num_classes, seq_len=seq_len,
+            seed=seed + 1,
+        )
+        return train, test
     if mdl.input_shape == (28, 28, 1):
         train = load_mnist("train", data_dir, synthetic_size=train_size)
         test = load_mnist("test", data_dir, synthetic_size=test_size)
@@ -78,6 +90,8 @@ def run_experiment(
     strict: bool = False,
     profile_programs: bool = False,
     autotune: bool = False,
+    adapter_rank: int | None = None,
+    adapter_alpha: float | None = None,
     **scheme_kwargs: Any,
 ) -> dict[str, Any]:
     """Run a full federated experiment; returns a summary dict.
@@ -134,6 +148,15 @@ def run_experiment(
     first real round) — the ranked table lands as ``<out_dir>/autotune_*.json``
     and the summary carries ``tuned_config``.  Refuses explicit values for the
     swept knobs: the tuner owns them.
+
+    ``adapter_rank`` (CLI ``--adapter-rank``) engages parameter-efficient
+    federation (``nanofed_tpu.adapters``): the base model is frozen
+    device-resident (model-sharded under ``model_shards > 1``) and only LoRA
+    adapter A/B deltas of this rank cross the client axis — training,
+    aggregation, checkpoints, and any wire payload are adapter-sized.
+    ``adapter_alpha`` is the LoRA scale numerator (default: the rank, i.e.
+    scale 1.0).  Combined with ``autotune=True``, the rank seeds the tuner's
+    rank-ladder sweep and the WINNING rank is the one federated.
     """
     log = Logger()
     robust = None
@@ -177,6 +200,18 @@ def run_experiment(
         prox_mu=prox_mu,
         compute_dtype=compute_dtype,
     )
+    adapter = None
+    if adapter_rank is not None:
+        from nanofed_tpu.adapters import AdapterSpec
+
+        adapter = AdapterSpec(rank=adapter_rank, alpha=adapter_alpha)
+    elif adapter_alpha is not None:
+        from nanofed_tpu.core.exceptions import NanoFedError
+
+        raise NanoFedError(
+            "adapter_alpha only applies with adapter_rank (it scales the "
+            "LoRA delta alpha/rank)"
+        )
     shared_kwargs: dict[str, Any] = dict(
         eval_data=pack_eval(test, batch_size=256),
         central_privacy=central_privacy,
@@ -184,6 +219,7 @@ def run_experiment(
         scaffold=scaffold,
         telemetry_dir=telemetry_dir,
         strict=strict,
+        adapter=adapter,
     )
     if autotune:
         pinned = [
@@ -227,9 +263,21 @@ def run_experiment(
     program_profiles = {
         r.program: r.to_dict() for r in coordinator.program_catalog.reports()
     }
+    adapter_summary = None
+    if coordinator.adapter is not None:
+        from nanofed_tpu.adapters import adapter_param_count
+
+        adapter_summary = {
+            **coordinator.adapter.to_dict(),
+            **adapter_param_count(
+                coordinator.adapter, coordinator._adapter_base_host
+            ),
+            "merges": coordinator._merge_count,
+        }
     return {
         **({"privacy_spent": privacy_summary} if privacy_summary else {}),
         **({"program_profiles": program_profiles} if program_profiles else {}),
+        **({"adapter": adapter_summary} if adapter_summary else {}),
         **({"tuned_config": coordinator.tuned_config}
            if coordinator.tuned_config is not None else {}),
         "model": model,

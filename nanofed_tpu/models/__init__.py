@@ -1,10 +1,16 @@
 """Model zoo (parity+: reference ships only ``MNISTModel``, ``nanofed/models/__init__.py``;
 the ResNets serve the BASELINE.json benchmark configs)."""
 
-from nanofed_tpu.models import linear, mnist, resnet  # noqa: F401  (registry side effects)
+from nanofed_tpu.models import (  # noqa: F401  (registry side effects)
+    linear,
+    mnist,
+    resnet,
+    transformer,
+)
 from nanofed_tpu.models.base import Model, get_model, list_models, register_model
 from nanofed_tpu.models.mnist import mnist_cnn
 from nanofed_tpu.models.resnet import resnet8, resnet18
+from nanofed_tpu.models.transformer import transformer_lm
 
 __all__ = [
     "Model",
@@ -14,4 +20,5 @@ __all__ = [
     "mnist_cnn",
     "resnet8",
     "resnet18",
+    "transformer_lm",
 ]

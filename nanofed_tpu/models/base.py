@@ -29,6 +29,12 @@ class Model:
     apply: ApplyFn
     input_shape: tuple[int, ...] = field(default=())  # per-example shape, e.g. (28, 28, 1)
     num_classes: int = 0
+    # Token-stream workloads (the causal transformer LM): ``x`` is int32 token
+    # ids in [0, num_classes) of shape ``input_shape == (seq_len,)`` and
+    # ``num_classes`` doubles as the vocabulary size.  Dataset selection
+    # (``experiments.load_datasets_for``) and mixed-precision casting
+    # (``trainer.local.make_grad_fn`` must not cast ids to bf16) key off this.
+    token_stream: bool = False
 
 
 _REGISTRY: dict[str, Callable[..., Model]] = {}

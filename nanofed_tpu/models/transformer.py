@@ -1,0 +1,235 @@
+"""GPT-style causal transformer LM — the first workload with a model worth sharding.
+
+Every other zoo member is digits-MLP/CNN/ResNet-8 scale, so the FSDP model axis
+(``parallel.mesh.param_partition_spec``) has never sharded a parameter that would
+not comfortably fit replicated, and the wire has never carried an update payload
+where compression pays.  This model exists to make both real: a next-token
+predictor over synthetic token streams (``data.synthetic_token_streams`` — no
+dataset download exists in this environment) whose parameter count scales as
+``~12 * depth * width^2 + 2 * vocab * width``, so ``transformer_lm(width=2048,
+depth=24, vocab=32768)`` is a ~1.3B-parameter tree that genuinely exceeds
+replicated per-device capacity on 16 GiB-HBM chips (docs/performance.md "When
+adapters pay" carries the math).
+
+Architecture (functional, pure ``(init, apply)`` like the rest of the zoo):
+token embedding + learned positional embedding, ``depth`` pre-LN blocks of
+multi-head CAUSAL self-attention and a 4x GELU MLP, final LayerNorm, untied
+unembedding head.  ``apply`` returns next-token log-probabilities at the LAST
+position (``[N, vocab]``) so the model drops into the standard federated
+pipeline — ``ClientData.y`` is the true next token, the masked-NLL ``grad_fn``,
+evaluator, and every round builder work unchanged; :func:`apply_sequence`
+exposes the full ``[N, T, vocab]`` per-position logits (causality tests, future
+all-position training).
+
+Every matrix the FSDP layout rule cares about is 2-D: attention ``wq/wk/wv/wo``
+``[D, D]``, MLP ``[D, 4D]``/``[4D, D]``, embeddings/head ``[V, D]``/``[D, V]``
+— each leaf's largest divisible dimension shards over the model axis, and these
+are exactly the leaves a LoRA :class:`~nanofed_tpu.adapters.AdapterSpec`
+targets.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from nanofed_tpu import nn
+from nanofed_tpu.core.types import Params, PRNGKey
+from nanofed_tpu.models.base import Model, register_model
+
+#: Defaults sized so tier-1 tests compile in seconds; the flagship configs in
+#: runs/adapter_* scale width/depth/vocab up through the same factory.
+DEFAULT_VOCAB = 256
+DEFAULT_SEQ_LEN = 32
+DEFAULT_WIDTH = 64
+DEFAULT_DEPTH = 2
+DEFAULT_HEADS = 4
+
+
+def _layer_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32),
+            "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def _layer_norm(params: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * params["scale"] + params["bias"]
+
+
+def init_transformer(
+    rng: PRNGKey,
+    vocab: int,
+    seq_len: int,
+    width: int,
+    depth: int,
+) -> Params:
+    """Parameter tree for the causal LM.  Embeddings draw N(0, 0.02) (GPT-2
+    convention); dense matrices use the zoo's kaiming-uniform ``dense_init``
+    with the output projections down-scaled by ``1/sqrt(2*depth)`` (the GPT-2
+    residual-accumulation fix, so deep stacks start with unit-scale residual
+    streams)."""
+    n_keys = 3 + depth
+    keys = jax.random.split(rng, n_keys)
+    params: Params = {
+        "tok_emb": 0.02 * jax.random.normal(keys[0], (vocab, width), jnp.float32),
+        "pos_emb": 0.02 * jax.random.normal(keys[1], (seq_len, width), jnp.float32),
+        "head": nn.dense_init(keys[2], width, vocab),
+        "ln_f": _layer_norm_init(width),
+    }
+    resid_scale = 1.0 / math.sqrt(2.0 * depth)
+    for i in range(depth):
+        kq, kk, kv, ko, k1, k2 = jax.random.split(keys[3 + i], 6)
+        wo = nn.dense_init(ko, width, width)
+        fc2 = nn.dense_init(k2, 4 * width, width)
+        params[f"block_{i}"] = {
+            "ln1": _layer_norm_init(width),
+            "attn": {
+                "wq": nn.dense_init(kq, width, width),
+                "wk": nn.dense_init(kk, width, width),
+                "wv": nn.dense_init(kv, width, width),
+                "wo": {"kernel": wo["kernel"] * resid_scale, "bias": wo["bias"]},
+            },
+            "ln2": _layer_norm_init(width),
+            "mlp": {
+                "fc1": nn.dense_init(k1, width, 4 * width),
+                "fc2": {"kernel": fc2["kernel"] * resid_scale, "bias": fc2["bias"]},
+            },
+        }
+    return params
+
+
+def _attention(params: Params, x: jax.Array, heads: int) -> jax.Array:
+    """Multi-head causal self-attention over ``x`` [N, T, D]."""
+    n, t, d = x.shape
+    hd = d // heads
+
+    def split_heads(y):  # [N, T, D] -> [N, H, T, hd]
+        return y.reshape(n, t, heads, hd).transpose(0, 2, 1, 3)
+
+    q = split_heads(nn.dense(params["wq"], x))
+    k = split_heads(nn.dense(params["wk"], x))
+    v = split_heads(nn.dense(params["wv"], x))
+    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) / math.sqrt(hd)
+    # Causal mask: position q attends to keys <= q only.  Additive -inf keeps the
+    # softmax exact for the allowed band.
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal[None, None], scores, jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("nhqk,nhkd->nhqd", att, v)
+    out = out.transpose(0, 2, 1, 3).reshape(n, t, d)
+    return nn.dense(params["wo"], out)
+
+
+def apply_sequence(
+    params: Params,
+    tokens: jax.Array,
+    *,
+    heads: int = DEFAULT_HEADS,
+    train: bool = False,
+    rng: PRNGKey | None = None,
+) -> jax.Array:
+    """Full per-position next-token log-probs ``[N, T, vocab]`` for int token
+    ids ``[N, T]``.  Deterministic (no dropout) — ``train``/``rng`` are accepted
+    for apply-signature parity and unused, which keeps fused-vs-single round
+    parity exact on every mesh."""
+    del train, rng
+    tokens = tokens.astype(jnp.int32)
+    n, t = tokens.shape
+    x = params["tok_emb"][tokens] + params["pos_emb"][None, :t]
+    depth = sum(1 for k in params if k.startswith("block_"))
+    for i in range(depth):
+        blk = params[f"block_{i}"]
+        x = x + _attention(blk["attn"], _layer_norm(blk["ln1"], x), heads)
+        h = nn.dense(blk["mlp"]["fc1"], _layer_norm(blk["ln2"], x))
+        x = x + nn.dense(blk["mlp"]["fc2"], jax.nn.gelu(h))
+    x = _layer_norm(params["ln_f"], x)
+    return nn.log_softmax(nn.dense(params["head"], x))
+
+
+def transformer_param_count(
+    vocab: int, seq_len: int, width: int, depth: int
+) -> int:
+    """Analytic parameter count of :func:`init_transformer` — the memory-math
+    side of docs/performance.md "When adapters pay", exact (asserted in tests
+    against the real tree)."""
+    per_block = (
+        4 * (width * width + width)  # wq/wk/wv/wo kernels + biases
+        + (width * 4 * width + 4 * width)  # fc1
+        + (4 * width * width + width)  # fc2
+        + 4 * width  # ln1 + ln2 scale/bias
+    )
+    return (
+        vocab * width  # tok_emb
+        + seq_len * width  # pos_emb
+        + width * vocab + vocab  # head kernel + bias
+        + 2 * width  # ln_f
+        + depth * per_block
+    )
+
+
+@register_model("transformer_lm")
+def transformer_lm(
+    vocab: int = DEFAULT_VOCAB,
+    seq_len: int = DEFAULT_SEQ_LEN,
+    width: int = DEFAULT_WIDTH,
+    depth: int = DEFAULT_DEPTH,
+    heads: int = DEFAULT_HEADS,
+) -> Model:
+    """The causal-LM zoo entry.  ``apply`` returns the LAST position's
+    next-token log-probs ``[N, vocab]`` so the standard masked-NLL pipeline
+    trains it with ``y`` = true next token; the full ``[N, T, vocab]`` surface
+    is :func:`apply_sequence`."""
+    if width % heads != 0:
+        raise ValueError(f"width {width} must be divisible by heads {heads}")
+
+    def init(rng: PRNGKey) -> Params:
+        return init_transformer(rng, vocab, seq_len, width, depth)
+
+    def apply(
+        params: Params, x: jax.Array, *, train: bool = False, rng=None
+    ) -> jax.Array:
+        logp = apply_sequence(params, x, heads=heads, train=train, rng=rng)
+        return logp[:, -1, :]
+
+    return Model(
+        name="transformer_lm",
+        init=init,
+        apply=apply,
+        input_shape=(seq_len,),
+        num_classes=vocab,
+        token_stream=True,
+    )
+
+
+#: Flagship shapes for the evidence artifacts (runs/adapter_*): the factory is
+#: the same, only the dims scale.  Listed here so the artifact generator, the
+#: docs math, and the tests agree on one source.
+FLAGSHIP_CONFIGS = {
+    # name: (vocab, seq_len, width, depth, heads)
+    "tiny": (DEFAULT_VOCAB, DEFAULT_SEQ_LEN, DEFAULT_WIDTH, DEFAULT_DEPTH, DEFAULT_HEADS),
+    "small": (512, 64, 128, 4, 4),
+    # ~4.5M params, CPU-trainable in minutes: the committed adapter-evidence
+    # workload — wide enough that rank-16 adapters are >10x smaller than the
+    # kernels they adapt (the wire-bytes headline needs the ratio, and tiny
+    # kernels would hide it).
+    "evidence": (1024, 64, 256, 4, 4),
+    # ~124M params: the smallest config whose replicated f32 train state
+    # (params + SGD momentum + a round's delta) crosses a 16 GiB v5e budget
+    # only when stacked across resident clients — the mid rung of the docs math.
+    "base": (8192, 128, 768, 12, 12),
+    # ~1.21B params (4.8 GiB f32): params + momentum + one gathered copy +
+    # one delta ≈ 19.4 GiB replicated — over a 16 GiB v5e HBM budget on its
+    # own, which is what "the model axis is binding" means.
+    "large": (32768, 256, 2048, 24, 16),
+}
+
+
+def flagship(name: str) -> Model:
+    """Build a named flagship config (see :data:`FLAGSHIP_CONFIGS`)."""
+    vocab, seq_len, width, depth, heads = FLAGSHIP_CONFIGS[name]
+    return transformer_lm(
+        vocab=vocab, seq_len=seq_len, width=width, depth=depth, heads=heads
+    )

@@ -185,6 +185,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     host_failures: list[dict[str, Any]] = []
     recoveries: list[dict[str, Any]] = []
     tenants: dict[str, dict[str, Any]] = {}
+    adapter: dict[str, Any] = {}
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -283,6 +284,24 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 }
+            elif rtype == "adapter":
+                # Parameter-efficient federation (nanofed_tpu.adapters):
+                # records accumulate by FIELD (different emitters own
+                # different fields — the Coordinator the rank/size split and
+                # final merge count, the wire harnesses the measured
+                # full-vs-adapter payload bytes), last value per field wins.
+                adapter.update({
+                    k: rec[k]
+                    for k in (
+                        "rank", "alpha", "targets", "adapter_params",
+                        "base_params", "ratio", "merges",
+                        "payload_bytes_full", "payload_bytes_adapter",
+                        "payload_reduction", "wire_bytes_full_round",
+                        "wire_bytes_adapter_round", "wire_reduction",
+                        "encoding",
+                    )
+                    if k in rec
+                })
             elif rtype == "loadtest":
                 # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
                 # by serving path; last record per mode wins (a re-run
@@ -329,6 +348,11 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Autotuner layer (nanofed_tpu.tuning): the winner config, scoring
         # basis, and sweep economics per swept configuration.
         out["autotunes"] = dict(sorted(autotunes.items()))
+    if adapter:
+        # Parameter-efficient federation (nanofed_tpu.adapters): rank, the
+        # trainable-vs-frozen split, merge count, and — when a wire harness
+        # ran — the measured full-vs-adapter wire bytes per round.
+        out["adapter"] = adapter
     if tenants:
         # Multi-tenant service layer (nanofed_tpu.service): per-tenant
         # rounds, p99 submit latency, 429s, and chaos hits — the isolation

@@ -196,6 +196,7 @@ class Coordinator:
                 "them; pin an axis with a single-valued tuning_space instead"
             )
         training = training or _TC()
+        adapter_spec = kwargs.pop("adapter", None)
         result = autotune(
             model, PopulationSpec.from_client_data(train_data), training,
             participation=config.participation_rate,
@@ -206,10 +207,17 @@ class Coordinator:
             cache_dir=autotune_cache_dir,
             out_dir=config.base_dir,
             force=autotune_force,
+            adapter=adapter_spec,
         )
         winner = result.winner
         import jax as _jax
 
+        if adapter_spec is not None and winner.adapter_rank is not None:
+            # The tuner owns the rank axis exactly like chunk/block/mesh: the
+            # built coordinator federates at the WINNING rank.
+            adapter_spec = dataclasses.replace(
+                adapter_spec, rank=winner.adapter_rank
+            )
         coord = cls(
             model,
             train_data,
@@ -224,6 +232,7 @@ class Coordinator:
                 getattr(winner, "hosts", 1), winner.model_shards,
                 len(_jax.devices()),
             ),
+            adapter=adapter_spec,
             **kwargs,
         )
         coord.autotune_result = result
@@ -263,6 +272,7 @@ class Coordinator:
         telemetry_dir: str | Path | None = None,
         strict: bool = False,
         chaos=None,
+        adapter=None,
     ) -> None:
         self.model = model
         self.config = config
@@ -351,10 +361,46 @@ class Coordinator:
         # 2-D mesh the per-leaf layout becomes the programs' shard_map specs.
         self._model_shards = model_axis_size(self.mesh)
         params_host = model.init(jax.random.key(config.seed))
+        # Parameter-efficient federation (nanofed_tpu.adapters): with an
+        # AdapterSpec, the FEDERATED state is the small LoRA adapter tree —
+        # ``self.params``/``self.server_state`` are adapter-shaped, so every
+        # downstream mechanism (aggregation, codec, checkpointing, autotuning)
+        # operates on the adapter tree without modification — while the frozen
+        # base stays device-resident in the same ``param_sharding`` layout
+        # (model-sharded on a 2-D/3-D mesh) and rides the round program as a
+        # read-only input (``parallel.round_step.FrozenBase``).
+        self.adapter = adapter
+        self._merge_count = 0
+        if adapter is not None:
+            if scaffold:
+                raise ValueError(
+                    "adapter= cannot be combined with scaffold=True: the "
+                    "control-variate machinery assumes the federated tree IS "
+                    "the model; adapter SCAFFOLD would need control state on "
+                    "the adapter tree, which is not built yet"
+                )
+            if local_fit is not None or grad_fn is not None:
+                raise ValueError(
+                    "adapter= builds the local fit from the frozen base inside "
+                    "the round program; a custom local_fit/grad_fn cannot see "
+                    "the base and is refused (see parallel.round_step.FrozenBase)"
+                )
+            from nanofed_tpu.adapters import init_adapters
+
+            self.base_params: Params | None = jax.device_put(
+                params_host, param_sharding(self.mesh, params_host)
+            )
+            # Adapter init is seeded off config.seed (host draw, like model
+            # init); B=0 makes the round-0 merged model exactly the base.
+            trainable_host = init_adapters(adapter, params_host, rng=config.seed)
+            self._adapter_base_host = params_host
+        else:
+            self.base_params = None
+            trainable_host = params_host
         self.params: Params = jax.device_put(
-            params_host, param_sharding(self.mesh, params_host)
+            trainable_host, param_sharding(self.mesh, trainable_host)
         )
-        sos_host = init_server_state(self.strategy, params_host)
+        sos_host = init_server_state(self.strategy, trainable_host)
         self.server_state = jax.device_put(
             sos_host, param_sharding(self.mesh, sos_host)
         )
@@ -460,17 +506,37 @@ class Coordinator:
                 )
             from nanofed_tpu.parallel.scaffold_step import build_scaffold_round_step
 
+            self._frozen_base = None
             self._round_step = build_scaffold_round_step(
                 model.apply, self.training, self.mesh, self.num_clients,
                 strategy=self.strategy, grad_fn=grad_fn, client_chunk=client_chunk,
                 params_like=self.params, donate=True,
             )
         else:
+            self._frozen_base = None
+            if adapter is not None:
+                from nanofed_tpu.adapters import make_adapter_apply, merge_adapters
+                from nanofed_tpu.parallel.round_step import FrozenBase
+
+                self._frozen_base = FrozenBase(
+                    base_like=params_host,
+                    bind=lambda base_full: make_adapter_apply(
+                        model.apply, adapter, base_full
+                    ),
+                )
+                # Merge for eval / versioned models: one jit, reused; the
+                # output placement follows the base leaves, so on a 2-D mesh a
+                # merged copy only materializes where a consumer asks for it.
+                # fedlint: disable=FED004 (merge must NOT donate: base_params and the live adapter tree are reused for the next round's dispatch)
+                self._merge_jit = jax.jit(
+                    lambda base, ad: merge_adapters(base, ad, adapter)
+                )
             self._round_step = build_round_step(
                 model.apply, self.training, self.mesh, self.strategy, grad_fn=grad_fn,
                 local_fit=local_fit, central_privacy=central_privacy,
                 validation=validation, robust=robust, client_chunk=client_chunk,
                 params_like=self.params, donate=True,
+                frozen_base=self._frozen_base,
             )
         # Fused multi-round execution: R rounds as one scanned device program,
         # host sync only at block boundaries.  Falls back to the single-round path
@@ -520,6 +586,7 @@ class Coordinator:
                     # mask exactly as _train_block builds it.
                     cohort_mode=self._cohort_mode,
                     donate=True,
+                    frozen_base=self._frozen_base,
                 )
         # Compiled-program cost catalog (observability.profiling): every program
         # this coordinator built, registered with LAZY dispatch-shaped argument
@@ -626,6 +693,18 @@ class Coordinator:
                 devices=len(jax.devices()),
                 num_clients=self.num_clients,
             )
+            if self.adapter is not None:
+                # The adapter record (digested by metrics-summary): rank,
+                # trainable-vs-frozen sizes, and the ANALYTIC payload ratio —
+                # the measured wire-bytes comparison is appended by whatever
+                # harness actually moves bytes (adapters.evidence, loadgen).
+                from nanofed_tpu.adapters import adapter_param_count
+
+                self.telemetry.record(
+                    "adapter",
+                    **self.adapter.to_dict(),
+                    **adapter_param_count(self.adapter, self._adapter_base_host),
+                )
         self._tracer = (
             self.telemetry.tracer
             if self.telemetry is not None
@@ -794,6 +873,23 @@ class Coordinator:
                 "scaffold_round_step", self._round_step,
                 args_factory=_scaffold_args, attrs=attrs,
             )
+        elif self.adapter is not None:
+            # The adapter program is costed under its own name so autotune /
+            # profile tables carry the adapter row next to the dense one; the
+            # frozen base enters the lowered signature exactly as dispatched.
+            attrs = {**attrs, "adapter_rank": self.adapter.rank}
+
+            def _adapter_step_args():
+                data, weights, rngs, lr = _step_common()
+                return (
+                    self.params, self.server_state, self.base_params,
+                    data, weights, rngs, lr,
+                ), {}
+
+            self.program_catalog.register(
+                "adapter_round_step", self._round_step,
+                args_factory=_adapter_step_args, attrs=attrs,
+            )
         else:
             def _step_args():
                 data, weights, rngs, lr = _step_common()
@@ -816,13 +912,17 @@ class Coordinator:
                     jnp.zeros((rpb, n), jnp.int32) if self._cohort_mode else None
                 )
                 mask = jnp.zeros((rpb, n), jnp.float32)
+                # The inner jit takes the frozen base as its LAST positional
+                # (None on dense programs — an empty pytree to the lowering).
                 return (
                     self.params, self.server_state, self._data,
-                    self._num_samples, keys, lr, idx, mask,
+                    self._num_samples, keys, lr, idx, mask, self.base_params,
                 ), {}
 
             self.program_catalog.register(
-                "round_block", self._round_block, args_factory=_block_args,
+                "adapter_round_block" if self.adapter is not None
+                else "round_block",
+                self._round_block, args_factory=_block_args,
                 rounds=self.config.rounds_per_block,
                 attrs={**attrs, "rounds_per_block": self.config.rounds_per_block},
             )
@@ -887,6 +987,9 @@ class Coordinator:
             lead(self._data, n),
             jax.ShapeDtypeStruct((n,), jnp.float32),
             rngs_sds,
+            # Adapter mode: the frozen base enters the traced signature but is
+            # absent from the fixed-point check (read-only boundary data).
+            frozen_base=self.base_params,
         )
         self._log.info("strict: round_step contract ok (%s)", report)
         if self._round_block is not None:
@@ -907,6 +1010,7 @@ class Coordinator:
                     if self._cohort_mode else None
                 ),
                 cohort_mask=jax.ShapeDtypeStruct((rpb, n), jnp.float32),
+                frozen_base=self.base_params,
             )
             self._log.info("strict: round_block contract ok (%s)", report)
         from nanofed_tpu.parallel.mesh import HOST_AXIS
@@ -914,6 +1018,7 @@ class Coordinator:
         check_input_shardings(
             self._data, self.params, axis_name=CLIENT_AXIS,
             model_axis=MODEL_AXIS, host_axis=HOST_AXIS,
+            base_params=self.base_params,
         )
 
     def _dispatch_guard(self):
@@ -969,6 +1074,13 @@ class Coordinator:
                     self.telemetry is not None
                     and self.current_round >= self.config.num_rounds
                 ):
+                    if self.adapter is not None:
+                        # Final merge count: how many times the run paid the
+                        # full-model merge (evals + versioned models).
+                        self.telemetry.record(
+                            "adapter", rank=self.adapter.rank,
+                            merges=self._merge_count,
+                        )
                     self.telemetry.close()
 
     def _publish_round(self, metrics: RoundMetrics, persist_state: bool = True) -> None:
@@ -1036,13 +1148,20 @@ class Coordinator:
             and persist_state
             and metrics.status == RoundStatus.COMPLETED
         ):
-            self.model_manager.save_model(
-                persist_params,
-                metadata={
-                    "round": metrics.round_id,
-                    "metrics": metrics.agg_metrics,
-                },
-            )
+            save_params = persist_params
+            metadata = {
+                "round": metrics.round_id,
+                "metrics": metrics.agg_metrics,
+            }
+            if self.adapter is not None:
+                # A versioned model must be runnable by a consumer who knows
+                # nothing of adapters: publish the MERGED params (checkpoints,
+                # by contrast, stay adapter-shaped — resume needs the adapter
+                # tree, and the base is re-derivable from the model seed).
+                # fedlint: disable=FED001 (block-boundary gather of the merged model for the versioned-model artifact)
+                save_params = jax.device_get(self.merged_params())
+                metadata["adapter"] = self.adapter.to_dict()
+            self.model_manager.save_model(save_params, metadata=metadata)
 
     def _sample_cohort(self, round_id: int) -> np.ndarray:
         """Draw this round's participant cohort (replaces the HTTP wait barrier),
@@ -1251,6 +1370,7 @@ class Coordinator:
                 result = self._round_block(
                     self.params, self.server_state, self._data,
                     self._num_samples, base_keys, lr_dev, idx_dev, mask_dev,
+                    base_params=self.base_params,
                 )
             self.params = result.params
             self.server_state = result.server_opt_state
@@ -1307,10 +1427,13 @@ class Coordinator:
                     and (r + 1) % cfg.eval_every == 0
                 ):
                     # Only ever the block's LAST round (_block_len cuts blocks at
-                    # eval boundaries), so self.params IS this round's model.
+                    # eval boundaries), so self.params IS this round's model
+                    # (merged with the frozen base in adapter mode).
                     eval_metrics = {
                         k: float(v)
-                        for k, v in self._evaluator(self.params, self._eval_data).items()
+                        for k, v in self._evaluator(
+                            self.merged_params(), self._eval_data
+                        ).items()
                     }
                 self._log.info(
                     "round %d: loss=%.4f acc=%.4f clients=%d (fused %d-round "
@@ -1490,6 +1613,12 @@ class Coordinator:
                     # not a scatter (which GSPMD may lower with cross-device index
                     # traffic).
                     self.c_stack = self._add_controls(self.c_stack, result.delta_c)
+            elif self.adapter is not None:
+                with self._dispatch_guard():
+                    result = self._round_step(
+                        self.params, self.server_state, self.base_params,
+                        data, weights, rngs, lr_dev,
+                    )
             else:
                 with self._dispatch_guard():
                     result = self._round_step(
@@ -1531,7 +1660,9 @@ class Coordinator:
             ):
                 eval_metrics = {
                     k: float(v)
-                    for k, v in self._evaluator(self.params, self._eval_data).items()
+                    for k, v in self._evaluator(
+                        self.merged_params(), self._eval_data
+                    ).items()
                 }
 
         # Per-client detail for the metrics file (parity: coordinator.py:247-280).  Only
@@ -1623,11 +1754,24 @@ class Coordinator:
             return None
         return self.privacy_accountant.get_privacy_spent(self.central_privacy.privacy.delta)
 
+    def merged_params(self) -> Params:
+        """The model the outside world consumes: ``self.params`` directly, or —
+        in adapter mode — base + low-rank deltas merged into ordinary params
+        (``nanofed_tpu.adapters.merge_adapters``, one jitted call).  Every merge
+        is counted (the ``adapter`` telemetry record reports the total): merging
+        is the only place adapter federation pays a full-model-sized compute,
+        so the count is the knob's honest cost surface."""
+        if self.adapter is None:
+            return self.params
+        self._merge_count += 1
+        return self._merge_jit(self.base_params, self.params)
+
     def evaluate(self) -> dict[str, float]:
         if self._evaluator is None:
             raise NanoFedError("no eval_data was provided to the Coordinator")
         return {
-            k: float(v) for k, v in self._evaluator(self.params, self._eval_data).items()
+            k: float(v)
+            for k, v in self._evaluator(self.merged_params(), self._eval_data).items()
         }
 
     def _save_round_metrics(self, metrics: RoundMetrics) -> None:

@@ -105,6 +105,7 @@ def build_round_block(
     cohort_mode: bool | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
+    frozen_base=None,
 ) -> RoundBlockFn:
     """Build the fused R-round block function.
 
@@ -171,16 +172,21 @@ def build_round_block(
     # Same floor the single-round coordinator applies before dispatching a round.
     required = max(1, math.ceil(cohort_size * min_completion_rate))
 
+    # Frozen-base rounds (adapters): the base is a LOOP-INVARIANT input of the
+    # scanned program — it enters the jit once, feeds every scanned round
+    # through the shard_map boundary, and is never part of the carry (so a
+    # fused block's carry stays adapter-sized, not model-sized).
     sharded = build_sharded_round(
         apply_fn, training, mesh, strategy,
         grad_fn=grad_fn, local_fit=local_fit, validation=validation,
         client_chunk=client_chunk, params_like=params_like, axis_name=axis_name,
+        frozen_base=frozen_base,
     )
     # Joint (hosts, clients) spec on a 3-axis mesh: the in-scan cohort gather's
     # result must land in the same layout the data rides, host rows intact.
     csh = client_sharding(mesh, axis_name)
 
-    def one_round(data, num_samples, carry, xs):
+    def one_round(data, num_samples, base_params, carry, xs):
         gp, sos = carry
         base, lr_scale, idx, mask = xs
         device_sampled = mask is None
@@ -227,10 +233,16 @@ def build_round_block(
             weights = compute_weights(num_samples, mask_eff)
         data_r = jax.tree.map(lambda x: lax.with_sharding_constraint(x, csh), data_r)
         noise_rng = jax.random.fold_in(rngs[0], 0x5EED)
-        gp, sos, metrics, client_metrics, sq_norms = sharded(
-            gp, sos, data_r, weights, rngs, noise_rng,
-            jnp.asarray(lr_scale, jnp.float32),
-        )
+        if frozen_base is not None:
+            gp, sos, metrics, client_metrics, sq_norms = sharded(
+                gp, sos, base_params, data_r, weights, rngs, noise_rng,
+                jnp.asarray(lr_scale, jnp.float32),
+            )
+        else:
+            gp, sos, metrics, client_metrics, sq_norms = sharded(
+                gp, sos, data_r, weights, rngs, noise_rng,
+                jnp.asarray(lr_scale, jnp.float32),
+            )
         ys: dict[str, Any] = {"metrics": metrics, "survivors": survivors}
         if collect_client_detail:
             ys["client_metrics"] = client_metrics
@@ -243,11 +255,12 @@ def build_round_block(
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def _block(
         global_params, server_opt_state, data, num_samples, base_keys, lr_scales,
-        cohort_idx, cohort_mask,
+        cohort_idx, cohort_mask, base_params,
     ):
         xs = (base_keys, jnp.asarray(lr_scales, jnp.float32), cohort_idx, cohort_mask)
         (gp, sos), ys = lax.scan(
-            partial(one_round, data, num_samples), (global_params, server_opt_state),
+            partial(one_round, data, num_samples, base_params),
+            (global_params, server_opt_state),
             xs,
         )
         return gp, sos, ys
@@ -261,15 +274,21 @@ def build_round_block(
         lr_scales: jax.Array,
         cohort_idx: jax.Array | None = None,
         cohort_mask: jax.Array | None = None,
+        base_params: Params | None = None,
     ) -> RoundBlockResult:
         if (cohort_mask is None) != (cohort_idx is None) and cohort_mode:
             raise ValueError(
                 "pass BOTH cohort_idx and cohort_mask (host-sampled cohorts) or "
                 "NEITHER (on-device resampling)"
             )
+        if (base_params is None) != (frozen_base is None):
+            raise ValueError(
+                "base_params must be passed exactly when the block was built "
+                "with frozen_base= (the frozen-base/adapter program)"
+            )
         gp, sos, ys = _block(
             global_params, server_opt_state, data, num_samples, base_keys,
-            lr_scales, cohort_idx, cohort_mask,
+            lr_scales, cohort_idx, cohort_mask, base_params,
         )
         return RoundBlockResult(
             params=gp,

@@ -46,6 +46,26 @@ from nanofed_tpu.trainer.local import GradFn, make_local_fit
 from nanofed_tpu.utils.trees import tree_clip_by_global_norm, tree_sq_norm, tree_where
 
 
+class FrozenBase(NamedTuple):
+    """Frozen-base round programs (parameter-efficient federation,
+    ``nanofed_tpu.adapters``): the federated ``global_params`` are the small
+    TRAINABLE tree (LoRA adapters) while the base model crosses the shard_map
+    boundary as an extra, NEVER-UPDATED input — model-sharded on a 2-D/3-D mesh
+    exactly like params (one all-gather over the model axis per round feeds the
+    per-client compute), absent from the params/opt-state fixed point, and
+    never donated (the caller re-passes the same buffers every round).
+
+    ``base_like`` supplies the per-leaf shapes for the boundary specs (concrete
+    or abstract); ``bind(base_full)`` receives the gathered full base INSIDE
+    the round body and must return an apply with the zoo signature
+    ``apply(trainable_params, x, *, train=..., rng=...)`` — for adapters,
+    :func:`nanofed_tpu.adapters.make_adapter_apply` partially applied to the
+    spec."""
+
+    base_like: Params
+    bind: Callable[[Params], Callable[..., jax.Array]]
+
+
 class RoundStepResult(NamedTuple):
     params: Params  # new global params (replicated over clients; model-sharded on a 2-D mesh)
     server_opt_state: Any  # server optimizer state (same layout as params)
@@ -70,6 +90,7 @@ def build_sharded_round(
     client_chunk: int | None = None,
     params_like: Params | None = None,
     axis_name: str = CLIENT_AXIS,
+    frozen_base: FrozenBase | None = None,
 ) -> Callable:
     """Build the UN-jitted ``shard_map`` round program.
 
@@ -170,12 +191,25 @@ def build_sharded_round(
             "pass either grad_fn (used to build the default local fit) or a complete "
             "local_fit, not both — a supplied local_fit ignores grad_fn"
         )
-    local_fit = local_fit or make_local_fit(apply_fn, training, grad_fn=grad_fn)
-    # Per-round lr scheduling rides a TRACED scalar (one compiled program; see
-    # trainer.schedules).  A custom local_fit that doesn't declare support simply
-    # trains unscaled — the Coordinator refuses a non-constant schedule in that case
-    # rather than silently ignoring it.
-    fit_takes_lr_scale = getattr(local_fit, "supports_lr_scale", False)
+    if frozen_base is not None:
+        if local_fit is not None or grad_fn is not None:
+            # The bound apply only exists INSIDE the round body (it closes over
+            # the gathered base), so a build-time fit/grad override could never
+            # see the base it needs — refuse rather than train a base-blind fit.
+            raise ValueError(
+                "frozen_base= builds the local fit from bind(gathered_base) "
+                "inside the round body; a custom local_fit/grad_fn cannot "
+                "close over the base and is refused"
+            )
+        base_specs = layout.boundary_specs(frozen_base.base_like)
+        fit_takes_lr_scale = True  # make_local_fit always supports lr_scale
+    else:
+        local_fit = local_fit or make_local_fit(apply_fn, training, grad_fn=grad_fn)
+        # Per-round lr scheduling rides a TRACED scalar (one compiled program; see
+        # trainer.schedules).  A custom local_fit that doesn't declare support simply
+        # trains unscaled — the Coordinator refuses a non-constant schedule in that
+        # case rather than silently ignoring it.
+        fit_takes_lr_scale = getattr(local_fit, "supports_lr_scale", False)
     server_tx = strategy.server_tx
     # The optimizer-state layout follows the same per-leaf rule as params —
     # abstract init only (eval_shape), nothing materializes here.
@@ -284,7 +318,8 @@ def build_sharded_round(
             (weights > 0).sum())
         return new_gp, new_sos, metrics, client_metrics, sq_norms
 
-    def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale):
+    def shard_body(gp, sos, data: ClientData, weights, rngs, noise_rng, lr_scale,
+                   base=None):
         if raw_keys_at_boundary:
             rngs = jax.random.wrap_key_data(rngs)
             noise_rng = jax.random.wrap_key_data(noise_rng)
@@ -295,12 +330,22 @@ def build_sharded_round(
         # gp arrives replicated (unvarying); the per-client scan carry inside local_fit is
         # device-varying, so cast explicitly for the vmapped compute path.
         gp_v = layout.cast_varying(gp_full)
+        if frozen_base is not None:
+            # Frozen base (adapters): gather the base's model shards ONCE per
+            # round — same FSDP boundary rule as params — and bind it into the
+            # per-client fit.  The base is read-only: it appears in no output,
+            # carries no optimizer state, and the server update never touches it.
+            base_full = layout.gather_full(base, base_specs)
+            base_v = layout.cast_varying(base_full)
+            round_fit = make_local_fit(frozen_base.bind(base_v), training)
+        else:
+            round_fit = local_fit
         # The schedule scale is replicated data closed over by the per-client fit (the
         # same scalar for every client in the round).
         fit = (
-            (lambda g, d, r: local_fit(g, d, r, lr_scale=lr_scale))
+            (lambda g, d, r: round_fit(g, d, r, lr_scale=lr_scale))
             if fit_takes_lr_scale
-            else local_fit
+            else round_fit
         )
         c_local = rngs.shape[0]
         chunking = client_chunk is not None and client_chunk < c_local
@@ -432,6 +477,36 @@ def build_sharded_round(
     # (identical on every model column by construction — see
     # multi_axis_shard_map_kwargs for why the checker is off there).
     dspec = layout.data_spec
+    if frozen_base is not None:
+        # The frozen base enters as an EXTRA shard_map operand in the params
+        # layout (model-sharded on multi-axis meshes) and leaves in no output —
+        # it is boundary data, not round state.
+        def body_with_base(gp, sos, base, data, weights, rngs, noise_rng, lr_scale):
+            return shard_body(
+                gp, sos, data, weights, rngs, noise_rng, lr_scale, base=base
+            )
+
+        inner = shard_map(
+            body_with_base,
+            mesh=mesh,
+            in_specs=(
+                params_specs, sos_specs, base_specs, dspec, dspec, dspec, P(), P()
+            ),
+            out_specs=(params_specs, sos_specs, P(), dspec, dspec),
+            **multi_axis_shard_map_kwargs(mesh),
+        )
+        if not raw_keys_at_boundary:
+            return inner
+
+        def sharded_base(gp, sos, base, data, weights, rngs, noise_rng, lr_scale):
+            if jnp.issubdtype(jnp.asarray(rngs).dtype, jax.dtypes.prng_key):
+                rngs = jax.random.key_data(rngs)
+            if jnp.issubdtype(jnp.asarray(noise_rng).dtype, jax.dtypes.prng_key):
+                noise_rng = jax.random.key_data(noise_rng)
+            return inner(gp, sos, base, data, weights, rngs, noise_rng, lr_scale)
+
+        return sharded_base
+
     inner = shard_map(
         shard_body,
         mesh=mesh,
@@ -466,6 +541,7 @@ def build_round_step(
     params_like: Params | None = None,
     axis_name: str = CLIENT_AXIS,
     donate: bool = False,
+    frozen_base: FrozenBase | None = None,
 ) -> RoundStepFn:
     """Compile the single-round function for a mesh.
 
@@ -483,13 +559,45 @@ def build_round_step(
     ``donate=True`` donates the params/opt-state buffers to the compiled call (saves one
     params-sized HBM copy per round) — the caller must then treat the inputs as consumed
     and keep only the returned arrays, as ``Coordinator`` does.
+
+    ``frozen_base`` (:class:`FrozenBase` — the adapters subsystem's hook) changes
+    the signature to ``round_step(trainable_params, server_opt_state,
+    base_params, data, weights, rngs, lr_scale)``: the base crosses as an extra
+    NEVER-donated input (the caller re-passes the same device buffers every
+    round), appears in no output, and the per-client fit is built from
+    ``frozen_base.bind(gathered_base)`` inside the program.
     """
     sharded = build_sharded_round(
         apply_fn, training, mesh, strategy,
         grad_fn=grad_fn, local_fit=local_fit, central_privacy=central_privacy,
         validation=validation, robust=robust, client_chunk=client_chunk,
-        params_like=params_like, axis_name=axis_name,
+        params_like=params_like, axis_name=axis_name, frozen_base=frozen_base,
     )
+
+    if frozen_base is not None:
+        # Donation still covers only the TRAINABLE state (argnums 0/1): the base
+        # is reused verbatim every round, so donating it would free the one
+        # buffer the whole federation depends on.
+        @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        def adapter_round_step(
+            global_params: Params,
+            server_opt_state: Any,
+            base_params: Params,
+            data: ClientData,
+            weights: jax.Array,
+            rngs: PRNGKey,
+            lr_scale: jax.Array | float = 1.0,
+        ) -> RoundStepResult:
+            noise_rng = jax.random.fold_in(rngs[0], 0x5EED)
+            lr_scale = jnp.asarray(lr_scale, jnp.float32)
+            gp, sos, metrics, client_metrics, sq_norms = sharded(
+                global_params, server_opt_state, base_params, data, weights,
+                rngs, noise_rng, lr_scale,
+            )
+            return RoundStepResult(gp, sos, metrics, client_metrics, sq_norms)
+
+        adapter_round_step.jit_program = adapter_round_step
+        return adapter_round_step
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def round_step(

@@ -66,7 +66,11 @@ def make_grad_fn(
     def loss_fn(params, xb, yb, mb, rng):
         if cdt is not None:
             params = jax.tree.map(lambda p: p.astype(cdt), params)
-            xb = xb.astype(cdt)
+            # Integer inputs (token-id streams) must stay integer: they index an
+            # embedding table, and casting ids to bf16 would corrupt the lookup.
+            # fedlint: disable=FED002 (branches on xb.dtype — static trace-time metadata, not a traced value; both arms compile into one program)
+            if jnp.issubdtype(xb.dtype, jnp.floating):
+                xb = xb.astype(cdt)
         logp = apply_fn(params, xb, train=True, rng=rng).astype(jnp.float32)
         nll = -jnp.take_along_axis(logp, yb[:, None], axis=-1)[:, 0]
         count = mb.sum()

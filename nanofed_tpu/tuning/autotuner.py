@@ -127,20 +127,26 @@ class CandidateConfig:
     deterministic last-resort tie-break is the dataclass ordering itself.
     ``hosts`` (default 1: every pre-multi-host candidate) is the hosts-axis
     size of the mesh the candidate lowers on — >1 builds the 3-axis
-    ``hosts x clients x model`` mesh with hierarchical aggregation."""
+    ``hosts x clients x model`` mesh with hierarchical aggregation.
+    ``adapter_rank`` (default None: dense full fine-tune) lowers the
+    parameter-efficient frozen-base round program at that LoRA rank — the
+    federated/aggregated tree is the adapter tree, the base crosses as a
+    read-only model-sharded input (``nanofed_tpu.adapters``)."""
 
     client_chunk: int | None
     rounds_per_block: int
     model_shards: int
     batch_size: int
     hosts: int = 1
+    adapter_rank: int | None = None
 
     @property
-    def key(self) -> tuple[int, int, int, int, int]:
-        """Stable sort key (``None`` chunk orders first as 0)."""
+    def key(self) -> tuple[int, int, int, int, int, int]:
+        """Stable sort key (``None`` chunk/rank order first as 0)."""
         return (
             self.client_chunk or 0, self.rounds_per_block,
             self.model_shards, self.batch_size, self.hosts,
+            self.adapter_rank or 0,
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -150,6 +156,7 @@ class CandidateConfig:
             "model_shards": self.model_shards,
             "batch_size": self.batch_size,
             "hosts": self.hosts,
+            "adapter_rank": self.adapter_rank,
         }
 
     @classmethod
@@ -160,6 +167,7 @@ class CandidateConfig:
             model_shards=int(d["model_shards"]),
             batch_size=int(d["batch_size"]),
             hosts=int(d.get("hosts", 1)),
+            adapter_rank=d.get("adapter_rank"),
         )
 
 
@@ -190,6 +198,11 @@ class TuningSpace:
     #: a flat mesh across processes would pay one DCN reduce per client shard,
     #: so the hierarchical topology is the only sensible default there.
     hosts: tuple[int, ...] = (1,)
+    #: LoRA ranks to sweep (the parameter-efficient axis); (None,) = dense
+    #: full fine-tune only.  Engaged when :func:`autotune` is given an
+    #: ``adapter=`` spec: the default becomes a ladder around the spec's rank
+    #: (rank/2, rank, 2*rank), every candidate frozen-base.
+    adapter_ranks: tuple[int | None, ...] = (None,)
 
     @classmethod
     def default(
@@ -199,6 +212,7 @@ class TuningSpace:
         batch_size: int,
         num_rounds: int,
         hosts: tuple[int, ...] | None = None,
+        adapter_rank: int | None = None,
     ) -> "TuningSpace":
         from nanofed_tpu.parallel.mesh import pad_client_count
 
@@ -223,12 +237,20 @@ class TuningSpace:
             b for b in (batch_size // 2, batch_size, batch_size * 2)
             if 1 <= b <= population.capacity and population.capacity % b == 0
         })) or (batch_size,)
+        # THE one home of the adapter-rank space rule: with a spec'd rank r the
+        # sweep covers the ladder {max(1, r//2), r, 2r} — enough to show where
+        # rank stops paying without exploding the cross product.
+        ranks: tuple[int | None, ...] = (None,)
+        if adapter_rank is not None:
+            ranks = tuple(sorted({max(1, adapter_rank // 2), adapter_rank,
+                                  2 * adapter_rank}))
         return cls(
             client_chunks=tuple(chunks),
             rounds_per_blocks=rpbs,
             model_shards=shards,
             batch_sizes=batches,
             hosts=tuple(hosts),
+            adapter_ranks=ranks,
         )
 
     def candidates(self) -> list[CandidateConfig]:
@@ -238,7 +260,10 @@ class TuningSpace:
                 for shards in self.model_shards:
                     for b in self.batch_sizes:
                         for h in self.hosts:
-                            out.append(CandidateConfig(chunk, rpb, shards, b, h))
+                            for r in self.adapter_ranks:
+                                out.append(
+                                    CandidateConfig(chunk, rpb, shards, b, h, r)
+                                )
         return sorted(set(out), key=lambda c: c.key)
 
     def to_dict(self) -> dict[str, Any]:
@@ -248,6 +273,7 @@ class TuningSpace:
             "model_shards": list(self.model_shards),
             "batch_sizes": list(self.batch_sizes),
             "hosts": list(self.hosts),
+            "adapter_ranks": list(self.adapter_ranks),
         }
 
 
@@ -453,6 +479,7 @@ def compute_cache_key(
     device_kind: str,
     num_devices: int,
     hbm_budget: int | None = None,
+    adapter: Any = None,
 ) -> str:
     """SHA-256 over everything that changes a sweep's outcome: model fingerprint,
     population shapes, the swept space, the non-swept training dims that shape
@@ -461,9 +488,10 @@ def compute_cache_key(
     candidates are rejected, hence the winner).  Learning RATE is deliberately
     excluded — it never changes the compiled program's cost."""
     payload = {
-        # v3: the swept space (and CandidateConfig) grew the hosts axis — any
-        # pre-hosts cache entry must miss.
-        "v": 3,
+        # v4: the swept space (and CandidateConfig) grew the adapter-rank axis
+        # — any pre-adapter cache entry must miss.  (v3 added the hosts axis.)
+        "v": 4,
+        "adapter": adapter.to_dict() if adapter is not None else None,
         "hbm_budget": hbm_budget,
         "model": _model_fingerprint(model),
         "population": population.to_dict(),
@@ -520,6 +548,7 @@ def _evaluate_candidate(
     eval_every: int,
     n_devices: int,
     budget: int | None,
+    adapter: Any = None,
 ) -> CandidateOutcome:
     """Lower + compile ONE candidate's round program with fully abstract
     (ShapeDtypeStruct) arguments in the dispatch shardings and score its cost
@@ -610,6 +639,12 @@ def _evaluate_candidate(
             "silently no-op; shrink the chunk or the hosts axis"
         ))
 
+    if cand.adapter_rank is not None and adapter is None:
+        return CandidateOutcome(cand, False, reject_reason=(
+            f"adapter_rank {cand.adapter_rank} swept without an adapter= spec "
+            "— the tuner needs the target patterns to build the adapter tree"
+        ))
+
     # --- Build + lower (compile; nothing executes) ---------------------------
     training_c = dc.replace(training, batch_size=cand.batch_size)
     if cand.hosts > 1:
@@ -618,7 +653,30 @@ def _evaluate_candidate(
         mesh = make_mesh(shape=(n_cs, cand.model_shards))
     else:
         mesh = make_mesh()
-    params_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    base_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    frozen_base = None
+    base_sds = None
+    if cand.adapter_rank is not None:
+        from nanofed_tpu.adapters import (
+            init_adapters,
+            make_adapter_apply,
+        )
+        from nanofed_tpu.parallel.round_step import FrozenBase
+
+        spec_r = dc.replace(adapter, rank=cand.adapter_rank)
+        # The federated tree IS the adapter tree at this rank; the base enters
+        # the lowered signature as the read-only frozen input, model-sharded in
+        # the candidate's layout — the costed program is the dispatched one.
+        # init_adapters only reads shapes from the base tree, so it accepts the
+        # abstract base directly; the (tiny) concrete A/B arrays it returns are
+        # reduced to ShapeDtypeStructs below like every other lowering input.
+        params_abs = init_adapters(spec_r, base_abs, rng=0)
+        frozen_base = FrozenBase(
+            base_like=base_abs,
+            bind=lambda bf: make_adapter_apply(model.apply, spec_r, bf),
+        )
+    else:
+        params_abs = base_abs
     strategy = fedavg_strategy()
     sos_abs = jax.eval_shape(lambda p: init_server_state(strategy, p), params_abs)
 
@@ -630,6 +688,8 @@ def _evaluate_candidate(
 
     params_sds = _sharded_sds(params_abs, param_sharding(mesh, params_abs))
     sos_sds = _sharded_sds(sos_abs, param_sharding(mesh, sos_abs))
+    if frozen_base is not None:
+        base_sds = _sharded_sds(base_abs, param_sharding(mesh, base_abs))
     csh = client_sharding(mesh)
 
     def _data_sds(rows: int) -> ClientData:
@@ -649,19 +709,22 @@ def _evaluate_candidate(
     name = (
         f"cand_chunk{cand.client_chunk or 0}_rpb{cand.rounds_per_block}"
         f"_m{cand.model_shards}_b{cand.batch_size}_h{cand.hosts}"
+        + (f"_r{cand.adapter_rank}" if cand.adapter_rank is not None else "")
     )
     try:
         if cand.rounds_per_block == 1:
             fn = build_round_step(
                 model.apply, training_c, mesh, strategy,
                 client_chunk=cand.client_chunk, params_like=params_abs,
-                donate=True,
+                donate=True, frozen_base=frozen_base,
             )
             rngs_sds = jax.eval_shape(
                 lambda: stack_rngs(jax.random.key(0), step_clients)
             )
             args = (
-                params_sds, sos_sds, _data_sds(step_clients),
+                params_sds, sos_sds,
+                *((base_sds,) if frozen_base is not None else ()),
+                _data_sds(step_clients),
                 jax.ShapeDtypeStruct((step_clients,), jnp.float32),
                 rngs_sds, jax.ShapeDtypeStruct((), jnp.float32),
             )
@@ -673,7 +736,7 @@ def _evaluate_candidate(
                 step_clients=step_clients, cohort_size=cohort,
                 client_chunk=cand.client_chunk, params_like=params_abs,
                 collect_client_detail=False, cohort_mode=cohort_mode,
-                donate=True,
+                donate=True, frozen_base=frozen_base,
             )
             keys_sds = jax.eval_shape(
                 lambda: stack_round_keys(0, list(range(rpb)))
@@ -688,6 +751,9 @@ def _evaluate_candidate(
                 keys_sds, jax.ShapeDtypeStruct((rpb,), jnp.float32),
                 idx_sds,
                 jax.ShapeDtypeStruct((rpb, step_clients), jnp.float32),
+                # The inner jit's last positional: the frozen base (None on
+                # dense candidates — an empty pytree to the lowering).
+                base_sds,
             )
         report = profile_program(
             name, fn, *args, rounds=cand.rounds_per_block,
@@ -754,6 +820,7 @@ def autotune(
     telemetry: Any = None,
     force: bool = False,
     include_epilogues: bool = True,
+    adapter: Any = None,
 ) -> AutotuneResult:
     """Sweep the round-program configuration space with the compiler's cost
     model; returns the ranked :class:`AutotuneResult` (winner first).
@@ -765,6 +832,14 @@ def autotune(
     device kind/count) — a cache hit compiles nothing; ``force=True`` re-sweeps.
     Raises :class:`AutotuneError` when every candidate is rejected (the artifact
     is still written first).
+
+    ``adapter`` (an :class:`~nanofed_tpu.adapters.AdapterSpec`) engages the
+    parameter-efficient axis: the default space sweeps LoRA rank over the
+    ladder {rank/2, rank, 2*rank}, every candidate lowers the frozen-base
+    round program (the federated tree is the adapter tree, the base a
+    read-only model-sharded input), and the epilogue cost table is sized to
+    the ADAPTER payload (the flattened client stack the q8 dequant-accumulate
+    epilogue would actually reduce in adapter mode).
     """
     import jax
 
@@ -778,14 +853,17 @@ def autotune(
     device_kind = str(getattr(devices[0], "device_kind", platform))
     n_devices = len(devices)
     if space is None:
-        # TuningSpace.default owns the multi-process hosts-axis rule.
+        # TuningSpace.default owns the multi-process hosts-axis rule AND the
+        # adapter-rank ladder rule.
         space = TuningSpace.default(
-            population, n_devices, training.batch_size, num_rounds
+            population, n_devices, training.batch_size, num_rounds,
+            adapter_rank=adapter.rank if adapter is not None else None,
         )
     budget, budget_basis = resolve_hbm_budget(hbm_budget_bytes, devices)
     key = compute_cache_key(
         model, population, training, space, participation, num_rounds,
         eval_every, device_kind, n_devices, hbm_budget=budget,
+        adapter=adapter,
     )
 
     cache_path = (
@@ -810,7 +888,7 @@ def autotune(
     for cand in space.candidates():
         outcome = _evaluate_candidate(
             cand, model, population, training, participation, num_rounds,
-            eval_every, n_devices, budget,
+            eval_every, n_devices, budget, adapter=adapter,
         )
         if outcome.cost.get("compile_seconds") is not None:
             compiles += 1
@@ -852,11 +930,19 @@ def autotune(
         try:
             from nanofed_tpu.tuning.epilogues import profile_aggregation_epilogues
 
+            base_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+            if adapter is not None:
+                # The epilogue's client stack in adapter mode is the ADAPTER
+                # payload — the q8 dequant-accumulate row must be costed at
+                # the bytes that actually cross the serving tier.
+                from nanofed_tpu.adapters import init_adapters
+
+                epilogue_tree = init_adapters(adapter, base_abs, rng=0)
+            else:
+                epilogue_tree = base_abs
             flat = sum(
                 int(math.prod(leaf.shape) or 1)
-                for leaf in jax.tree.leaves(
-                    jax.eval_shape(lambda: model.init(jax.random.key(0)))
-                )
+                for leaf in jax.tree.leaves(epilogue_tree)
             )
             result.epilogues = profile_aggregation_epilogues(flat_size=flat)
         except Exception as e:  # the sweep result must not die on the side table
@@ -925,9 +1011,10 @@ def _finish(
 
 
 def format_candidate_table(result: AutotuneResult) -> str:
-    """Human-readable ranked table (what ``nanofed-tpu profile --sweep`` prints)."""
+    """Human-readable ranked table (what ``nanofed-tpu profile --sweep`` prints).
+    The ``lora`` column is the adapter rank ("-" = dense full fine-tune)."""
     rows = [(
-        "rank", "chunk", "rpb", "shards", "batch", "hosts", "score",
+        "rank", "chunk", "rpb", "shards", "batch", "hosts", "lora", "score",
         "peak bytes", "verdict",
     )]
     for i, o in enumerate(result.outcomes):
@@ -936,6 +1023,7 @@ def format_candidate_table(result: AutotuneResult) -> str:
             str(i + 1) if o.feasible else "-",
             str(c.client_chunk or "-"), str(c.rounds_per_block),
             str(c.model_shards), str(c.batch_size), str(c.hosts),
+            str(c.adapter_rank or "-"),
             f"{o.score:.4g}" if o.score is not None else "-",
             f"{o.cost.get('peak_bytes', 0):,}" if o.cost else "-",
             o.cost.get("verdict", o.reject_reason or "-")

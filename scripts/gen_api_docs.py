@@ -27,7 +27,9 @@ MODULES = [
               "nanofed_tpu.data.batching"]),
     ("models", ["nanofed_tpu.models.base", "nanofed_tpu.models.linear",
                 "nanofed_tpu.models.mnist", "nanofed_tpu.models.resnet",
-                "nanofed_tpu.nn"]),
+                "nanofed_tpu.models.transformer", "nanofed_tpu.nn"]),
+    ("adapters", ["nanofed_tpu.adapters.lora",
+                  "nanofed_tpu.adapters.evidence"]),
     ("trainer", ["nanofed_tpu.trainer.config", "nanofed_tpu.trainer.local",
                  "nanofed_tpu.trainer.private", "nanofed_tpu.trainer.scaffold",
                  "nanofed_tpu.trainer.schedules",

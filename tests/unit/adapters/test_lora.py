@@ -1,0 +1,188 @@
+"""Unit tests for the LoRA adapter algebra (``nanofed_tpu.adapters.lora``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.adapters import (
+    AdapterSpec,
+    adapter_delta,
+    adapter_param_count,
+    adapter_wire_ratio,
+    init_adapters,
+    make_adapter_apply,
+    merge_adapters,
+    target_paths,
+    unmerge_adapters,
+)
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.models import get_model
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+
+@pytest.fixture(scope="module")
+def mlp_base():
+    model = get_model("mlp", in_features=16, hidden=32, num_classes=4)
+    return model, model.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def transformer_base():
+    model = get_model(
+        "transformer_lm", vocab=32, seq_len=8, width=16, depth=2, heads=2
+    )
+    return model, model.init(jax.random.key(1))
+
+
+def test_spec_targets_2d_kernels_only(transformer_base):
+    _, base = transformer_base
+    spec = AdapterSpec(rank=2)
+    paths = target_paths(spec, base)
+    named = dict(tree_flatten_with_names(base)[0])
+    for p in paths:
+        assert p.endswith("kernel")
+        assert len(np.shape(named[p])) == 2
+    # biases, layer norms, and embeddings are never adapted by the default spec
+    assert not any("bias" in p or "ln" in p or "emb" in p for p in paths)
+
+
+def test_spec_min_dim_excludes_small_matrices(mlp_base):
+    _, base = mlp_base
+    # fc2 kernel is [32, 4]: min dim 4 < min_dim 8 -> only fc1 is adapted
+    spec = AdapterSpec(rank=2, min_dim=8)
+    assert target_paths(spec, base) == ["fc1/kernel"]
+
+
+def test_spec_no_match_raises(mlp_base):
+    _, base = mlp_base
+    with pytest.raises(NanoFedError, match="matches no leaf"):
+        target_paths(AdapterSpec(rank=2, targets=("*nonexistent*",)), base)
+
+
+def test_spec_validation():
+    with pytest.raises(NanoFedError):
+        AdapterSpec(rank=0)
+    with pytest.raises(NanoFedError):
+        AdapterSpec(rank=2, alpha=0.0)
+    with pytest.raises(NanoFedError):
+        AdapterSpec(rank=2, targets=())
+    assert AdapterSpec(rank=4).scaling == 1.0  # alpha defaults to rank
+    assert AdapterSpec(rank=4, alpha=8.0).scaling == 2.0
+
+
+def test_init_shapes_and_identity_start(transformer_base):
+    _, base = transformer_base
+    spec = AdapterSpec(rank=3)
+    ad = init_adapters(spec, base, rng=0)
+    named = dict(tree_flatten_with_names(ad)[0])
+    base_named = dict(tree_flatten_with_names(base)[0])
+    for path in target_paths(spec, base):
+        d_in, d_out = base_named[path].shape
+        assert named[f"{path}/A"].shape == (d_in, 3)
+        assert named[f"{path}/B"].shape == (3, d_out)
+        # B = 0: the LoRA identity start
+        np.testing.assert_array_equal(named[f"{path}/B"], 0.0)
+    merged = merge_adapters(base, ad, spec)
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(base)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_init_is_seed_deterministic(mlp_base):
+    _, base = mlp_base
+    spec = AdapterSpec(rank=2, min_dim=4)
+    a1 = init_adapters(spec, base, rng=7)
+    a2 = init_adapters(spec, base, rng=7)
+    a3 = init_adapters(spec, base, rng=8)
+    for x, y in zip(jax.tree.leaves(a1), jax.tree.leaves(a2)):
+        np.testing.assert_array_equal(x, y)
+    assert any(
+        not np.array_equal(x, y)
+        for x, y in zip(jax.tree.leaves(a1), jax.tree.leaves(a3))
+    )
+
+
+def test_merge_unmerge_round_trip(transformer_base):
+    _, base = transformer_base
+    spec = AdapterSpec(rank=2, alpha=4.0)
+    ad = init_adapters(spec, base, rng=0)
+    # give B real mass so the delta is nonzero
+    ad = jax.tree.map(lambda x: x + 0.05, ad)
+    merged = merge_adapters(base, ad, spec)
+    assert any(
+        not np.allclose(np.asarray(m), np.asarray(b))
+        for m, b in zip(jax.tree.leaves(merged), jax.tree.leaves(base))
+    )
+    back = unmerge_adapters(merged, ad, spec)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(base)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_adapter_delta_matches_merge(transformer_base):
+    _, base = transformer_base
+    spec = AdapterSpec(rank=2)
+    ad = jax.tree.map(lambda x: x + 0.03, init_adapters(spec, base, rng=0))
+    delta = adapter_delta(spec, base, ad)
+    merged = merge_adapters(base, ad, spec)
+    for d, m, b in zip(
+        jax.tree.leaves(delta), jax.tree.leaves(merged), jax.tree.leaves(base)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(d), np.asarray(m) - np.asarray(b), atol=1e-6
+        )
+
+
+def test_make_adapter_apply_equals_apply_of_merged(transformer_base):
+    model, base = transformer_base
+    spec = AdapterSpec(rank=2)
+    ad = jax.tree.map(lambda x: x + 0.02, init_adapters(spec, base, rng=0))
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 32, (4, 8)), jnp.int32)
+    bound = make_adapter_apply(model.apply, spec, base)
+    np.testing.assert_allclose(
+        np.asarray(bound(ad, x)),
+        np.asarray(model.apply(merge_adapters(base, ad, spec), x)),
+        atol=1e-6,
+    )
+
+
+def test_param_counts_and_wire_ratio(transformer_base):
+    _, base = transformer_base
+    spec = AdapterSpec(rank=2)
+    counts = adapter_param_count(spec, base)
+    named = dict(tree_flatten_with_names(base)[0])
+    want_trainable = sum(
+        2 * (named[p].shape[0] + named[p].shape[1])
+        for p in target_paths(spec, base)
+    )
+    assert counts["adapter_params"] == want_trainable
+    assert counts["base_params"] == sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(base)
+    )
+    assert adapter_wire_ratio(spec, base) == pytest.approx(
+        counts["base_params"] / counts["adapter_params"]
+    )
+
+
+def test_works_on_abstract_trees(transformer_base):
+    """Shapes-only operation: the autotuner lowers adapter candidates from
+    eval_shape output, never materializing the base."""
+    model, _ = transformer_base
+    base_abs = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    spec = AdapterSpec(rank=2)
+    ad = init_adapters(spec, base_abs, rng=0)
+    assert target_paths(spec, base_abs)
+    assert adapter_param_count(spec, base_abs)["adapter_params"] > 0
+    assert all(isinstance(x, np.ndarray) for x in jax.tree.leaves(ad))
+
+
+def test_adapter_tree_rides_checkpoint_layout(transformer_base):
+    """The adapter tree round-trips through the '/'-path npz codec like any
+    params tree — a captured adapter payload IS a loadable checkpoint."""
+    from nanofed_tpu.communication.codec import decode_params, encode_params
+
+    _, base = transformer_base
+    spec = AdapterSpec(rank=2)
+    ad = init_adapters(spec, base, rng=0)
+    out = decode_params(encode_params(ad), like=ad)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ad)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,154 @@
+"""Codec round-trip tests on ADAPTER-SHAPED pytrees (ISSUE 15 satellite).
+
+The existing codec regression tests cover dense MLP/CNN shapes; adapter trees
+are a different animal — many tiny ``[d, r]``/``[r, d]`` leaves next to one
+large embedding-sized leaf, nested one level deeper (``.../kernel/A``) — and
+the q8/topk encoders do per-leaf scale/top-k selection, so the shape mix is
+exactly where a per-leaf bug would hide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanofed_tpu.adapters import AdapterSpec, init_adapters
+from nanofed_tpu.communication.codec import (
+    decode_delta_q8,
+    decode_delta_topk8,
+    decode_params,
+    encode_delta_q8,
+    encode_delta_topk8,
+    encode_params,
+)
+from nanofed_tpu.models import get_model
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+RANK = 4
+WIDTH, VOCAB = 128, 1024
+
+
+@pytest.fixture(scope="module")
+def adapter_tree():
+    """Adapter-shaped delta: many small [d, r]/[r, d] pairs next to one large
+    unembedding-sized leaf (the head adapter's [r, vocab] B) — sized so
+    payload claims are not drowned by per-entry npz container overhead, which
+    a toy-width tree cannot amortize."""
+    model = get_model(
+        "transformer_lm", vocab=VOCAB, seq_len=8, width=WIDTH, depth=2, heads=4
+    )
+    base = model.init(jax.random.key(0))
+    spec = AdapterSpec(rank=RANK)
+    ad = init_adapters(spec, base, rng=0)
+    # Real-valued (non-zero-B) deltas, deterministic:
+    rng = np.random.default_rng(42)
+    return jax.tree.map(
+        lambda x: np.asarray(x) + rng.normal(0, 0.01, x.shape).astype(np.float32),
+        ad,
+    )
+
+
+def test_adapter_tree_shape_mix(adapter_tree):
+    """Precondition of this file's claim: small A/B leaves AND a large one."""
+    sizes = sorted(int(np.prod(x.shape)) for x in jax.tree.leaves(adapter_tree))
+    assert sizes[0] <= WIDTH * RANK
+    assert sizes[-1] >= VOCAB * RANK  # the head adapter's [r, vocab] B
+    assert len(sizes) > 10
+
+
+def test_plain_npz_round_trip(adapter_tree):
+    out = decode_params(encode_params(adapter_tree), like=adapter_tree)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(adapter_tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_q8_round_trip_bounded_per_leaf(adapter_tree):
+    """Per-leaf absmax scaling: every leaf's reconstruction error is bounded by
+    ITS OWN scale step — a tiny A leaf next to the big head leaf must not
+    inherit the big leaf's quantization grid."""
+    payload = encode_delta_q8(adapter_tree, seed=0)
+    out = decode_delta_q8(payload, like=adapter_tree)
+    for (name, want), (_, got) in zip(
+        tree_flatten_with_names(adapter_tree)[0], tree_flatten_with_names(out)[0]
+    ):
+        step = float(np.max(np.abs(want))) / 127.0
+        np.testing.assert_allclose(
+            np.asarray(got), want, atol=step + 1e-9, err_msg=name
+        )
+
+
+def test_q8_round_trip_bf16_template(adapter_tree):
+    bf16 = jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), adapter_tree)
+    out = decode_delta_q8(encode_delta_q8(adapter_tree, seed=0), like=bf16)
+    for got, want in zip(jax.tree.leaves(out), jax.tree.leaves(bf16)):
+        assert np.asarray(got).dtype == jnp.bfloat16
+        step = float(np.max(np.abs(np.asarray(want, np.float32)))) / 127.0
+        # one q8 step + bf16's ~8-bit mantissa (1/256 relative) of slack
+        bf16_ulp = float(np.max(np.abs(np.asarray(want, np.float32)))) / 128.0
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32),
+            np.asarray(want, dtype=np.float32),
+            atol=step + bf16_ulp + 1e-6,
+        )
+
+
+def test_topk8_round_trip_and_payload_drop(adapter_tree):
+    payload = encode_delta_topk8(adapter_tree, fraction=0.25, seed=0)
+    out = decode_delta_topk8(payload, like=adapter_tree)
+    # Dense reconstruction: zeros off the shipped coordinates, every leaf
+    # present, template dtypes/shapes respected.
+    for got, want in zip(jax.tree.leaves(out), jax.tree.leaves(adapter_tree)):
+        assert np.asarray(got).shape == want.shape
+        nz = np.asarray(got) != 0
+        # at least the per-leaf minimum of 1 coordinate shipped
+        assert nz.sum() >= 1
+    # The bytes win needs a SPARSE fraction: at 5% kept, 5 bytes/coordinate
+    # (uint32 idx + int8 val) beats q8's 1 byte on every coordinate; at 25%
+    # on tiny A/B leaves the index overhead can exceed the saving.
+    sparse = encode_delta_topk8(adapter_tree, fraction=0.05, seed=0)
+    assert len(sparse) < len(encode_delta_q8(adapter_tree, seed=0))
+
+
+def test_topk8_keeps_each_leafs_own_top_coordinates(adapter_tree):
+    """Selection is PER LEAF: a tiny A matrix still ships its locally-largest
+    coordinate even though the big head leaf dwarfs it globally."""
+    payload = encode_delta_topk8(adapter_tree, fraction=0.05, seed=0)
+    out = decode_delta_topk8(payload, like=adapter_tree)
+    for (name, want), (_, got) in zip(
+        tree_flatten_with_names(adapter_tree)[0], tree_flatten_with_names(out)[0]
+    ):
+        got = np.asarray(got).ravel()
+        top_idx = int(np.argmax(np.abs(want.ravel())))
+        assert got[top_idx] != 0.0, f"{name}: locally-largest coordinate dropped"
+
+
+def test_encode_params_gathers_2d_mesh_sharded_adapter_leaves(adapter_tree):
+    """Model-sharded adapter leaves off a 2-D clients x model mesh encode
+    correctly: jax.device_get performs the one well-defined gather (the
+    encode_params contract, extended to adapter trees)."""
+    from nanofed_tpu.parallel.mesh import make_mesh, shard_params
+
+    mesh = make_mesh(shape=(4, 2))
+    sharded = shard_params(adapter_tree, mesh)
+    # Precondition: at least one leaf actually lives sharded over `model`.
+    assert any(
+        not leaf.sharding.is_fully_replicated for leaf in jax.tree.leaves(sharded)
+    )
+    out = decode_params(encode_params(sharded), like=adapter_tree)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(adapter_tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_q8_on_2d_mesh_sharded_delta(adapter_tree):
+    """The q8 encoder's host pull must assemble sharded leaves whole before
+    quantizing — a per-shard absmax would change the scale."""
+    from nanofed_tpu.parallel.mesh import make_mesh, shard_params
+
+    mesh = make_mesh(shape=(4, 2))
+    sharded = shard_params(adapter_tree, mesh)
+    p_host = encode_delta_q8(adapter_tree, seed=0)
+    p_dev = encode_delta_q8(jax.device_get(sharded), seed=0)
+    got_host = decode_delta_q8(p_host, like=adapter_tree)
+    got_dev = decode_delta_q8(p_dev, like=adapter_tree)
+    for a, b in zip(jax.tree.leaves(got_host), jax.tree.leaves(got_dev)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
