@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke adapter-smoke adapter-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -96,6 +96,26 @@ adapter-smoke:
 	python -m pytest tests/integration/test_adapter_federation.py \
 	  tests/unit/adapters tests/unit/models/test_transformer.py \
 	  tests/unit/communication/test_adapter_codec.py -q -p no:cacheprovider
+
+# Fleet smoke (nanofed_tpu.fleet): a 3-tier heterogeneous fleet — rank-4
+# topk8 phones, rank-8 q8 edge boxes, rank-32 f32 silos — drives one live
+# fleet server on a VirtualClock: tier-routed model payloads, mixed-codec
+# submits on one endpoint, per-tier byte/latency accounting, zero lost
+# submits, and BOTH aggregation routes (dense reference vs rank-bucketed
+# padded einsum) parity-asserted every round.  The compile-heavy convergence
+# comparison legs are slow-marked (tier-1 excludes them) and run here
+# un-filtered, plus the fleet unit suites as a sanity floor.
+fleet-smoke:
+	python -m pytest tests/integration/test_fleet_federation.py \
+	  tests/unit/fleet -q -p no:cacheprovider
+
+# The committed fleet evidence artifacts (runs/fleet_r16_*.json +
+# runs/fedbuff_staleness_r16.json): the mixed-tier convergence-vs-bytes
+# comparison against a homogeneous max-rank baseline, the live-server
+# per-tier p99 swarm leg, and the FedBuff staleness-exponent ablation over
+# the r15 delay scenario.  A few minutes on CPU — not a CI job.
+fleet-evidence:
+	python -m nanofed_tpu.fleet.evidence
 
 # The committed evidence artifacts (runs/adapter_r15_*.json +
 # runs/fedbuff_adapter_r15_*.json): rank-8 transformer adapter federation

@@ -67,6 +67,7 @@ HEADER_SIGNATURE = "X-NanoFed-Signature"  # base64 RSA-PSS signature of the npz 
 HEADER_SECAGG = "X-NanoFed-SecAgg"  # "masked" flags a pairwise-masked uint32 payload
 HEADER_ENCODING = "X-NanoFed-Encoding"  # absent/"npz" = full params; "q8-delta" = codec
 HEADER_SUBMIT = "X-NanoFed-Submit"  # idempotency key: one per LOGICAL submit, rides retries
+HEADER_TIER = "X-NanoFed-Tier"  # fleet mode: which DeviceTier this client belongs to
 
 
 @dataclass(frozen=True)
@@ -106,6 +107,7 @@ class HTTPServer:
         ingest: Any | None = None,
         transport: HTTPTransport | None = None,
         tenant: str | None = None,
+        fleet: Any | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -170,9 +172,32 @@ class HTTPServer:
         the transport's lifecycle and ``client_max_size`` govern;
         ``host``/``port``/``max_request_size`` here are ignored and
         ``start()`` must not be called (the service starts the transport
-        once)."""
+        once).
+
+        ``fleet`` (a ``nanofed_tpu.fleet.FleetGateway``, duck-typed) turns on
+        heterogeneous-fleet mode: ``GET /model`` with an ``X-NanoFed-Tier``
+        header serves that tier's low-rank published view instead of the
+        dense global, and tier-tagged submits decode by the TIER's codec
+        (derived from the profile — a mismatching explicit encoding header is
+        a 400) into flat dense-delta rows for the ingest buffer.  Fleet mode
+        REQUIRES ``ingest`` (tier rows only exist in the batched flat path)
+        and excludes ``require_signatures`` (signatures cover dense-params
+        reconstructions, which tier submits never materialize) and masked
+        SecAgg submits (rejected 400 per request).  Untagged requests behave
+        exactly as without a fleet — mixed cohorts are first-class."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
+        if fleet is not None and ingest is None:
+            raise ValueError(
+                "fleet mode requires ingest= (tier submits decode into the "
+                "batched flat ingest buffer; there is no per-update path)"
+            )
+        if fleet is not None and require_signatures:
+            raise ValueError(
+                "fleet mode cannot combine with require_signatures: tier "
+                "submits never reconstruct the dense params tree a signature "
+                "would cover"
+            )
         if max_inflight is not None and max_inflight < 0:
             raise ValueError("max_inflight must be >= 0 (0 rejects every submit)")
         if read_timeout_s <= 0:
@@ -189,6 +214,7 @@ class HTTPServer:
         self._chaos = chaos
         self._clock = clock or SYSTEM_CLOCK
         self.ingest = ingest
+        self.fleet = fleet
         # Built lazily at the first publish_model (the params template fixes
         # the buffer's flat size); every mutation happens under self._lock.
         self._ingest_pipeline: Any | None = None
@@ -258,6 +284,18 @@ class HTTPServer:
             "nanofed_read_timeouts_total",
             "Request bodies that failed to arrive within read_timeout_s (408)",
         )
+        # Fleet mode: per-tier wire accounting — the aggregate-wire-bytes
+        # story of docs/fleet.md is read straight off these.
+        self._m_fleet_bytes = self.metrics_registry.counter(
+            "nanofed_fleet_bytes_total",
+            "Fleet-mode body bytes by tier and direction (rx=submit, tx=model)",
+            labels=("tier", "direction"),
+        )
+        self._m_fleet_updates = self.metrics_registry.counter(
+            "nanofed_fleet_updates_total",
+            "Fleet-mode tier submits by tier and result",
+            labels=("tier", "result"),
+        )
         # Logical-path route table: the transport resolves the TENANT and
         # hands this session the endpoint path; everything behind it —
         # admission, dedup windows, chaos, quota state — is session-scoped.
@@ -323,6 +361,13 @@ class HTTPServer:
                     # Sync parity with the _updates.clear() below: a new
                     # round invalidates every unaggregated buffered delta.
                     self._ingest_pipeline.clear()
+            if self.fleet is not None:
+                # Tier views version with the SAME window rule as the flat
+                # base cache above, so tier-delta reconstruction and wire
+                # acceptance can never disagree about live rounds.
+                self.fleet.publish(
+                    round_number, params, window=self.staleness_window
+                )
             if self.staleness_window > 0:
                 # Async mode: keep the window of base versions for delta
                 # reconstruction, and keep buffered updates — a straggler's update
@@ -782,6 +827,31 @@ class HTTPServer:
             return web.json_response(
                 {"status": "error", "message": "no model published"}, status=503
             )
+        tier = request.headers.get(HEADER_TIER)
+        if tier is not None:
+            if self.fleet is None:
+                return web.json_response(
+                    {"status": "error",
+                     "message": "tier header on a server with no fleet configured"},
+                    status=400,
+                )
+            try:
+                body = self.fleet.payload(tier)
+            except Exception as e:
+                return web.json_response(
+                    {"status": "error", "message": f"bad tier: {e}"}, status=400
+                )
+            self._m_bytes_tx.inc(len(body), endpoint="model")
+            self._m_fleet_bytes.inc(len(body), tier=tier, direction="tx")
+            return web.Response(
+                body=body,
+                content_type="application/octet-stream",
+                headers={
+                    HEADER_STATUS: "training",
+                    HEADER_ROUND: str(self._round),
+                    HEADER_TIER: tier,
+                },
+            )
         self._m_bytes_tx.inc(len(body), endpoint="model")
         return web.Response(
             body=body,
@@ -822,6 +892,45 @@ class HTTPServer:
                 {"status": "error", "message": "no model published"}, status=503
             )
         masked = request.headers.get(HEADER_SECAGG) == "masked"
+        # Fleet mode: a tier-tagged submit decodes by the TIER's codec — the
+        # tier must exist, an explicit encoding header must AGREE with the
+        # tier's (a client that disagrees with its own profile is
+        # misconfigured, not negotiable), and masked payloads cannot be
+        # tier-routed (the mask hides the codec's structure).
+        tier = request.headers.get(HEADER_TIER)
+        if tier is not None:
+            if self.fleet is None:
+                self._reject_update("bad_tier")
+                return web.json_response(
+                    {"status": "error",
+                     "message": "tier header on a server with no fleet configured"},
+                    status=400,
+                )
+            if masked:
+                self._reject_update("bad_tier", kind="masked")
+                return web.json_response(
+                    {"status": "error",
+                     "message": "tier routing cannot combine with SecAgg "
+                                "masked payloads"},
+                    status=400,
+                )
+            try:
+                tier_encoding = self.fleet.profile.tier(tier).encoding
+            except Exception as e:
+                self._reject_update("bad_tier")
+                return web.json_response(
+                    {"status": "error", "message": f"bad tier: {e}"}, status=400
+                )
+            explicit = request.headers.get(HEADER_ENCODING)
+            if explicit is not None and explicit != tier_encoding:
+                self._reject_update("bad_tier")
+                self._m_fleet_updates.inc(tier=tier, result="encoding_mismatch")
+                return web.json_response(
+                    {"status": "error",
+                     "message": (f"tier {tier!r} submits {tier_encoding!r}, "
+                                 f"not {explicit!r}")},
+                    status=400,
+                )
         # Idempotent-submit dedupe FIRST — even before the stale-round check: a
         # retry of an ACCEPTED submit may arrive after publish_model advanced
         # the round, and answering it 400-stale would make a topk8 client fold
@@ -907,7 +1016,8 @@ class HTTPServer:
                     fingerprint,
                 )
             return await self._admitted_submit_update(
-                request, client_id, round_number, metrics, submit_id, fingerprint
+                request, client_id, round_number, metrics, submit_id, fingerprint,
+                tier=tier,
             )
         finally:
             self._inflight -= 1
@@ -915,12 +1025,19 @@ class HTTPServer:
     async def _admitted_submit_update(
         self, request: web.Request, client_id: str, round_number: int,
         metrics: dict[str, Any], submit_id: str | None, fingerprint: str,
+        tier: str | None = None,
     ) -> web.StreamResponse:
         """The body of a plain-update submit AFTER admission: the caller holds
         one in-flight slot for the duration (read + decode + verify + buffer)."""
         body = await self._read_body(request)
         self._m_bytes_rx.inc(len(body), endpoint="update")
-        encoding = request.headers.get(HEADER_ENCODING, "npz")
+        if tier is not None:
+            self._m_fleet_bytes.inc(len(body), tier=tier, direction="rx")
+            # The tier fixes the codec (validated against any explicit header
+            # at entry); the tier's own decode path runs below.
+            encoding = self.fleet.profile.tier(tier).encoding
+        else:
+            encoding = request.headers.get(HEADER_ENCODING, "npz")
         if encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
             self._reject_update("bad_encoding")
             return web.json_response(
@@ -982,7 +1099,17 @@ class HTTPServer:
             # signatures the flatten fuses into the same pool job — the full
             # params tree never comes back to the handler, and each submit
             # pays ONE pool round trip, not two.
-            if (
+            if tier is not None:
+                # Fleet path: the gateway decodes by the tier's codec against
+                # the tier's published view for this round and returns the
+                # flat dense-delta row directly — the tier submit never
+                # materializes a dense params tree.
+                def _decode_tier() -> Any:
+                    return self.fleet.decode_submit(tier, body, round_number)
+
+                ingest_flat = await self._offload(_decode_tier)
+                params = None
+            elif (
                 self._ingest_pipeline is not None
                 and not self.require_signatures
                 and base_flat is not None
@@ -1002,6 +1129,8 @@ class HTTPServer:
                 params = await self._offload(_decode)
         except Exception as e:
             self._reject_update("bad_payload")
+            if tier is not None:
+                self._m_fleet_updates.inc(tier=tier, result="bad_payload")
             return web.json_response(
                 {"status": "error", "message": f"bad payload: {e}"}, status=400
             )
@@ -1015,7 +1144,7 @@ class HTTPServer:
         if self._ingest_pipeline is not None:
             return await self._ingest_buffer_update(
                 client_id, round_number, metrics, submit_id, fingerprint,
-                params, base_flat, ingest_flat,
+                params, base_flat, ingest_flat, tier=tier,
             )
         async with self._lock:
             # Authoritative duplicate re-check: two concurrent attempts of the
@@ -1055,6 +1184,7 @@ class HTTPServer:
         self, client_id: str, round_number: int, metrics: dict[str, Any],
         submit_id: str | None, fingerprint: str, params: Params | None,
         base_flat: Any, flat_delta: Any | None = None,
+        tier: str | None = None,
     ) -> web.StreamResponse:
         """Batched-ingest tail of an admitted plain submit: flatten the decoded
         params into a delta against the snapshotted base (worker pool — one
@@ -1097,6 +1227,11 @@ class HTTPServer:
                      "message": self._round_rejection_message(round_number)},
                     status=400,
                 )
+            if tier is not None:
+                # Tag the slot with its tier so drain-side consumers (fleet
+                # telemetry, per-tier round accounting) can group without a
+                # side lookup.
+                metrics = dict(metrics, tier=tier)
             slot = self._ingest_pipeline.offer(
                 flat_delta, client_id=client_id, round_number=round_number,
                 metrics=metrics,
@@ -1107,6 +1242,8 @@ class HTTPServer:
         if slot is None:
             self._m_429.inc(endpoint="update")
             self._reject_update("ingest_full")
+            if tier is not None:
+                self._m_fleet_updates.inc(tier=tier, result="ingest_full")
             return web.json_response(
                 {"status": "error",
                  "message": (f"ingest buffer full ({self.ingest.capacity} "
@@ -1114,6 +1251,8 @@ class HTTPServer:
                 status=429,
                 headers={"Retry-After": f"{self.retry_after_s:g}"},
             )
+        if tier is not None:
+            self._m_fleet_updates.inc(tier=tier, result="accepted")
         self._m_updates.inc(kind="plain", result="accepted")
         self._log.info("ingested update from %s (round %d, slot %d, %d buffered)",
                        client_id, round_number, slot, buffered)

@@ -1,0 +1,290 @@
+"""Heterogeneous-rank adapter aggregation: rank-4 phones and rank-32 silos in
+one global update.
+
+LoRA factors of DIFFERENT ranks cannot be averaged factor-wise — a mean of
+``A`` matrices followed by a product is not the mean of the products
+(``mean(A_i @ B_i) != mean(A_i) @ mean(B_i)``), and the factors do not even
+share shapes across tiers.  What IS well-defined across ranks is the DENSE
+delta each client's adapters represent: ``scaling * A @ B`` is base-shaped for
+every rank (``adapters.lora.adapter_delta``).  So the fleet's global update
+lives in dense-delta space, and this module provides two routes into it:
+
+* :func:`aggregate_dense` — the REFERENCE route: weighted mean of per-client
+  dense deltas.  Obviously correct, materializes one ``[d_in, d_out]``
+  temporary per client per leaf.
+* :func:`aggregate_padded` — the fast path: zero-pad every client's factors
+  into a common max-rank bucket (``A [d_in, r] -> [d_in, R]``, ``B [r, d_out]
+  -> [R, d_out]``), fold the client's ``weight * scaling / total_weight`` into
+  its ``A``, and contract the whole cohort in ONE stacked einsum per leaf
+  (``'cir,cro->io'``).  Padded rows/columns are zero, so the result is EXACTLY
+  the dense route (to float tolerance — the parity tests assert it); the
+  cohort-sized temporaries are factor-shaped ``[C, d_in, R] / [C, R, d_out]``
+  instead of C dense ``[d_in, d_out]`` products, which is the in-device win
+  whenever ``C * R << d_out`` (see docs/fleet.md for the crossover).
+
+Redistribution closes the loop: :func:`project_to_rank` compresses the
+aggregated dense delta back onto one tier's rank via truncated SVD (the
+rank-r Frobenius-optimal factorization, Eckart–Young), and
+:func:`redistribute` does it for every tier of a profile.  Low-rank tiers
+receive the best rank-r view of the fleet's update; the SVD tail they drop is
+reported by :func:`projection_error` so the evidence can show what
+heterogeneity costs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from nanofed_tpu.adapters.lora import AdapterSpec, adapter_delta, target_paths
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.types import Params
+
+__all__ = [
+    "AdapterUpdate",
+    "aggregate_dense",
+    "aggregate_padded",
+    "pad_adapters_to_rank",
+    "project_to_rank",
+    "projection_error",
+    "redistribute",
+    "revive_adapters",
+]
+
+
+@dataclass(frozen=True)
+class AdapterUpdate:
+    """One client's contribution to a heterogeneous round: its tier's spec,
+    its trained adapter tree, and its FedAvg weight (sample count)."""
+
+    spec: AdapterSpec
+    adapters: Params
+    weight: float = 1.0
+    tier: str = ""
+
+    def __post_init__(self) -> None:
+        if self.weight <= 0:
+            raise NanoFedError(f"update weight must be > 0, got {self.weight}")
+
+
+def _named_leaves(tree: Params) -> list[tuple[str, Any]]:
+    from nanofed_tpu.persistence.serialization import tree_flatten_with_names
+
+    return tree_flatten_with_names(tree)[0]
+
+
+def _unflatten(arrays: dict[str, Any], source: str) -> Params:
+    from nanofed_tpu.persistence.serialization import unflatten_from_arrays
+
+    return unflatten_from_arrays(arrays, like=None, source=source)
+
+
+def _check_compatible(updates: Sequence[AdapterUpdate]) -> None:
+    if not updates:
+        raise NanoFedError("cannot aggregate an empty update set")
+    t0, m0 = updates[0].spec.targets, updates[0].spec.min_dim
+    for u in updates[1:]:
+        if u.spec.targets != t0 or u.spec.min_dim != m0:
+            raise NanoFedError(
+                "heterogeneous-rank aggregation requires every tier to target "
+                f"the same leaves: {u.spec.targets}/{u.spec.min_dim} vs "
+                f"{t0}/{m0} — ranks may differ, target sets may not"
+            )
+
+
+def aggregate_dense(
+    updates: Sequence[AdapterUpdate], base_like: Params
+) -> Params:
+    """REFERENCE route: the weighted mean of per-client dense deltas,
+    ``sum_i (w_i / sum w) * scaling_i * (A_i @ B_i)`` per targeted leaf.
+    Base-shaped output; works even when tiers target different leaf sets."""
+    if not updates:
+        raise NanoFedError("cannot aggregate an empty update set")
+    total_w = float(sum(u.weight for u in updates))
+    acc: dict[str, Any] = {
+        name: jnp.zeros(np.shape(leaf), jnp.float32)
+        for name, leaf in _named_leaves(base_like)
+    }
+    for u in updates:
+        delta = adapter_delta(u.spec, base_like, u.adapters)
+        coef = u.weight / total_w
+        for name, leaf in _named_leaves(delta):
+            acc[name] = acc[name] + coef * jnp.asarray(leaf)
+    return _unflatten(acc, "dense fleet delta")
+
+
+def aggregate_padded(
+    updates: Sequence[AdapterUpdate],
+    base_like: Params,
+    pad_rank: int | None = None,
+) -> Params:
+    """Fast path: pad every client's factors into a common ``pad_rank``
+    bucket (default: the cohort max rank), fold ``w_i * scaling_i / sum w``
+    into ``A_i``, and contract each leaf's whole cohort in one stacked einsum.
+    Exactly the dense route — padded rows/columns are zero and contribute
+    nothing to the contraction (the parity tests hold this to float32
+    tolerance).  Requires a shared target set across tiers."""
+    _check_compatible(updates)
+    ranks = [u.spec.rank for u in updates]
+    bucket = max(ranks) if pad_rank is None else int(pad_rank)
+    if bucket < max(ranks):
+        raise NanoFedError(
+            f"pad_rank {bucket} smaller than the cohort's max rank {max(ranks)}"
+        )
+    total_w = float(sum(u.weight for u in updates))
+    paths = set(target_paths(updates[0].spec, base_like))
+
+    named_per_update = [dict(_named_leaves(u.adapters)) for u in updates]
+    arrays: dict[str, Any] = {}
+    for name, leaf in _named_leaves(base_like):
+        if name not in paths:
+            arrays[name] = jnp.zeros(np.shape(leaf), jnp.float32)
+            continue
+        d_in, d_out = (int(s) for s in np.shape(leaf))
+        a_stack = np.zeros((len(updates), d_in, bucket), np.float32)
+        b_stack = np.zeros((len(updates), bucket, d_out), np.float32)
+        for c, (u, named_ad) in enumerate(zip(updates, named_per_update)):
+            r = u.spec.rank
+            coef = u.weight * u.spec.scaling / total_w
+            a_stack[c, :, :r] = coef * np.asarray(named_ad[f"{name}/A"])
+            b_stack[c, :r, :] = np.asarray(named_ad[f"{name}/B"])
+        arrays[name] = jnp.einsum(
+            "cir,cro->io", jnp.asarray(a_stack), jnp.asarray(b_stack)
+        )
+    return _unflatten(arrays, "padded fleet delta")
+
+
+def pad_adapters_to_rank(
+    adapters: Params, from_spec: AdapterSpec, to_spec: AdapterSpec
+) -> Params:
+    """Re-express a low-rank tier's adapters at a higher rank WITHOUT changing
+    the delta they represent: zero-pad ``A``'s columns and ``B``'s rows to
+    ``to_spec.rank``, and rescale ``A`` by ``from_spec.scaling /
+    to_spec.scaling`` so ``adapter_delta(to_spec, base, padded) ==
+    adapter_delta(from_spec, base, original)`` exactly.  This is how a phone's
+    rank-4 update enters a rank-32 bucket as a first-class citizen."""
+    if to_spec.rank < from_spec.rank:
+        raise NanoFedError(
+            f"cannot pad rank {from_spec.rank} down to {to_spec.rank} — "
+            "use project_to_rank for compression"
+        )
+    if (from_spec.targets, from_spec.min_dim) != (to_spec.targets, to_spec.min_dim):
+        raise NanoFedError(
+            "pad_adapters_to_rank requires matching target sets between specs"
+        )
+    rescale = from_spec.scaling / to_spec.scaling
+    grow = to_spec.rank - from_spec.rank
+    arrays: dict[str, Any] = {}
+    for name, leaf in _named_leaves(adapters):
+        x = np.asarray(leaf, np.float32)
+        if name.endswith("/A"):
+            arrays[name] = np.pad(rescale * x, ((0, 0), (0, grow)))
+        elif name.endswith("/B"):
+            arrays[name] = np.pad(x, ((0, grow), (0, 0)))
+        else:  # pragma: no cover - adapter trees only hold /A and /B leaves
+            raise NanoFedError(f"unexpected adapter leaf {name!r}")
+    return _unflatten(arrays, "padded adapters")
+
+
+def project_to_rank(
+    dense_delta: Params, spec: AdapterSpec, base_like: Params
+) -> Params:
+    """Compress a base-shaped dense delta onto ``spec``'s rank: per targeted
+    leaf, the truncated SVD ``U_r S_r V_r^T`` (the Frobenius-optimal rank-r
+    approximation), split symmetrically as ``A = U_r sqrt(S_r)``, ``B =
+    sqrt(S_r) V_r^T / scaling`` so ``scaling * A @ B`` reproduces the
+    truncation.  Leaves whose true rank is below ``spec.rank`` pad with zeros
+    (exact representation).  This is the redistribution direction: the fleet's
+    aggregated update flowing back DOWN to a low-rank tier."""
+    paths = target_paths(spec, base_like)
+    named = dict(_named_leaves(dense_delta))
+    arrays: dict[str, Any] = {}
+    for name in paths:
+        m = np.asarray(named[name], np.float64)
+        u, s, vt = np.linalg.svd(m, full_matrices=False)
+        r = min(spec.rank, s.shape[0])
+        root = np.sqrt(s[:r])
+        a = (u[:, :r] * root).astype(np.float32)
+        b = ((root[:, None] * vt[:r]) / spec.scaling).astype(np.float32)
+        if r < spec.rank:
+            a = np.pad(a, ((0, 0), (0, spec.rank - r)))
+            b = np.pad(b, ((0, spec.rank - r), (0, 0)))
+        arrays[f"{name}/A"] = a
+        arrays[f"{name}/B"] = b
+    return _unflatten(arrays, "projected adapters")
+
+
+def projection_error(
+    dense_delta: Params, spec: AdapterSpec, base_like: Params
+) -> dict[str, float]:
+    """Relative Frobenius error per targeted leaf of the rank-``spec.rank``
+    truncation (what :func:`project_to_rank` drops), plus an ``__overall__``
+    aggregate — the number docs/fleet.md and the evidence artifact report as
+    the cost of redistributing to a thin tier."""
+    named = dict(_named_leaves(dense_delta))
+    out: dict[str, float] = {}
+    num = den = 0.0
+    for name in target_paths(spec, base_like):
+        m = np.asarray(named[name], np.float64)
+        s = np.linalg.svd(m, compute_uv=False)
+        tail = float(np.sum(s[spec.rank:] ** 2))
+        total = float(np.sum(s**2))
+        out[name] = float(np.sqrt(tail / total)) if total > 0 else 0.0
+        num += tail
+        den += total
+    out["__overall__"] = float(np.sqrt(num / den)) if den > 0 else 0.0
+    return out
+
+
+def revive_adapters(
+    adapters: Params, spec: AdapterSpec, seed: int = 0
+) -> Params:
+    """Give DEAD adapter directions gradient flow without changing the delta
+    they represent.  A direction ``j`` is dead when ``A[:, j]`` and ``B[j, :]``
+    are both zero — true of every direction a truncated SVD zero-padded, and
+    of EVERY direction at round 0 (the global delta is zero) — and LoRA
+    gradients through a dead pair are identically zero, so a client fetching
+    such a tree could never train it.  The fix is the LoRA identity-init move:
+    redraw those ``A`` columns as ``U(-s, s) / sqrt(rank)`` while ``B``'s rows
+    stay zero — ``scaling * A @ B`` is untouched (the zero ``B`` rows
+    annihilate the new columns), but dL/dB is now nonzero.  Deterministic in
+    ``seed`` so server replicas publish identical views."""
+    host = np.random.default_rng(int(seed))
+    s = spec.init_scale / np.sqrt(spec.rank)
+    arrays: dict[str, Any] = {}
+    named = dict(_named_leaves(adapters))
+    for name, leaf in named.items():
+        if not name.endswith("/A"):
+            arrays[name] = np.asarray(leaf, np.float32)
+            continue
+        a = np.asarray(leaf, np.float32).copy()
+        b = np.asarray(named[name[:-2] + "/B"], np.float32)
+        dead = (np.abs(a).sum(axis=0) == 0) & (np.abs(b).sum(axis=1) == 0)
+        if dead.any():
+            fresh = host.uniform(
+                -s, s, size=(a.shape[0], int(dead.sum()))
+            ).astype(np.float32)
+            a[:, dead] = fresh
+        arrays[name] = a
+    return _unflatten(arrays, "revived adapters")
+
+
+def redistribute(
+    dense_delta: Params,
+    profile: Any,
+    base_like: Params,
+    specs: dict[str, AdapterSpec] | None = None,
+) -> dict[str, Params]:
+    """Project one aggregated dense delta onto EVERY tier of ``profile``:
+    ``{tier_name: adapter_tree}`` at each tier's rank, via
+    :func:`project_to_rank`.  ``specs`` defaults to ``profile.specs()`` (the
+    common-alpha convention); pass explicit ones to match a running fleet's
+    spec set."""
+    tier_specs = specs if specs is not None else profile.specs()
+    return {
+        name: project_to_rank(dense_delta, tier_specs[name], base_like)
+        for name in profile.tier_names()
+    }

@@ -1,0 +1,187 @@
+"""Server-side fleet state: per-tier published views over one dense global.
+
+In fleet mode the server's global model stays exactly what it always was — a
+dense base-shaped params tree, published each round, aggregated through the
+batched device ingest buffer (``ingest.buffer``) in flat dense-delta space.
+What changes is the EDGE of the server: each tier sees the global through its
+own low-rank window.  The :class:`FleetGateway` owns that edge:
+
+* :meth:`publish` — at every ``publish_model`` the gateway takes the new
+  global params, forms the dense delta vs the frozen round-0 base, and
+  projects it onto EVERY tier's rank via truncated SVD
+  (``fleet.aggregate.project_to_rank``); each tier's published view is the
+  projected adapter tree, its npz payload (what ``GET /model`` with a tier
+  header serves), and its dense-flat image (the delta base tier submits are
+  measured against).  Zero-padded SVD columns are revived with the LoRA init
+  draw (:func:`~nanofed_tpu.fleet.aggregate.revive_adapters`) so a tier whose
+  view is rank-deficient — every tier, at round 0 — still has gradient flow.
+* :meth:`decode_submit` — a tier submit (any codec) decodes into the full
+  adapter tree the client now holds, densifies through ``adapter_delta``, and
+  returns the flat dense delta vs the tier's published view.  That row drops
+  straight into the existing ingest buffer: ``drain`` then computes
+  ``published + weighted-mean(per-client training progress)``, the same
+  FedAvg-on-deltas semantics as a homogeneous cohort — the buffer never
+  learns tiers exist.
+
+Views are versioned with the SAME window rule as the ingest pipeline's flat
+base cache, so wire acceptance and tier-delta reconstruction can never
+disagree about which rounds are alive.
+
+The per-publish cost is one truncated SVD per targeted leaf per tier — fine
+for the adapter-scale models this subsystem targets (docs/fleet.md quantifies
+it); the projections happen once per round on the server, not per client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+from nanofed_tpu.adapters.lora import AdapterSpec, adapter_delta
+from nanofed_tpu.communication.codec import encode_params
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.fleet.aggregate import project_to_rank, revive_adapters
+from nanofed_tpu.fleet.profile import FleetProfile
+from nanofed_tpu.fleet.wire import decode_tier_submit
+
+__all__ = ["FleetGateway", "TierView"]
+
+
+@dataclass(frozen=True)
+class TierView:
+    """One tier's published window onto one round's global model."""
+
+    tree: Params  # the tier-rank adapter tree (what the tier fetches)
+    flat_dense: np.ndarray  # flat dense image of ``tree`` (delta base, [P] f32)
+    payload: bytes  # npz of ``tree`` — the GET /model body for this tier
+
+
+class FleetGateway:
+    """Per-tier publish/decode state for an :class:`~nanofed_tpu.communication.
+    http_server.HTTPServer` running a heterogeneous fleet (see module doc).
+
+    ``base_like`` is the FROZEN round-0 base the whole fleet adapts; every
+    dense delta — published or submitted — is measured against it.
+    ``spec_kwargs`` (targets, min_dim, ...) are shared across tiers exactly as
+    ``FleetProfile.specs`` shares them; ranks come from the tiers."""
+
+    def __init__(
+        self,
+        profile: FleetProfile,
+        base_like: Params,
+        spec_kwargs: dict[str, Any] | None = None,
+        revive_seed: int = 0,
+    ) -> None:
+        self.profile = profile
+        self.base_like = jax.device_get(base_like)
+        self.specs: dict[str, AdapterSpec] = profile.specs(**(spec_kwargs or {}))
+        self.revive_seed = revive_seed
+        self.current_round: int | None = None
+        self._views: dict[int, dict[str, TierView]] = {}  # round -> tier -> view
+
+    def spec(self, tier_name: str) -> AdapterSpec:
+        try:
+            return self.specs[tier_name]
+        except KeyError:
+            raise NanoFedError(
+                f"fleet profile {self.profile.name!r} has no tier {tier_name!r}"
+            ) from None
+
+    # ------------------------------------------------------------------
+    # Publish side
+    # ------------------------------------------------------------------
+
+    def publish(self, round_number: int, params: Params, window: int = 0) -> None:
+        """Project the new global onto every tier and version the views with
+        the ingest pipeline's pruning rule (keep ``[round - window, round]``;
+        ``window=0`` keeps only the current round)."""
+        from nanofed_tpu.ingest.pipeline import flatten_params
+
+        params = jax.device_get(params)
+        dense = jax.tree.map(
+            lambda p, b: np.asarray(p, np.float32) - np.asarray(b, np.float32),
+            params, self.base_like,
+        )
+        views: dict[str, TierView] = {}
+        for name, spec in self.specs.items():
+            tree = project_to_rank(dense, spec, self.base_like)
+            tree = revive_adapters(
+                tree, spec, seed=self.revive_seed + round_number
+            )
+            flat = flatten_params(
+                adapter_delta(spec, self.base_like, tree)
+            ).astype(np.float32)
+            views[name] = TierView(
+                tree=tree, flat_dense=flat, payload=encode_params(tree)
+            )
+        self._views[round_number] = views
+        self.current_round = round_number
+        floor = round_number - max(0, window)
+        for old in [r for r in self._views if r < floor]:
+            del self._views[old]
+
+    def view(self, tier_name: str, round_number: int | None = None) -> TierView:
+        """The tier's published view for ``round_number`` (default: current).
+        Raises when the round is outside the live window — the server maps
+        that onto its stale-round rejection."""
+        rnd = self.current_round if round_number is None else round_number
+        views = self._views.get(rnd)
+        if views is None or tier_name not in views:
+            raise NanoFedError(
+                f"no published fleet view for tier {tier_name!r} at round {rnd}"
+            )
+        return views[tier_name]
+
+    def payload(self, tier_name: str, round_number: int | None = None) -> bytes:
+        """The npz body ``GET /model`` serves a client of this tier."""
+        return self.view(tier_name, round_number).payload
+
+    # ------------------------------------------------------------------
+    # Submit side
+    # ------------------------------------------------------------------
+
+    def decode_submit(
+        self, tier_name: str, body: bytes, round_number: int
+    ) -> np.ndarray:
+        """Tier payload -> flat dense-delta row for the ingest buffer: decode
+        by the tier's codec against the tier's published view for the
+        client's round, densify through ``adapter_delta``, subtract the
+        view's dense image.  CPU-bound (npz decompress + matmuls + O(P)
+        subtract) — the server runs it in the decode worker pool."""
+        from nanofed_tpu.ingest.pipeline import flatten_params
+
+        tier = self.profile.tier(tier_name)
+        view = self.view(tier_name, round_number)
+        new_tree = decode_tier_submit(
+            tier, body, template=view.tree, published=view.tree
+        )
+        flat = flatten_params(
+            adapter_delta(self.spec(tier_name), self.base_like, new_tree)
+        ).astype(np.float32)
+        return flat - view.flat_dense
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """Per-tier shape of the CURRENT views — rank, payload bytes, live
+        rounds — for /status surfaces and the fleet telemetry record."""
+        out: dict[str, Any] = {
+            "profile": self.profile.name,
+            "round": self.current_round,
+            "live_rounds": sorted(self._views),
+            "tiers": {},
+        }
+        if self.current_round is not None:
+            for name, v in self._views[self.current_round].items():
+                out["tiers"][name] = {
+                    "rank": self.spec(name).rank,
+                    "codec": self.profile.tier(name).codec,
+                    "payload_bytes": len(v.payload),
+                }
+        return out

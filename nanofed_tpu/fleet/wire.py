@@ -1,0 +1,184 @@
+"""Per-tier wire paths: one adapter tree, three codecs, isolated residuals.
+
+In a mixed fleet, the SAME round sees the same logical object — a tier's
+adapter tree — cross the wire three different ways: a silo ships the full tree
+as plain npz (``f32``), an edge box ships its factor-space delta through the
+q8 quantizer, a phone ships the top-k sparsified delta.  This module owns the
+two halves of that contract:
+
+* :func:`decode_tier_submit` — the server side: given the tier's codec, the
+  tier's structural template, and the tier's last PUBLISHED tree (the delta
+  base), turn a payload into the full adapter tree the client now holds.  All
+  three codecs land in the same place, so downstream aggregation
+  (``fleet.aggregate``) never sees the wire.
+* :class:`TierClientState` — the client side, transport-free: the delta-base
+  pinning and topk8 error-feedback bookkeeping that ``communication.
+  http_client.HTTPClient`` implements for homogeneous clients, replicated per
+  tier so the staged-residual contract (fold-before-encode, commit-on-accept)
+  is unit-testable without a server.  Each client owns its OWN state object:
+  a phone's residual is its private unsent tail and must never leak into
+  another client's — or another tier's — accounting (the mixed-tier round-trip
+  tests assert this isolation).
+
+The q8 codec needs no residual: stochastic rounding is unbiased, so FedAvg
+averages its noise away (Alistarh et al. 2017).  topk8's dropped tail is
+biased and DOES need error feedback (Seide et al. 2014; Karimireddy et al.
+2019) — the residual accumulates what a submit didn't ship and rides the next
+delta, staged (not committed) until the server accepts.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from nanofed_tpu.adapters.lora import AdapterSpec
+from nanofed_tpu.communication.codec import (
+    decode_delta_topk8,
+    decode_params,
+    encode_delta_q8,
+    encode_delta_topk8,
+    encode_params,
+    reconstruct_q8,
+    reconstruct_topk8,
+)
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.core.types import Params
+from nanofed_tpu.fleet.profile import CODEC_ENCODINGS, DeviceTier
+
+__all__ = [
+    "TierClientState",
+    "decode_tier_submit",
+]
+
+
+def decode_tier_submit(
+    tier: DeviceTier,
+    body: bytes,
+    template: Params,
+    published: Params,
+) -> Params:
+    """Payload -> the FULL adapter tree the client holds, by the tier's codec.
+
+    ``template`` is the tier's structural template (shapes/dtypes validated
+    against it); ``published`` is the tier tree the server last served this
+    tier — the base both delta codecs measure against.  f32 payloads ARE the
+    full tree; q8/topk8 payloads are deltas reconstructed onto ``published``
+    in the shared float32 arithmetic of ``codec.reconstruct_*`` (the same
+    invariant signature verification relies on)."""
+    if tier.codec == "f32":
+        return decode_params(body, like=template)
+    if tier.codec == "q8":
+        return reconstruct_q8(published, body)
+    if tier.codec == "topk8":
+        return reconstruct_topk8(published, body)
+    raise NanoFedError(f"tier {tier.name!r}: unknown codec {tier.codec!r}")
+
+
+def _f32_delta(new: Params, base: Params) -> Params:
+    return jax.tree.map(
+        lambda p, g: np.asarray(p, np.float32) - np.asarray(g, np.float32),
+        new, base,
+    )
+
+
+class TierClientState:
+    """One client's wire-side state for one tier (see module doc).
+
+    Lifecycle per round: ``payload = encode(trained_tree)`` -> POST ->
+    ``commit()`` on 200 or ``reject(trained_tree)`` on anything else; a fresh
+    server publish arrives via ``set_base(tree)``.  For the ``f32``/``q8``
+    codecs commit/reject are cheap bookkeeping; for ``topk8`` they implement
+    the staged-residual contract of ``HTTPClient.submit_update``."""
+
+    def __init__(self, tier: DeviceTier, spec: AdapterSpec, base: Params):
+        if spec.rank != tier.adapter_rank:
+            raise NanoFedError(
+                f"tier {tier.name!r} trains rank {tier.adapter_rank} but the "
+                f"spec says rank {spec.rank}"
+            )
+        self.tier = tier
+        self.spec = spec
+        self.base = base  # the tier tree the server last published to us
+        self._residual: Params | None = None  # topk8 error-feedback accumulator
+        # After a REJECTED topk8 submit the whole un-sent delta is folded into
+        # _residual; _pending_base remembers the local tree that fold covered,
+        # so a retry measures only post-fold training (HTTPClient's contract).
+        self._pending_base: Params | None = None
+        self._staged_residual: Params | None = None
+        self.bytes_sent = 0
+        self.submits = 0
+
+    @property
+    def encoding(self) -> str:
+        return CODEC_ENCODINGS[self.tier.codec]
+
+    def set_base(self, base: Params) -> None:
+        """A fresh published tier tree: future deltas measure against it.  Any
+        accumulated residual stays — it rides the next delta as usual — but
+        retry bookkeeping resets (mass from a rejected submit is already in
+        the residual)."""
+        self.base = base
+        self._pending_base = None
+        self._staged_residual = None
+
+    def encode(self, new_tree: Params, seed: int | None = None) -> bytes:
+        """The wire bytes for this client's current local tree.  topk8 folds
+        the residual in BEFORE encoding and stages (does not commit) the new
+        unsent tail; nothing is mutated until :meth:`commit`/:meth:`reject`."""
+        if self.tier.codec == "f32":
+            body = encode_params(new_tree)
+        else:
+            delta_base = (
+                self._pending_base if self._pending_base is not None else self.base
+            )
+            delta = _f32_delta(new_tree, delta_base)
+            if self.tier.codec == "q8":
+                body = encode_delta_q8(delta, seed=seed)
+            else:
+                if self._residual is not None:
+                    delta = jax.tree.map(np.add, delta, self._residual)
+                body = encode_delta_topk8(
+                    delta, fraction=self.tier.topk_fraction, seed=seed
+                )
+                sent = decode_delta_topk8(body, like=self.base)
+                # STAGED, not committed: the sent mass leaves the residual only
+                # once the server accepts, or a rejected submit would lose it
+                # from both sides forever.
+                self._staged_residual = jax.tree.map(
+                    lambda d, s: d - np.asarray(s, np.float32), delta, sent
+                )
+                self._pending_delta = delta
+        self._last_body_len = len(body)
+        return body
+
+    def commit(self) -> None:
+        """Server accepted: the staged residual becomes THE residual, retry
+        bookkeeping clears, byte accounting advances."""
+        if self._staged_residual is not None:
+            self._residual = self._staged_residual
+            self._staged_residual = None
+        self._pending_base = None
+        self.bytes_sent += getattr(self, "_last_body_len", 0)
+        self.submits += 1
+
+    def reject(self, new_tree: Params) -> None:
+        """Server rejected: nothing was applied server-side.  topk8 folds the
+        WHOLE combined delta (round progress + accumulated tail) into the
+        residual and pins ``_pending_base`` at the local tree, so a retry
+        contributes only post-fold training instead of double-counting."""
+        if self.tier.codec == "topk8" and self._staged_residual is not None:
+            self._residual = self._pending_delta
+            self._pending_base = new_tree
+            self._staged_residual = None
+
+    def residual_norm(self) -> float:
+        """l2 norm of the accumulated unsent tail (0 when no residual) — what
+        the isolation tests compare across tiers."""
+        if self._residual is None:
+            return 0.0
+        sq = jax.tree.map(
+            lambda x: float(np.sum(np.square(np.asarray(x, np.float64)))),
+            self._residual,
+        )
+        return float(np.sqrt(sum(jax.tree.leaves(sq))))

@@ -32,11 +32,14 @@ from typing import Any
 import aiohttp
 import numpy as np
 
+from nanofed_tpu.communication.codec import ENCODING_Q8_DELTA, ENCODING_TOPK8
 from nanofed_tpu.communication.http_server import (
     HEADER_CLIENT,
+    HEADER_ENCODING,
     HEADER_METRICS,
     HEADER_ROUND,
     HEADER_SUBMIT,
+    HEADER_TIER,
 )
 from nanofed_tpu.communication.retry import RetryPolicy, parse_retry_after
 from nanofed_tpu.core.types import Params
@@ -61,7 +64,15 @@ class SwarmConfig:
     arrival_rate`` seconds), or ``burst`` (everyone at t=0 — the thundering
     herd admission control exists for).  ``weight_skew`` is the sigma of a
     lognormal over the reported ``num_samples`` (0 = homogeneous clients);
-    ``canned_payloads`` sizes the shared pre-encoded body pool."""
+    ``canned_payloads`` sizes the shared pre-encoded body pool.
+
+    ``encoding`` picks the wire codec the canned bodies are pre-encoded with
+    (``npz`` full params, or the ``q8-delta``/``topk8-delta`` compressed-delta
+    codecs — for those the bodies carry the seeded noise AS the delta and the
+    ``base_params`` handed to :func:`make_canned_payloads` must be the tree
+    the server reconstructs against).  ``tier`` stamps ``X-NanoFed-Tier`` on
+    every submit — a fleet-mode sub-swarm; ``client_prefix`` keeps concurrent
+    sub-swarm client-id spaces disjoint."""
 
     num_clients: int = 1000
     submits_per_client: int = 1
@@ -84,6 +95,14 @@ class SwarmConfig:
     #: the connector (part of measured latency, as in production).  Bounded
     #: well under typical fd ulimits so a 10k swarm runs on a laptop.
     connector_limit: int = 512
+    #: Wire codec for the canned bodies (see class doc).
+    encoding: str = "npz"
+    #: topk8-only: kept fraction per leaf.
+    topk_fraction: float = 0.05
+    #: Fleet mode: the X-NanoFed-Tier value stamped on every submit.
+    tier: str | None = None
+    #: Client-id prefix — sub-swarms sharing one server need disjoint spaces.
+    client_prefix: str = "swarm"
 
     def __post_init__(self) -> None:
         if self.num_clients < 1:
@@ -96,6 +115,10 @@ class SwarmConfig:
             raise ValueError("arrival_rate must be > 0")
         if self.canned_payloads < 1:
             raise ValueError("canned_payloads must be >= 1")
+        if self.encoding not in ("npz", ENCODING_Q8_DELTA, ENCODING_TOPK8):
+            raise ValueError(f"unknown encoding {self.encoding!r}")
+        if not 0.0 < self.topk_fraction <= 1.0:
+            raise ValueError("topk_fraction must be in (0, 1]")
 
 
 @dataclass
@@ -138,23 +161,41 @@ def make_canned_payloads(
     base_params: Params, config: SwarmConfig
 ) -> list[bytes]:
     """Pre-encode the shared body pool: ``canned_payloads`` variants of
-    ``base + N(0, delta_scale)``, npz-encoded once.  Structure/shape/dtype
-    match the template exactly, so every server-side validation barrier runs
-    for real on every submit — only the float content repeats."""
+    ``base + N(0, delta_scale)``, encoded once through ``config.encoding``.
+    Structure/shape/dtype match the template exactly, so every server-side
+    validation barrier runs for real on every submit — only the float content
+    repeats.  For the delta codecs the body IS the noise delta (client-side
+    ``new - base``), so the server's reconstruction against ``base_params``
+    lands on the same ``base + noise`` the npz encoding ships whole."""
     import jax
 
-    from nanofed_tpu.communication.codec import encode_params
+    from nanofed_tpu.communication.codec import (
+        encode_delta_q8,
+        encode_delta_topk8,
+        encode_params,
+    )
 
     rng = np.random.default_rng(config.seed)
     bodies = []
-    for _ in range(config.canned_payloads):
-        noisy = jax.tree.map(
-            lambda leaf: np.asarray(leaf, np.float32)
-            + rng.normal(scale=config.delta_scale,
-                         size=np.shape(leaf)).astype(np.float32),
+    for i in range(config.canned_payloads):
+        noise = jax.tree.map(
+            lambda leaf: rng.normal(
+                scale=config.delta_scale, size=np.shape(leaf)
+            ).astype(np.float32),
             base_params,
         )
-        bodies.append(encode_params(noisy))
+        if config.encoding == ENCODING_Q8_DELTA:
+            bodies.append(encode_delta_q8(noise, seed=config.seed + i))
+        elif config.encoding == ENCODING_TOPK8:
+            bodies.append(encode_delta_topk8(
+                noise, fraction=config.topk_fraction, seed=config.seed + i
+            ))
+        else:
+            noisy = jax.tree.map(
+                lambda leaf, d: np.asarray(leaf, np.float32) + d,
+                base_params, noise,
+            )
+            bodies.append(encode_params(noisy))
     return bodies
 
 
@@ -282,6 +323,10 @@ async def _submit_once(
                             HEADER_SUBMIT:
                                 f"{client_id}:{submitted_round}:{seq}:{refresh}",
                         }
+                        if config.encoding != "npz":
+                            headers[HEADER_ENCODING] = config.encoding
+                        if config.tier is not None:
+                            headers[HEADER_TIER] = config.tier
                     async with session.post(
                         update_url, data=body, headers=headers
                     ) as resp:
@@ -405,8 +450,8 @@ async def run_swarm(
                     continue
                 await _submit_once(
                     session, update_url, tracker, bodies[i % len(bodies)],
-                    f"swarm_{i}", s, float(weights[i]), config, clock, result,
-                    sem,
+                    f"{config.client_prefix}_{i}", s, float(weights[i]),
+                    config, clock, result, sem,
                 )
 
         try:

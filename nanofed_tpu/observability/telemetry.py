@@ -185,6 +185,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     host_failures: list[dict[str, Any]] = []
     recoveries: list[dict[str, Any]] = []
     tenants: dict[str, dict[str, Any]] = {}
+    fleets: dict[str, dict[str, Any]] = {}
     adapter: dict[str, Any] = {}
     malformed = 0
     with path.open() as f:
@@ -284,6 +285,21 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 }
+            elif rtype == "fleet":
+                # Heterogeneous fleet layer (nanofed_tpu.fleet): one fleet
+                # run's headline numbers keyed by profile name; last record
+                # per profile wins (a re-run supersedes) — same policy as
+                # tenant/loadtest.
+                fleets[str(rec.get("profile", "?"))] = {
+                    k: rec[k]
+                    for k in (
+                        "tiers", "population", "max_rank", "accepted_total",
+                        "failed_total", "rejected_429_total",
+                        "wire_bytes_by_tier", "p99_s_by_tier",
+                        "parity_max_abs_diff", "aggregate_route", "rounds",
+                    )
+                    if k in rec
+                }
             elif rtype == "adapter":
                 # Parameter-efficient federation (nanofed_tpu.adapters):
                 # records accumulate by FIELD (different emitters own
@@ -358,6 +374,11 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # rounds, p99 submit latency, 429s, and chaos hits — the isolation
         # story in one block.
         out["tenants"] = dict(sorted(tenants.items()))
+    if fleets:
+        # Heterogeneous fleet layer (nanofed_tpu.fleet): per-profile tier
+        # mix, per-tier wire bytes and submit p99, and the dense-vs-padded
+        # aggregation parity — the tiered-federation story in one block.
+        out["fleets"] = dict(sorted(fleets.items()))
     if host_failures:
         # Host fault-tolerance layer (parallel.resilience): every detected
         # host failure, by kind, plus the recovery outcomes with MTTR — a

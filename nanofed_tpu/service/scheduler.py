@@ -77,6 +77,51 @@ class TenantFootprint:
         if self.resident_bytes < 0 or self.peak_extra_bytes < 0:
             raise ValueError("footprint bytes must be >= 0")
 
+    @classmethod
+    def for_fleet(
+        cls,
+        profile: Any,
+        base_like: Any,
+        ingest_capacity: int,
+        agg_k: int = 8,
+    ) -> "TenantFootprint":
+        """The analytic footprint of a HETEROGENEOUS-fleet tenant
+        (``nanofed_tpu.fleet.FleetProfile``), sized by its LARGEST-RANK tier:
+        the fleet aggregates in dense-delta space, so the ingest buffer and
+        drain temporaries are dense regardless of tier ranks, and the
+        adapter-state cost is the max-rank tier's (the padded fast path
+        buckets every contribution at max rank; smaller tiers fit inside).
+        Resident: the frozen base + its published copy, one max-rank A/B
+        projection per publish, and the ``capacity x P`` ingest buffer.
+        Peak: the ``(K+2) x P`` drain shape of the batched reduce.  The basis
+        string names the sizing tier so an admission rejection reads
+        causally."""
+        import numpy as np
+
+        from nanofed_tpu.adapters.lora import AdapterSpec, adapter_param_count
+        from nanofed_tpu.persistence.serialization import tree_flatten_with_names
+
+        flat = sum(
+            int(np.prod(np.shape(leaf)) or 1)
+            for _, leaf in tree_flatten_with_names(base_like)[0]
+        )
+        top = profile.max_rank_tier
+        counts = adapter_param_count(AdapterSpec(rank=top.adapter_rank), base_like)
+        resident = (
+            2 * flat * 4  # frozen base + published dense copy
+            + 2 * counts["adapter_bytes_f32"]  # max-rank A/B projection
+            + ingest_capacity * flat * 4  # dense ingest buffer rows
+        )
+        peak = (agg_k + 2) * flat * 4
+        return cls(
+            resident_bytes=int(resident),
+            peak_extra_bytes=int(peak),
+            basis=(
+                f"analytic fleet({profile.name}): dense ingest, sized by "
+                f"max-rank tier '{top.name}' (rank {top.adapter_rank})"
+            ),
+        )
+
 
 class _Lease:
     """One granted device section: async context manager measuring its own

@@ -253,6 +253,33 @@ class TuningSpace:
             adapter_ranks=ranks,
         )
 
+    @classmethod
+    def for_fleet(
+        cls,
+        profile: Any,
+        population: PopulationSpec,
+        n_devices: int,
+        batch_size: int,
+        num_rounds: int,
+        hosts: tuple[int, ...] | None = None,
+    ) -> "TuningSpace":
+        """The compiled-cost space for a heterogeneous fleet
+        (``nanofed_tpu.fleet.FleetProfile``): identical to :meth:`default`
+        except the adapter-rank axis is the sorted UNION of every tier's
+        ``{max(1, r//2), r, 2r}`` ladder — the mix itself is swept analytically
+        by ``nanofed_tpu.fleet.tuning`` (no compile per mix), but every rank
+        any mix candidate could assign to a tier needs a measured per-rank
+        cost here, so the two sweeps compose: this space prices the ranks,
+        the mix sweep shops from those prices."""
+        base = cls.default(
+            population, n_devices, batch_size, num_rounds, hosts=hosts,
+        )
+        ranks: set[int] = set()
+        for t in profile.tiers:
+            r = int(t.adapter_rank)
+            ranks.update({max(1, r // 2), r, 2 * r})
+        return dataclasses.replace(base, adapter_ranks=tuple(sorted(ranks)))
+
     def candidates(self) -> list[CandidateConfig]:
         out = []
         for chunk in self.client_chunks:

@@ -30,6 +30,10 @@ MODULES = [
                 "nanofed_tpu.models.transformer", "nanofed_tpu.nn"]),
     ("adapters", ["nanofed_tpu.adapters.lora",
                   "nanofed_tpu.adapters.evidence"]),
+    ("fleet", ["nanofed_tpu.fleet.profile", "nanofed_tpu.fleet.aggregate",
+               "nanofed_tpu.fleet.wire", "nanofed_tpu.fleet.gateway",
+               "nanofed_tpu.fleet.swarm", "nanofed_tpu.fleet.tuning",
+               "nanofed_tpu.fleet.evidence"]),
     ("trainer", ["nanofed_tpu.trainer.config", "nanofed_tpu.trainer.local",
                  "nanofed_tpu.trainer.private", "nanofed_tpu.trainer.scaffold",
                  "nanofed_tpu.trainer.schedules",

@@ -1,0 +1,207 @@
+"""Unit tests for heterogeneous-rank aggregation (``nanofed_tpu.fleet.aggregate``).
+
+The load-bearing property is ROUTE PARITY: the padded einsum fast path must
+produce exactly the dense reference aggregate (zero pad rows/columns contribute
+nothing to the contraction), for any mix of ranks and weights.  Everything else
+— pad exactness, SVD projection optimality, dead-direction revival — protects
+an invariant of the dense-delta-space design.
+"""
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.adapters import AdapterSpec, adapter_delta, init_adapters
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.fleet import (
+    AdapterUpdate,
+    aggregate_dense,
+    aggregate_padded,
+    pad_adapters_to_rank,
+    project_to_rank,
+    projection_error,
+    redistribute,
+    reference_fleet,
+    revive_adapters,
+)
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+BASE = {
+    "dense1": {"kernel": np.zeros((48, 64), np.float32)},
+    "dense2": {"kernel": np.zeros((64, 32), np.float32)},
+}
+ALPHA = 32.0  # the reference fleet's common alpha (max rank)
+
+
+def _update(rank, seed, weight=1.0, tier=""):
+    spec = AdapterSpec(rank=rank, alpha=ALPHA)
+    adapters = init_adapters(spec, BASE, rng=seed)
+    # give B nonzero content too, or every delta is identically zero
+    rng = np.random.default_rng(seed + 1000)
+    import jax
+
+    adapters = jax.tree.map(
+        lambda x: np.asarray(x) + rng.normal(0, 0.02, np.shape(x)).astype(np.float32),
+        adapters,
+    )
+    return AdapterUpdate(spec=spec, adapters=adapters, weight=weight, tier=tier)
+
+
+def _leaves(tree):
+    return dict(tree_flatten_with_names(tree)[0])
+
+
+def _max_abs_diff(t1, t2):
+    l1, l2 = _leaves(t1), _leaves(t2)
+    assert l1.keys() == l2.keys()
+    return max(
+        float(np.max(np.abs(np.asarray(l1[k]) - np.asarray(l2[k]))))
+        for k in l1
+    )
+
+
+# -- route parity ------------------------------------------------------------
+
+
+def test_padded_route_equals_dense_route_across_ranks():
+    updates = [
+        _update(4, seed=0, weight=3.0, tier="phone"),
+        _update(4, seed=1, weight=1.0, tier="phone"),
+        _update(8, seed=2, weight=2.0, tier="edge"),
+        _update(32, seed=3, weight=5.0, tier="silo"),
+    ]
+    dense = aggregate_dense(updates, BASE)
+    padded = aggregate_padded(updates, BASE)
+    assert _max_abs_diff(dense, padded) < 1e-6
+
+
+def test_padded_route_honors_explicit_pad_rank():
+    updates = [_update(4, seed=0), _update(8, seed=1)]
+    dense = aggregate_dense(updates, BASE)
+    # over-padding beyond the cohort max is wasteful but still exact
+    padded = aggregate_padded(updates, BASE, pad_rank=64)
+    assert _max_abs_diff(dense, padded) < 1e-6
+    with pytest.raises(NanoFedError, match="smaller than the cohort"):
+        aggregate_padded(updates, BASE, pad_rank=4)
+
+
+def test_single_update_aggregate_is_its_own_delta():
+    u = _update(8, seed=7)
+    dense = aggregate_dense([u], BASE)
+    assert _max_abs_diff(dense, adapter_delta(u.spec, BASE, u.adapters)) < 1e-6
+
+
+def test_aggregate_rejects_empty_and_mismatched_targets():
+    with pytest.raises(NanoFedError, match="empty"):
+        aggregate_dense([], BASE)
+    with pytest.raises(NanoFedError, match="empty"):
+        aggregate_padded([], BASE)
+    u1 = _update(4, seed=0)
+    spec2 = AdapterSpec(rank=8, alpha=ALPHA, targets=("*dense1*",))
+    u2 = AdapterUpdate(spec=spec2, adapters=init_adapters(spec2, BASE, rng=1))
+    with pytest.raises(NanoFedError, match="same leaves"):
+        aggregate_padded([u1, u2], BASE)
+
+
+def test_zero_weight_update_rejected():
+    spec = AdapterSpec(rank=4, alpha=ALPHA)
+    with pytest.raises(NanoFedError, match="weight"):
+        AdapterUpdate(spec=spec, adapters=init_adapters(spec, BASE), weight=0.0)
+
+
+# -- padding -----------------------------------------------------------------
+
+
+def test_pad_adapters_preserves_delta_exactly():
+    lo = AdapterSpec(rank=4, alpha=ALPHA)
+    hi = AdapterSpec(rank=32, alpha=ALPHA)
+    u = _update(4, seed=5)
+    padded = pad_adapters_to_rank(u.adapters, lo, hi)
+    d_lo = adapter_delta(lo, BASE, u.adapters)
+    d_hi = adapter_delta(hi, BASE, padded)
+    assert _max_abs_diff(d_lo, d_hi) == 0.0
+    # shapes actually grew to the bucket rank
+    named = _leaves(padded)
+    assert named["dense1/kernel/A"].shape == (48, 32)
+    assert named["dense1/kernel/B"].shape == (32, 64)
+
+
+def test_pad_down_is_rejected():
+    lo = AdapterSpec(rank=4, alpha=ALPHA)
+    hi = AdapterSpec(rank=32, alpha=ALPHA)
+    with pytest.raises(NanoFedError, match="project_to_rank"):
+        pad_adapters_to_rank(init_adapters(hi, BASE), hi, lo)
+
+
+# -- SVD projection ----------------------------------------------------------
+
+
+def test_project_full_rank_reproduces_delta():
+    u = _update(8, seed=9)
+    dense = adapter_delta(u.spec, BASE, u.adapters)
+    # rank 32 >= true rank 8: projection is lossless
+    spec32 = AdapterSpec(rank=32, alpha=ALPHA)
+    tree = project_to_rank(dense, spec32, BASE)
+    back = adapter_delta(spec32, BASE, tree)
+    assert _max_abs_diff(dense, back) < 1e-5
+    err = projection_error(dense, spec32, BASE)
+    assert err["__overall__"] < 1e-6
+
+
+def test_project_truncation_is_frobenius_optimal():
+    u = _update(32, seed=11)
+    dense = adapter_delta(u.spec, BASE, u.adapters)
+    spec4 = AdapterSpec(rank=4, alpha=ALPHA)
+    tree = project_to_rank(dense, spec4, BASE)
+    back = adapter_delta(spec4, BASE, tree)
+    named_d, named_b = _leaves(dense), _leaves(back)
+    err = projection_error(dense, spec4, BASE)
+    for name in named_d:
+        m = np.asarray(named_d[name], np.float64)
+        approx = np.asarray(named_b[name], np.float64)
+        achieved = np.linalg.norm(m - approx) / np.linalg.norm(m)
+        # matches the analytic SVD tail (Eckart-Young: nothing does better)
+        assert achieved == pytest.approx(err[name], abs=1e-5)
+        assert 0.0 < err[name] < 1.0
+
+
+def test_redistribute_covers_every_tier_at_its_rank():
+    prof = reference_fleet()
+    u = _update(32, seed=13)
+    dense = adapter_delta(u.spec, BASE, u.adapters)
+    trees = redistribute(dense, prof, BASE)
+    assert set(trees) == {"phone", "edge", "silo"}
+    assert _leaves(trees["phone"])["dense1/kernel/A"].shape == (48, 4)
+    assert _leaves(trees["silo"])["dense1/kernel/A"].shape == (48, 32)
+
+
+# -- revival -----------------------------------------------------------------
+
+
+def test_revive_gives_dead_directions_gradient_flow_without_moving_delta():
+    spec = AdapterSpec(rank=8, alpha=ALPHA)
+    # zero delta — the round-0 case: every direction dead
+    dense = {
+        "dense1": {"kernel": np.zeros((48, 64), np.float32)},
+        "dense2": {"kernel": np.zeros((64, 32), np.float32)},
+    }
+    tree = project_to_rank(dense, spec, BASE)
+    named = _leaves(tree)
+    assert float(np.abs(named["dense1/kernel/A"]).sum()) == 0.0
+    revived = revive_adapters(tree, spec, seed=3)
+    rn = _leaves(revived)
+    # A columns are alive now, B rows still zero, so the delta is unchanged
+    assert float(np.abs(rn["dense1/kernel/A"]).sum()) > 0.0
+    assert float(np.abs(rn["dense1/kernel/B"]).sum()) == 0.0
+    d = adapter_delta(spec, BASE, revived)
+    assert _max_abs_diff(d, dense) == 0.0
+    # deterministic in the seed (replicas publish identical views)
+    again = revive_adapters(tree, spec, seed=3)
+    assert _max_abs_diff(revived, again) == 0.0
+    other = revive_adapters(tree, spec, seed=4)
+    assert _max_abs_diff(revived, other) > 0.0
+
+
+def test_revive_leaves_live_directions_untouched():
+    u = _update(8, seed=17)
+    revived = revive_adapters(u.adapters, u.spec, seed=0)
+    assert _max_abs_diff(u.adapters, revived) == 0.0

@@ -1,0 +1,119 @@
+"""Unit tests for the server-side fleet edge (``nanofed_tpu.fleet.gateway``)."""
+
+import jax
+import numpy as np
+import pytest
+
+from nanofed_tpu.communication.codec import decode_params
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.fleet import FleetGateway, TierClientState, reference_fleet
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+BASE = {
+    "dense1": {"kernel": np.full((32, 48), 0.1, np.float32)},
+    "dense2": {"kernel": np.full((48, 16), -0.2, np.float32)},
+}
+
+
+@pytest.fixture()
+def gateway():
+    return FleetGateway(reference_fleet(), BASE)
+
+
+def _global_at(step):
+    rng = np.random.default_rng(step)
+    return jax.tree.map(
+        lambda x: np.asarray(x) + rng.normal(0, 0.05, np.shape(x)).astype(np.float32),
+        BASE,
+    )
+
+
+def test_publish_builds_a_live_view_per_tier(gateway):
+    gateway.publish(0, BASE)
+    for name in ("phone", "edge", "silo"):
+        view = gateway.view(name)
+        named = dict(tree_flatten_with_names(view.tree)[0])
+        rank = gateway.spec(name).rank
+        assert named["dense1/kernel/A"].shape == (32, rank)
+        # round 0: zero global delta, but the view must still TRAIN — revived
+        # A columns are nonzero while B stays zero (delta unchanged)
+        assert float(np.abs(named["dense1/kernel/A"]).sum()) > 0.0
+        assert float(np.abs(named["dense1/kernel/B"]).sum()) == 0.0
+        assert float(np.abs(view.flat_dense).max()) == 0.0
+        # the GET /model body is the npz of exactly this tree
+        decoded = decode_params(view.payload, like=view.tree)
+        dn = dict(tree_flatten_with_names(decoded)[0])
+        assert np.array_equal(dn["dense1/kernel/A"], named["dense1/kernel/A"])
+
+
+def test_view_windowing_matches_ingest_rule(gateway):
+    gateway.publish(0, BASE, window=1)
+    gateway.publish(1, _global_at(1), window=1)
+    gateway.publish(2, _global_at(2), window=1)
+    assert sorted(r for r in gateway._views) == [1, 2]
+    gateway.view("phone", 1)  # inside the window
+    with pytest.raises(NanoFedError, match="no published fleet view"):
+        gateway.view("phone", 0)  # pruned
+    with pytest.raises(NanoFedError, match="no published fleet view"):
+        gateway.view("phone", 3)  # never published
+
+
+def test_unknown_tier_raises(gateway):
+    gateway.publish(0, BASE)
+    with pytest.raises(NanoFedError, match="no tier"):
+        gateway.spec("watch")
+    with pytest.raises(NanoFedError, match="no published fleet view"):
+        gateway.view("watch")
+
+
+@pytest.mark.parametrize("tier_name", ["phone", "edge", "silo"])
+def test_decode_submit_yields_pure_training_progress(gateway, tier_name):
+    gateway.publish(3, _global_at(3))
+    view = gateway.view(tier_name)
+    state = TierClientState(
+        gateway.profile.tier(tier_name), gateway.spec(tier_name), view.tree
+    )
+    rng = np.random.default_rng(7)
+    trained = jax.tree.map(
+        lambda x: np.asarray(x, np.float32)
+        + rng.normal(0, 0.03, np.shape(x)).astype(np.float32),
+        view.tree,
+    )
+    body = state.encode(trained, seed=0)
+    row = gateway.decode_submit(tier_name, body, round_number=3)
+    assert row.dtype == np.float32 and row.ndim == 1
+    # the row is flat(dense(trained)) - flat(dense(view)): nonzero progress,
+    # bounded by the perturbation scale (codec noise included)
+    assert float(np.abs(row).max()) > 0.0
+    from nanofed_tpu.adapters import adapter_delta
+    from nanofed_tpu.ingest.pipeline import flatten_params
+
+    spec = gateway.spec(tier_name)
+    expect = flatten_params(adapter_delta(spec, BASE, trained)) - view.flat_dense
+    tol = {"silo": 1e-6, "edge": 0.05}.get(tier_name)
+    if tol is not None:  # topk8 drops its tail by design — no bound to assert
+        assert float(np.abs(row - expect).max()) < tol
+
+
+def test_decode_submit_no_training_is_a_zero_row(gateway):
+    gateway.publish(4, _global_at(4))
+    view = gateway.view("silo")
+    state = TierClientState(
+        gateway.profile.tier("silo"), gateway.spec("silo"), view.tree
+    )
+    row = gateway.decode_submit("silo", state.encode(view.tree), round_number=4)
+    assert float(np.abs(row).max()) < 1e-6
+
+
+def test_stats_reports_per_tier_shape(gateway):
+    gateway.publish(5, _global_at(5))
+    stats = gateway.stats()
+    assert stats["round"] == 5 and stats["live_rounds"] == [5]
+    assert stats["tiers"]["phone"] == {
+        "rank": 4,
+        "codec": "topk8",
+        "payload_bytes": stats["tiers"]["phone"]["payload_bytes"],
+    }
+    assert stats["tiers"]["silo"]["payload_bytes"] > stats["tiers"]["phone"][
+        "payload_bytes"
+    ]

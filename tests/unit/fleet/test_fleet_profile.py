@@ -1,0 +1,138 @@
+"""Unit tests for fleet profiles (``nanofed_tpu.fleet.profile``)."""
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.fleet import DeviceTier, FleetProfile, reference_fleet
+
+BASE = {
+    "dense1": {"kernel": np.zeros((64, 64), np.float32)},
+    "dense2": {"kernel": np.zeros((64, 32), np.float32)},
+}
+
+
+# -- DeviceTier validation ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kwargs,match",
+    [
+        (dict(name="", fraction=1.0), "non-empty"),
+        (dict(name="a/b", fraction=1.0), "non-empty"),
+        (dict(name="t", fraction=0.0), "fraction"),
+        (dict(name="t", fraction=1.5), "fraction"),
+        (dict(name="t", fraction=1.0, adapter_rank=0), "adapter_rank"),
+        (dict(name="t", fraction=1.0, codec="zstd"), "unknown codec"),
+        (dict(name="t", fraction=1.0, batch_size=0), "batch_size"),
+        (dict(name="t", fraction=1.0, arrival="fibonacci"), "arrival"),
+        (dict(name="t", fraction=1.0, arrival_rate=0.0), "arrival_rate"),
+        (dict(name="t", fraction=1.0, availability=0.0), "availability"),
+        (dict(name="t", fraction=1.0, local_steps=0), "local_steps"),
+        (dict(name="t", fraction=1.0, topk_fraction=0.0), "topk_fraction"),
+    ],
+)
+def test_tier_validation(kwargs, match):
+    with pytest.raises(NanoFedError, match=match):
+        DeviceTier(**kwargs)
+
+
+def test_tier_encoding_maps_codec_to_wire_value():
+    assert DeviceTier(name="t", fraction=1.0, codec="f32").encoding == "npz"
+    assert DeviceTier(name="t", fraction=1.0, codec="q8").encoding == "q8-delta"
+    assert (
+        DeviceTier(name="t", fraction=1.0, codec="topk8").encoding
+        == "topk8-delta"
+    )
+
+
+# -- FleetProfile validation -------------------------------------------------
+
+
+def test_profile_fractions_must_sum_to_one():
+    with pytest.raises(NanoFedError, match="sum to"):
+        FleetProfile(
+            name="p",
+            tiers=(
+                DeviceTier(name="a", fraction=0.5),
+                DeviceTier(name="b", fraction=0.4),
+            ),
+        )
+
+
+def test_profile_rejects_duplicate_tier_names():
+    with pytest.raises(NanoFedError, match="duplicate"):
+        FleetProfile(
+            name="p",
+            tiers=(
+                DeviceTier(name="a", fraction=0.5),
+                DeviceTier(name="a", fraction=0.5),
+            ),
+        )
+
+
+def test_profile_needs_at_least_one_tier():
+    with pytest.raises(NanoFedError, match="at least one"):
+        FleetProfile(name="p", tiers=())
+
+
+def test_tier_lookup_and_max_rank():
+    prof = reference_fleet()
+    assert prof.tier("silo").adapter_rank == 32
+    assert prof.max_rank == 32
+    assert prof.max_rank_tier.name == "silo"
+    with pytest.raises(NanoFedError, match="no tier"):
+        prof.tier("watch")
+
+
+# -- population_split --------------------------------------------------------
+
+
+def test_population_split_is_exact_and_deterministic():
+    prof = reference_fleet()
+    for n in (3, 10, 97, 100, 1000):
+        split = prof.population_split(n)
+        assert sum(split.values()) == n
+        assert all(v >= 1 for v in split.values())
+        assert split == prof.population_split(n)  # deterministic
+    # the dominant tier dominates
+    split = prof.population_split(100)
+    assert split["phone"] > split["edge"] > split["silo"]
+
+
+def test_population_split_guarantees_min_one_even_for_thin_tiers():
+    # silo is 5%: at n=3 the floor split would starve it to zero.
+    split = reference_fleet().population_split(3)
+    assert split == {"phone": 1, "edge": 1, "silo": 1}
+
+
+def test_population_split_rejects_population_below_tier_count():
+    with pytest.raises(NanoFedError, match="smaller than the tier count"):
+        reference_fleet().population_split(2)
+
+
+# -- specs / wire sizing -----------------------------------------------------
+
+
+def test_specs_share_the_max_rank_alpha():
+    specs = reference_fleet().specs()
+    assert {s.alpha for s in specs.values()} == {32.0}
+    assert specs["phone"].rank == 4 and specs["silo"].rank == 32
+    # common alpha => scaling ratio is a pure rank ratio (the padding rescale)
+    assert specs["phone"].scaling / specs["silo"].scaling == pytest.approx(8.0)
+
+
+def test_wire_bytes_per_round_orders_codecs_sanely():
+    out = reference_fleet().wire_bytes_per_round(BASE, 100)
+    # per-UPDATE bytes: f32 at rank 32 must dwarf topk8 at rank 4
+    assert out["silo"]["bytes_per_update"] > 20 * out["phone"]["bytes_per_update"]
+    assert out["total_bytes_per_round"] == sum(
+        out[t]["bytes_per_round"] for t in ("phone", "edge", "silo")
+    )
+    assert "analytic" in out["basis"]
+
+
+def test_profile_dict_round_trip():
+    prof = reference_fleet()
+    clone = FleetProfile.from_dict(prof.to_dict())
+    assert clone == prof

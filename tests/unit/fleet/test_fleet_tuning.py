@@ -1,0 +1,93 @@
+"""Unit tests for the analytic fleet-mix sweep (``nanofed_tpu.fleet.tuning``)."""
+
+import numpy as np
+import pytest
+
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.fleet import (
+    FleetMixCandidate,
+    mix_candidates,
+    profile_with_ranks,
+    reference_fleet,
+    sweep_fleet_mix,
+)
+
+BASE = {
+    "dense1": {"kernel": np.zeros((64, 64), np.float32)},
+    "dense2": {"kernel": np.zeros((64, 32), np.float32)},
+}
+
+
+def test_mix_candidates_cross_the_per_tier_ladders():
+    prof = reference_fleet()  # ranks 4 / 8 / 32, each a 3-point ladder
+    cands = mix_candidates(prof)
+    assert len(cands) == 27
+    # the profile's own ranks are one of the candidates
+    assert FleetMixCandidate(
+        ranks=(("phone", 4), ("edge", 8), ("silo", 32))
+    ) in cands
+    for c in cands:
+        assert c.rank_for("phone") in (2, 4, 8)
+        assert c.rank_for("silo") in (16, 32, 64)
+    with pytest.raises(NanoFedError, match="no tier"):
+        cands[0].rank_for("watch")
+
+
+def test_profile_with_ranks_moves_only_ranks():
+    prof = reference_fleet()
+    cand = FleetMixCandidate(ranks=(("phone", 8), ("edge", 4), ("silo", 16)))
+    p2 = profile_with_ranks(prof, cand)
+    assert [t.adapter_rank for t in p2.tiers] == [8, 4, 16]
+    assert [t.codec for t in p2.tiers] == [t.codec for t in prof.tiers]
+    assert [t.fraction for t in p2.tiers] == [t.fraction for t in prof.tiers]
+    assert p2.name == prof.name
+
+
+def test_sweep_is_deterministic_and_scores_feasible_first():
+    prof = reference_fleet()
+    a = sweep_fleet_mix(prof, BASE, num_clients=100)
+    b = sweep_fleet_mix(prof, BASE, num_clients=100)
+    assert [o.candidate for o in a] == [o.candidate for o in b]
+    assert all(o.feasible for o in a)
+    scores = [o.score for o in a]
+    assert scores == sorted(scores)
+    # score is exactly bytes per unit of availability-weighted rank
+    top = a[0]
+    assert top.score == pytest.approx(
+        top.wire_bytes_per_round / top.capacity
+    )
+
+
+def test_sweep_hbm_budget_rejects_with_a_reason():
+    prof = reference_fleet()
+    unbounded = sweep_fleet_mix(prof, BASE, num_clients=100)
+    need = max(o.hbm_resident_bytes + o.hbm_peak_bytes for o in unbounded)
+    # a budget below the smallest candidate's need rejects everything
+    all_out = sweep_fleet_mix(prof, BASE, num_clients=100, hbm_budget_bytes=1)
+    assert all(not o.feasible for o in all_out)
+    assert all("hbm" in o.reject_reason for o in all_out)
+    assert all(o.score is None for o in all_out)
+    # a budget at the max need admits everything again
+    all_in = sweep_fleet_mix(
+        prof, BASE, num_clients=100, hbm_budget_bytes=need
+    )
+    assert all(o.feasible for o in all_in)
+
+
+def test_sweep_step_cost_annotation_uses_the_max_rank():
+    prof = reference_fleet()
+    costs = {16: 0.1, 32: 0.2, 64: 0.4}
+    outs = sweep_fleet_mix(prof, BASE, num_clients=100, step_costs=costs)
+    for o in outs:
+        max_rank = max(r for _, r in o.candidate.ranks)
+        assert o.step_cost_s == costs.get(max_rank)
+
+
+def test_outcome_to_dict_round_trips_the_headline_fields():
+    outs = sweep_fleet_mix(reference_fleet(), BASE, num_clients=50)
+    d = outs[0].to_dict()
+    assert set(d) >= {
+        "ranks", "feasible", "wire_bytes_per_round", "capacity",
+        "hbm_resident_bytes", "hbm_peak_bytes", "score",
+    }
+    assert d["feasible"] is True

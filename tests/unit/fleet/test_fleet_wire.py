@@ -1,0 +1,213 @@
+"""Mixed-tier codec round trips + error-feedback isolation (ISSUE 16 satellite).
+
+Three clients of three tiers ship the SAME logical object — an adapter tree —
+as npz, q8 delta, and topk8 delta; every payload must land back as the tree
+the client holds (to its codec's fidelity), and each client's topk8 residual
+must stay ITS residual: error feedback is per-client state, and a rejected
+submit on one phone must not perturb another phone's (or another tier's)
+accounting.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from nanofed_tpu.adapters import AdapterSpec, init_adapters
+from nanofed_tpu.core.exceptions import NanoFedError
+from nanofed_tpu.fleet import (
+    DeviceTier,
+    TierClientState,
+    decode_tier_submit,
+    reference_fleet,
+)
+from nanofed_tpu.utils.trees import tree_flatten_with_names
+
+BASE = {
+    "dense1": {"kernel": np.zeros((32, 48), np.float32)},
+    "dense2": {"kernel": np.zeros((48, 16), np.float32)},
+}
+PROFILE = reference_fleet()
+SPECS = PROFILE.specs()
+
+
+def _published(tier_name, seed=0):
+    """A plausible published tier tree: identity-init A, zero B, revived."""
+    return init_adapters(SPECS[tier_name], BASE, rng=seed)
+
+
+def _trained(published, seed):
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda x: np.asarray(x, np.float32)
+        + rng.normal(0, 0.05, np.shape(x)).astype(np.float32),
+        published,
+    )
+
+
+def _max_abs_diff(t1, t2):
+    l1 = dict(tree_flatten_with_names(t1)[0])
+    l2 = dict(tree_flatten_with_names(t2)[0])
+    return max(
+        float(np.max(np.abs(np.asarray(l1[k]) - np.asarray(l2[k]))))
+        for k in l1
+    )
+
+
+def _l2(t1, t2):
+    sq = jax.tree.map(
+        lambda a, b: float(
+            np.sum((np.asarray(a, np.float64) - np.asarray(b, np.float64)) ** 2)
+        ),
+        t1, t2,
+    )
+    return float(np.sqrt(sum(jax.tree.leaves(sq))))
+
+
+def _state(tier_name, published):
+    return TierClientState(
+        PROFILE.tier(tier_name), SPECS[tier_name], published
+    )
+
+
+# -- round trips per codec ---------------------------------------------------
+
+
+def test_f32_round_trip_is_exact():
+    pub = _published("silo")
+    st = _state("silo", pub)
+    trained = _trained(pub, seed=1)
+    body = st.encode(trained)
+    back = decode_tier_submit(PROFILE.tier("silo"), body, pub, pub)
+    assert _max_abs_diff(trained, back) == 0.0
+    st.commit()
+    assert st.submits == 1 and st.bytes_sent == len(body)
+
+
+def test_q8_round_trip_lands_within_quantization_noise():
+    pub = _published("edge")
+    st = _state("edge", pub)
+    trained = _trained(pub, seed=2)
+    body = st.encode(trained, seed=0)
+    back = decode_tier_submit(PROFILE.tier("edge"), body, pub, pub)
+    # q8 quantizes the delta to ~1/256 of its per-leaf range
+    assert _max_abs_diff(trained, back) < 0.01
+    # and is unbiased enough that no residual machinery engages
+    st.commit()
+    assert st.residual_norm() == 0.0
+
+
+def test_topk8_round_trip_ships_the_top_and_banks_the_tail():
+    pub = _published("phone")
+    st = _state("phone", pub)
+    trained = _trained(pub, seed=3)
+    body = st.encode(trained, seed=0)
+    back = decode_tier_submit(PROFILE.tier("phone"), body, pub, pub)
+    # residual is staged, not live, until the server answers
+    assert st.residual_norm() == 0.0
+    st.commit()
+    # the unsent tail is exactly what the decode missed
+    assert st.residual_norm() == pytest.approx(_l2(trained, back), rel=1e-5)
+    assert st.residual_norm() > 0.0
+
+
+def test_topk8_residual_rides_the_next_submit():
+    pub = _published("phone")
+    st = _state("phone", pub)
+    trained = _trained(pub, seed=4)
+    st.encode(trained, seed=0)
+    st.commit()
+    tail = st.residual_norm()
+    assert tail > 0.0
+    # next round: the server publishes fresh, the client resumes from it with
+    # zero new local progress — the submit is then a PURE residual flush
+    new_pub = _published("phone", seed=50)
+    st.set_base(new_pub)
+    body2 = st.encode(new_pub, seed=1)
+    back2 = decode_tier_submit(PROFILE.tier("phone"), body2, new_pub, new_pub)
+    st.commit()
+    # the residual's top coordinates crossed the wire, so the tail shrank
+    assert 0.0 < st.residual_norm() < tail
+    assert _l2(back2, new_pub) > 0.0
+
+
+def test_unknown_codec_rejected():
+    tier = DeviceTier(name="x", fraction=1.0, codec="q8")
+    object.__setattr__(tier, "codec", "gzip")
+    with pytest.raises(NanoFedError, match="unknown codec"):
+        decode_tier_submit(tier, b"", BASE, BASE)
+
+
+def test_spec_rank_must_match_tier_rank():
+    with pytest.raises(NanoFedError, match="rank"):
+        TierClientState(PROFILE.tier("phone"), SPECS["silo"], _published("silo"))
+
+
+# -- the staged-residual contract (reject path) ------------------------------
+
+
+def test_topk8_reject_folds_and_pins_so_retry_does_not_double_count():
+    pub = _published("phone")
+    st = _state("phone", pub)
+    trained = _trained(pub, seed=5)
+    st.encode(trained, seed=0)
+    st.reject(trained)
+    # the WHOLE un-applied delta is banked; the fold point pins at `trained`
+    assert st.residual_norm() == pytest.approx(_l2(trained, pub), rel=1e-5)
+    # retry with zero new training: delta vs pending base is zero, the body
+    # carries residual mass only — commit drains it instead of growing it
+    body = st.encode(trained, seed=1)
+    back = decode_tier_submit(PROFILE.tier("phone"), body, pub, pub)
+    st.commit()
+    assert st.residual_norm() < _l2(trained, pub)
+    assert _l2(back, pub) > 0.0
+
+
+def test_set_base_resets_retry_bookkeeping_but_keeps_residual():
+    pub = _published("phone")
+    st = _state("phone", pub)
+    trained = _trained(pub, seed=6)
+    st.encode(trained, seed=0)
+    st.reject(trained)
+    banked = st.residual_norm()
+    assert banked > 0.0
+    new_pub = _published("phone", seed=99)
+    st.set_base(new_pub)
+    assert st.base is new_pub
+    assert st._pending_base is None and st._staged_residual is None
+    assert st.residual_norm() == banked  # the tail still rides the next delta
+
+
+# -- isolation (the satellite's core assertion) ------------------------------
+
+
+def test_residuals_are_isolated_between_clients_and_tiers():
+    pub_phone = _published("phone")
+    pub_edge = _published("edge")
+    phone_a = _state("phone", pub_phone)
+    phone_b = _state("phone", pub_phone)
+    edge = _state("edge", pub_edge)
+
+    # phone_a suffers a reject; phone_b and edge complete clean rounds
+    t_a = _trained(pub_phone, seed=7)
+    phone_a.encode(t_a, seed=0)
+    phone_a.reject(t_a)
+
+    t_b = _trained(pub_phone, seed=8)
+    phone_b.encode(t_b, seed=0)
+    phone_b.commit()
+    b_tail = phone_b.residual_norm()
+
+    edge.encode(_trained(pub_edge, seed=9), seed=0)
+    edge.commit()
+
+    # a's banked mass is a's alone; b's tail is the normal topk8 tail; the q8
+    # tier never grows a residual at all
+    assert phone_a.residual_norm() == pytest.approx(_l2(t_a, pub_phone), rel=1e-5)
+    assert 0.0 < b_tail < phone_a.residual_norm()
+    assert edge.residual_norm() == 0.0
+
+    # and a's retry/commit cycle moves nobody else's state
+    phone_a.encode(t_a, seed=1)
+    phone_a.commit()
+    assert phone_b.residual_norm() == b_tail
+    assert edge.residual_norm() == 0.0

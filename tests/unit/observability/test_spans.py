@@ -192,3 +192,32 @@ def test_summarize_digests_host_failure_and_recovery_records(tmp_path):
     assert rec["mttr"]["count"] == 1  # the rejoin record carries no MTTR
     assert rec["mttr"]["p50_s"] == 9.7
     assert rec["events"][1]["rejoin"] is True
+
+
+def test_summarize_digests_fleet_records(tmp_path):
+    """metrics-summary folds `fleet` telemetry into a `fleets` block keyed by
+    profile, last record per profile winning — the tenants/loadtests policy."""
+    p = tmp_path / "telemetry.jsonl"
+    stale = {
+        "type": "fleet", "profile": "phone_edge_silo", "tiers": 3,
+        "accepted_total": 1, "ignored_field": "dropped",
+    }
+    fresh = {
+        "type": "fleet", "profile": "phone_edge_silo", "tiers": 3,
+        "population": 60, "max_rank": 32, "accepted_total": 41,
+        "failed_total": 0, "rejected_429_total": 2,
+        "wire_bytes_by_tier": {"phone": 1000, "edge": 2000, "silo": 9000},
+        "p99_s_by_tier": {"phone": 0.1, "edge": 0.2, "silo": 0.3},
+        "parity_max_abs_diff": 4.5e-08, "rounds": 5,
+    }
+    other = {"type": "fleet", "profile": "all_silo", "tiers": 1,
+             "accepted_total": 7}
+    p.write_text("\n".join(json.dumps(r) for r in (stale, fresh, other)) + "\n")
+
+    summary = summarize_telemetry(p)
+    assert set(summary["fleets"]) == {"all_silo", "phone_edge_silo"}
+    rec = summary["fleets"]["phone_edge_silo"]
+    assert rec["accepted_total"] == 41  # last record won
+    assert rec["parity_max_abs_diff"] == 4.5e-08
+    assert "ignored_field" not in rec
+    assert summary["fleets"]["all_silo"] == {"tiers": 1, "accepted_total": 7}

@@ -244,3 +244,27 @@ def test_unadmitted_lease_refused():
                 pass
 
     _run(scenario())
+
+
+def test_for_fleet_footprint_sized_by_max_rank_tier():
+    import numpy as np
+
+    from nanofed_tpu.fleet import reference_fleet
+
+    base = {
+        "dense1": {"kernel": np.zeros((64, 64), np.float32)},
+        "dense2": {"kernel": np.zeros((64, 32), np.float32)},
+    }
+    prof = reference_fleet()
+    fp = TenantFootprint.for_fleet(prof, base, ingest_capacity=32, agg_k=8)
+    flat = 64 * 64 + 64 * 32
+    # dense ingest dominates: base + published + capacity rows, all P-sized
+    assert fp.resident_bytes >= (2 + 32) * flat * 4
+    assert fp.peak_extra_bytes == 10 * flat * 4
+    # the basis names the tier that set the adapter cost
+    assert "silo" in fp.basis and "rank 32" in fp.basis
+    # a fatter max-rank tier grows resident, never shrinks it
+    fat = reference_fleet(silo_rank=64)
+    fp2 = TenantFootprint.for_fleet(fat, base, ingest_capacity=32, agg_k=8)
+    assert fp2.resident_bytes > fp.resident_bytes
+    assert fp2.peak_extra_bytes == fp.peak_extra_bytes  # drain shape is dense

@@ -305,3 +305,29 @@ class TestHostsAxis:
         )
         back = AutotuneResult.from_dict(result.to_dict())
         assert back.winner.hosts == 2
+
+
+class TestForFleet:
+    def test_rank_axis_is_the_union_of_tier_ladders(self):
+        from nanofed_tpu.fleet import reference_fleet
+
+        space = TuningSpace.for_fleet(
+            reference_fleet(), POP, n_devices=8, batch_size=16, num_rounds=10
+        )
+        # tiers 4/8/32 -> ladders {2,4,8} | {4,8,16} | {16,32,64}
+        assert space.adapter_ranks == (2, 4, 8, 16, 32, 64)
+        # everything else matches the homogeneous default
+        default = TuningSpace.default(POP, 8, 16, 10)
+        assert space.client_chunks == default.client_chunks
+        assert space.batch_sizes == default.batch_sizes
+
+    def test_candidate_count_is_linear_in_distinct_ranks(self):
+        from nanofed_tpu.fleet import reference_fleet
+
+        prof = reference_fleet()
+        space = TuningSpace.for_fleet(
+            prof, POP, n_devices=8, batch_size=16, num_rounds=10
+        )
+        default = TuningSpace.default(POP, 8, 16, 10)
+        per_rank = len(default.candidates()) // len(default.adapter_ranks)
+        assert len(space.candidates()) == per_rank * 6  # not 3**tiers
