@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -84,6 +84,25 @@ tenants-bench:
 # repeat sweep must hit the result cache with ZERO compiles.  Tier-1-safe.
 autotune-smoke:
 	python -m pytest tests/integration/test_autotune_smoke.py -q
+
+# Retune smoke (nanofed_tpu.tuning.retuner): the closed online-retuning loop —
+# measured-walltime re-ranking of the sweep table, hysteresis holds, a swap
+# landing at a block boundary with a bit-identical loss trajectory, refused
+# swaps keeping the incumbent live, and the measured numbers written back into
+# the cached autotune entry — plus the compile-cache lifecycle units
+# (manifest/warm/verify, hit-miss counters, budget-pruned sweeps).  Runs the
+# slow-marked closed-loop legs too, so it compiles a handful of round programs.
+retune-smoke:
+	python -m pytest tests/integration/test_retune.py \
+	  tests/unit/tuning/test_retuner.py tests/unit/tuning/test_compile_cache.py \
+	  -q -p no:cacheprovider
+
+# Warm the shippable persistent compilation cache (tuning.compile_cache.warm):
+# pre-compile the candidate program set into .jax_cache/ with a toolchain
+# manifest, ready to tar to the accel host.  Verify a shipped cache with
+# `python scripts/warm_cache.py --verify-only --cache-dir <dir>`.
+warm-cache:
+	python scripts/warm_cache.py --cache-dir .jax_cache
 
 # Adapter smoke (nanofed_tpu.adapters): the compile-heavy transformer/adapter
 # integration legs — strict 2-D frozen-base federation with a descending loss,

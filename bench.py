@@ -551,10 +551,22 @@ def flagship_autotune(
             "cache_hit": result.cache_hit,
             "compiles": result.compiles,
             "compile_seconds_total": round(result.compile_seconds_total, 2),
+            # Sweep economics under a compile budget (NANOFED_AUTOTUNE_COMPILE_
+            # BUDGET / _CANDIDATE_DEADLINE): how many candidates were skipped,
+            # and — when a compile blew the per-candidate deadline — WHICH
+            # program wedged, so a truncated table names its own blind spot.
+            **({"skipped": result.skipped} if result.skipped else {}),
+            **({"wedged_at": result.wedged_at}
+               if result.wedged_at is not None else {}),
             **({"artifact": result.artifact_path}
                if result.artifact_path else {}),
             "top_candidates": [
-                {**o.config.to_dict(), "score": o.score}
+                {
+                    **o.config.to_dict(), "score": o.score,
+                    # Per-candidate compile walltime: the price of ADMITTING
+                    # this candidate to the sweep (None on cache hits).
+                    "compile_seconds": o.cost.get("compile_seconds"),
+                }
                 for o in feasible[:3]
             ],
         },

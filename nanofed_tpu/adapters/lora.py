@@ -57,8 +57,10 @@ class AdapterSpec:
     """Which leaves get adapters and at what rank.
 
     ``targets`` are fnmatch patterns over '/'-joined leaf paths (the
-    ``persistence.serialization`` naming); only 2-D leaves matching a pattern
-    with BOTH dims >= ``min_dim`` are adapted — 1-D biases/norm scales and tiny
+    ``persistence.serialization`` naming); only 2-D leaves (and 3-D stacked
+    kernels ``[L, d_in, d_out]`` — the scan-over-layers block layout, adapted
+    per layer) matching a pattern with both TRAILING dims >= ``min_dim`` are
+    adapted — 1-D biases/norm scales and tiny
     matrices carry their full delta cheaper than an A/B pair would.  The default
     pattern adapts every dense kernel, which for the transformer means the
     attention ``wq/wk/wv/wo``, the MLP ``fc1/fc2``, and the unembedding head;
@@ -93,8 +95,15 @@ class AdapterSpec:
         return (self.alpha if self.alpha is not None else float(self.rank)) / self.rank
 
     def matches(self, path: str, shape: tuple[int, ...]) -> bool:
-        """Does the leaf at ``path`` with ``shape`` get an adapter?"""
-        if len(shape) != 2 or min(shape) < self.min_dim:
+        """Does the leaf at ``path`` with ``shape`` get an adapter?
+
+        2-D leaves adapt as the classic ``A [d_in, r]`` / ``B [r, d_out]``
+        pair.  3-D leaves are treated as a STACK of ``L`` homogeneous kernels
+        ``[L, d_in, d_out]`` (the scan-over-layers transformer's block layout)
+        and adapt per layer — ``A [L, d_in, r]`` / ``B [L, r, d_out]``, so the
+        fnmatch target addresses every per-layer slice of the stacked leaf at
+        once and ``A @ B`` batches over the stacking dim unchanged."""
+        if len(shape) not in (2, 3) or min(shape[-2:]) < self.min_dim:
             return False
         return any(fnmatch.fnmatch(path, pat) for pat in self.targets)
 
@@ -139,8 +148,8 @@ def _tree_with_adapters(spec: AdapterSpec, base_like: Params, make_leaf) -> Para
     arrays: dict[str, Any] = {}
     for name, leaf in _named_leaves(base_like):
         if name in targets:
-            d_in, d_out = (int(s) for s in np.shape(leaf))
-            a, b = make_leaf(name, d_in, d_out)
+            shape = tuple(int(s) for s in np.shape(leaf))
+            a, b = make_leaf(name, shape)
             arrays[f"{name}/A"] = a
             arrays[f"{name}/B"] = b
     return unflatten_from_arrays(arrays, like=None, source="adapters")
@@ -162,9 +171,15 @@ def init_adapters(
     host = np.random.default_rng(int(rng))
     s = spec.init_scale / math.sqrt(spec.rank)
 
-    def make_leaf(name: str, d_in: int, d_out: int):
-        a = host.uniform(-s, s, size=(d_in, spec.rank)).astype(np.float32)
-        b = np.zeros((spec.rank, d_out), np.float32)
+    def make_leaf(name: str, shape: tuple[int, ...]):
+        # Rank-3 base leaves are stacked kernels [L, d_in, d_out] (the
+        # scan-over-layers layout): A/B grow a matching leading stack dim so
+        # A @ B batches into the per-layer delta stack.
+        *lead, d_in, d_out = shape
+        a = host.uniform(
+            -s, s, size=(*lead, d_in, spec.rank)
+        ).astype(np.float32)
+        b = np.zeros((*lead, spec.rank, d_out), np.float32)
         return a, b
 
     return _tree_with_adapters(spec, base_like, make_leaf)
@@ -254,11 +269,13 @@ def adapter_param_count(spec: AdapterSpec, base_like: Params) -> dict[str, int]:
     base_total = 0
     trainable = 0
     for name, leaf in _named_leaves(base_like):
-        n = int(np.prod(np.shape(leaf)) or 1)
+        shape = tuple(int(s) for s in np.shape(leaf))
+        n = int(np.prod(shape) or 1)
         base_total += n
-        if spec.matches(name, tuple(np.shape(leaf))):
-            d_in, d_out = np.shape(leaf)
-            trainable += spec.rank * (int(d_in) + int(d_out))
+        if spec.matches(name, shape):
+            *lead, d_in, d_out = shape
+            stack = int(np.prod(lead) or 1)
+            trainable += stack * spec.rank * (d_in + d_out)
     return {
         "base_params": base_total,
         "adapter_params": trainable,

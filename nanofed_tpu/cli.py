@@ -97,6 +97,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
               f"(eps={args.dp_epsilon}, delta={args.dp_delta}) over {args.rounds} "
               "rounds (tight RDP accounting)", file=sys.stderr)
 
+    if args.retune_every > 0 and not args.autotune:
+        print("error: --retune-every requires --autotune — the online retuner "
+              "re-ranks the sweep's candidate table; without a sweep there is "
+              "no table", file=sys.stderr)
+        return 2
+
     if args.autotune:
         pinned = [
             flag for flag, engaged in (
@@ -196,6 +202,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         strict=args.strict,
         profile_programs=args.profile_programs,
         autotune=args.autotune,
+        retune_every=args.retune_every,
         adapter_rank=args.adapter_rank,
         adapter_alpha=args.adapter_alpha,
     )
@@ -925,6 +932,18 @@ def main(argv: list[str] | None = None) -> int:
         "<out-dir>/autotune_*.json, and caches the result under .jax_cache/ "
         "so repeat runs compile nothing. Incompatible with explicit "
         "--client-chunk/--rounds-per-block/--model-shards",
+    )
+    run.add_argument(
+        "--retune-every", type=int, default=0, metavar="N",
+        help="close the tuning loop online (requires --autotune): every N "
+        "completed rounds, re-rank the sweep's candidate table by the "
+        "walltimes the run actually realized (plus the device-occupancy "
+        "gauge) and hot-swap the live round program at the next block "
+        "boundary when measurements beat the AOT pick by more than the "
+        "retuner's hysteresis. Every decision lands as a `retune` telemetry "
+        "record, the summary carries a `retunes` block, and the measured "
+        "numbers are written back into the autotune cache entry at run end. "
+        "0 = off",
     )
     run.add_argument(
         "--profile-programs", action="store_true",

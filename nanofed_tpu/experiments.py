@@ -90,6 +90,7 @@ def run_experiment(
     strict: bool = False,
     profile_programs: bool = False,
     autotune: bool = False,
+    retune_every: int = 0,
     adapter_rank: int | None = None,
     adapter_alpha: float | None = None,
     **scheme_kwargs: Any,
@@ -149,6 +150,16 @@ def run_experiment(
     and the summary carries ``tuned_config``.  Refuses explicit values for the
     swept knobs: the tuner owns them.
 
+    ``retune_every`` (CLI ``--retune-every``; requires ``autotune=True``)
+    closes the tuning loop online: every N completed rounds the
+    ``OnlineRetuner`` re-ranks the sweep's candidate table by the walltimes
+    the run actually realized (plus the device-occupancy gauge) and — at the
+    next block boundary, never mid-block — hot-swaps the live round program
+    when measurements beat the AOT pick by more than the hysteresis.  Every
+    decision lands as a ``retune`` telemetry record, the summary carries a
+    ``retunes`` block, and the measured numbers are written back into the
+    autotune cache entry at run end.
+
     ``adapter_rank`` (CLI ``--adapter-rank``) engages parameter-efficient
     federation (``nanofed_tpu.adapters``): the base model is frozen
     device-resident (model-sharded under ``model_shards > 1``) and only LoRA
@@ -192,6 +203,7 @@ def run_experiment(
         rounds_per_block=rounds_per_block,
         client_metrics_every=client_metrics_every,
         profile_programs=profile_programs,
+        retune_every=retune_every,
     )
     training_config = TrainingConfig(
         batch_size=batch_size,
@@ -221,6 +233,13 @@ def run_experiment(
         strict=strict,
         adapter=adapter,
     )
+    if retune_every > 0 and not autotune:
+        from nanofed_tpu.core.exceptions import NanoFedError
+
+        raise NanoFedError(
+            "retune_every requires autotune=True: the online retuner re-ranks "
+            "the sweep's candidate table — without a sweep there is no table"
+        )
     if autotune:
         pinned = [
             name for name, engaged in (
@@ -280,6 +299,8 @@ def run_experiment(
         **({"adapter": adapter_summary} if adapter_summary else {}),
         **({"tuned_config": coordinator.tuned_config}
            if coordinator.tuned_config is not None else {}),
+        **({"retunes": coordinator.retuner.summary()}
+           if coordinator.retuner is not None else {}),
         "model": model,
         "num_clients": num_clients,
         "rounds_completed": len(completed),

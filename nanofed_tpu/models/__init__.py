@@ -10,7 +10,12 @@ from nanofed_tpu.models import (  # noqa: F401  (registry side effects)
 from nanofed_tpu.models.base import Model, get_model, list_models, register_model
 from nanofed_tpu.models.mnist import mnist_cnn
 from nanofed_tpu.models.resnet import resnet8, resnet18
-from nanofed_tpu.models.transformer import transformer_lm
+from nanofed_tpu.models.transformer import (
+    stack_blocks,
+    transformer_lm,
+    transformer_lm_scan,
+    unstack_blocks,
+)
 
 __all__ = [
     "Model",
@@ -20,5 +25,8 @@ __all__ = [
     "mnist_cnn",
     "resnet8",
     "resnet18",
+    "stack_blocks",
     "transformer_lm",
+    "transformer_lm_scan",
+    "unstack_blocks",
 ]

@@ -26,11 +26,20 @@ Every matrix the FSDP layout rule cares about is 2-D: attention ``wq/wk/wv/wo``
 — each leaf's largest divisible dimension shards over the model axis, and these
 are exactly the leaves a LoRA :class:`~nanofed_tpu.adapters.AdapterSpec`
 targets.
+
+``scan_layers=True`` (the ``transformer_lm_scan`` zoo name) trades the pytree
+layout for compile time: the ``depth`` homogeneous block trees stack into
+leading-``[depth, ...]`` leaves and the forward pass runs ``lax.scan`` over
+them, so XLA compiles ONE block regardless of depth — numerically identical
+(the stacked leaves are exactly ``jnp.stack`` of the unrolled ones), and the
+FSDP rule never shards the stacking dim (``param_partition_spec`` excludes the
+leading dim of rank>=3 leaves from the model axis).
 """
 
 from __future__ import annotations
 
 import math
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -65,12 +74,21 @@ def init_transformer(
     seq_len: int,
     width: int,
     depth: int,
+    scan_layers: bool = False,
 ) -> Params:
     """Parameter tree for the causal LM.  Embeddings draw N(0, 0.02) (GPT-2
     convention); dense matrices use the zoo's kaiming-uniform ``dense_init``
     with the output projections down-scaled by ``1/sqrt(2*depth)`` (the GPT-2
     residual-accumulation fix, so deep stacks start with unit-scale residual
-    streams)."""
+    streams).
+
+    ``scan_layers=True`` emits the SAME per-layer values (identical RNG splits
+    layer for layer) but stacks the ``depth`` homogeneous block trees into one
+    ``"blocks"`` subtree whose leaves carry a leading ``[depth, ...]`` stacking
+    dim — the layout :func:`apply_sequence` runs a ``lax.scan`` over, so XLA
+    traces and compiles ONE block body instead of ``depth`` inlined copies.
+    Each stacked leaf is exactly ``jnp.stack`` of the unrolled form's leaves,
+    so the two layouts are numerically identical by construction."""
     n_keys = 3 + depth
     keys = jax.random.split(rng, n_keys)
     params: Params = {
@@ -80,11 +98,12 @@ def init_transformer(
         "ln_f": _layer_norm_init(width),
     }
     resid_scale = 1.0 / math.sqrt(2.0 * depth)
+    blocks = []
     for i in range(depth):
         kq, kk, kv, ko, k1, k2 = jax.random.split(keys[3 + i], 6)
         wo = nn.dense_init(ko, width, width)
         fc2 = nn.dense_init(k2, 4 * width, width)
-        params[f"block_{i}"] = {
+        blocks.append({
             "ln1": _layer_norm_init(width),
             "attn": {
                 "wq": nn.dense_init(kq, width, width),
@@ -97,8 +116,39 @@ def init_transformer(
                 "fc1": nn.dense_init(k1, width, 4 * width),
                 "fc2": {"kernel": fc2["kernel"] * resid_scale, "bias": fc2["bias"]},
             },
-        }
+        })
+    if scan_layers:
+        params["blocks"] = jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+    else:
+        for i, blk in enumerate(blocks):
+            params[f"block_{i}"] = blk
     return params
+
+
+def stack_blocks(params: Params) -> Params:
+    """Convert an UNROLLED parameter tree (``block_0..block_{L-1}``) to the
+    scan layout (stacked ``"blocks"`` leaves) — the checkpoint-migration path
+    between the two forms; :func:`unstack_blocks` is the exact inverse.  The
+    non-block leaves are shared by reference."""
+    depth = sum(1 for k in params if k.startswith("block_"))
+    if depth == 0:
+        raise ValueError("no block_<i> entries to stack — already scan layout?")
+    blocks = [params[f"block_{i}"] for i in range(depth)]
+    out = {k: v for k, v in params.items() if not k.startswith("block_")}
+    out["blocks"] = jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+    return out
+
+
+def unstack_blocks(params: Params) -> Params:
+    """Scan layout -> unrolled layout (inverse of :func:`stack_blocks`)."""
+    if "blocks" not in params:
+        raise ValueError("no stacked 'blocks' subtree — already unrolled?")
+    stacked = params["blocks"]
+    depth = int(jax.tree.leaves(stacked)[0].shape[0])
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i in range(depth):
+        out[f"block_{i}"] = jax.tree.map(lambda leaf: leaf[i], stacked)
+    return out
 
 
 def _attention(params: Params, x: jax.Array, heads: int) -> jax.Array:
@@ -139,12 +189,23 @@ def apply_sequence(
     tokens = tokens.astype(jnp.int32)
     n, t = tokens.shape
     x = params["tok_emb"][tokens] + params["pos_emb"][None, :t]
-    depth = sum(1 for k in params if k.startswith("block_"))
-    for i in range(depth):
-        blk = params[f"block_{i}"]
+
+    def block(x, blk):
         x = x + _attention(blk["attn"], _layer_norm(blk["ln1"], x), heads)
         h = nn.dense(blk["mlp"]["fc1"], _layer_norm(blk["ln2"], x))
-        x = x + nn.dense(blk["mlp"]["fc2"], jax.nn.gelu(h))
+        return x + nn.dense(blk["mlp"]["fc2"], jax.nn.gelu(h))
+
+    if "blocks" in params:
+        # Scan layout: one traced block body, scanned over the stacked
+        # [depth, ...] leaves — XLA compiles O(1) block HLO in depth instead
+        # of O(depth) inlined copies (the compile-wall fix).
+        x, _ = jax.lax.scan(
+            lambda carry, blk: (block(carry, blk), None), x, params["blocks"]
+        )
+    else:
+        depth = sum(1 for k in params if k.startswith("block_"))
+        for i in range(depth):
+            x = block(x, params[f"block_{i}"])
     x = _layer_norm(params["ln_f"], x)
     return nn.log_softmax(nn.dense(params["head"], x))
 
@@ -177,16 +238,27 @@ def transformer_lm(
     width: int = DEFAULT_WIDTH,
     depth: int = DEFAULT_DEPTH,
     heads: int = DEFAULT_HEADS,
+    scan_layers: bool = False,
 ) -> Model:
     """The causal-LM zoo entry.  ``apply`` returns the LAST position's
     next-token log-probs ``[N, vocab]`` so the standard masked-NLL pipeline
     trains it with ``y`` = true next token; the full ``[N, T, vocab]`` surface
-    is :func:`apply_sequence`."""
+    is :func:`apply_sequence`.
+
+    ``scan_layers=True`` (also registered as ``transformer_lm_scan``) selects
+    the scan-over-layers parameter layout: the ``depth`` block trees stack into
+    leading-``[depth, ...]`` leaves and the forward pass is a ``lax.scan`` over
+    them, so compile cost is O(1) in depth instead of O(depth) — identical
+    logits (the stacked leaves ARE the unrolled leaves, asserted in tests), a
+    different pytree structure (checkpoints don't interchange between layouts;
+    ``stack_blocks``/``unstack_blocks`` migrate them)."""
     if width % heads != 0:
         raise ValueError(f"width {width} must be divisible by heads {heads}")
 
     def init(rng: PRNGKey) -> Params:
-        return init_transformer(rng, vocab, seq_len, width, depth)
+        return init_transformer(
+            rng, vocab, seq_len, width, depth, scan_layers=scan_layers
+        )
 
     def apply(
         params: Params, x: jax.Array, *, train: bool = False, rng=None
@@ -195,13 +267,23 @@ def transformer_lm(
         return logp[:, -1, :]
 
     return Model(
-        name="transformer_lm",
+        name="transformer_lm_scan" if scan_layers else "transformer_lm",
         init=init,
         apply=apply,
         input_shape=(seq_len,),
         num_classes=vocab,
         token_stream=True,
     )
+
+
+@register_model("transformer_lm_scan")
+def transformer_lm_scan(**kwargs: Any) -> Model:
+    """The scan-over-layers causal LM as its own zoo name, so every name-keyed
+    surface (CLI ``--model``, ``run_experiment``, autotune fingerprints — the
+    two layouts compile DIFFERENT programs and must never share a cache entry)
+    addresses it directly."""
+    kwargs.pop("scan_layers", None)
+    return transformer_lm(scan_layers=True, **kwargs)
 
 
 #: Flagship shapes for the evidence artifacts (runs/adapter_*): the factory is
@@ -227,9 +309,10 @@ FLAGSHIP_CONFIGS = {
 }
 
 
-def flagship(name: str) -> Model:
+def flagship(name: str, scan_layers: bool = False) -> Model:
     """Build a named flagship config (see :data:`FLAGSHIP_CONFIGS`)."""
     vocab, seq_len, width, depth, heads = FLAGSHIP_CONFIGS[name]
     return transformer_lm(
-        vocab=vocab, seq_len=seq_len, width=width, depth=depth, heads=heads
+        vocab=vocab, seq_len=seq_len, width=width, depth=depth, heads=heads,
+        scan_layers=scan_layers,
     )

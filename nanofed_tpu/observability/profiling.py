@@ -374,6 +374,13 @@ class ProgramCatalog:
             )
             self._reports.pop(name, None)
 
+    def remove(self, name: str) -> None:
+        """Drop a program and its cached report; no-op when absent (a retune
+        swap down to rounds_per_block=1 retires the block program)."""
+        with self._lock:
+            self._entries.pop(name, None)
+            self._reports.pop(name, None)
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._entries)

@@ -187,6 +187,9 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     tenants: dict[str, dict[str, Any]] = {}
     fleets: dict[str, dict[str, Any]] = {}
     adapter: dict[str, Any] = {}
+    compile_events: list[dict[str, Any]] = []
+    retune_events: list[dict[str, Any]] = []
+    retune_final: dict[str, Any] | None = None
     malformed = 0
     with path.open() as f:
         for line in f:
@@ -318,6 +321,40 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 })
+            elif rtype == "compile":
+                # One XLA compile paid by the autotune sweep / warm pass
+                # (tuning.autotuner / tuning.compile_cache): which program,
+                # how long — the compile-wall evidence stream.
+                compile_events.append({
+                    k: rec[k]
+                    for k in ("program", "seconds", "cache_key")
+                    if k in rec
+                })
+            elif rtype == "retune":
+                # One online-retune verdict (tuning.retuner via the
+                # Coordinator): swap or hold, with the measured basis; the
+                # `considered` table stays in the raw telemetry — the digest
+                # keeps the verdict line.
+                retune_events.append({
+                    k: rec[k]
+                    for k in (
+                        "round", "swap", "applied", "old_program",
+                        "new_program", "measured_s_per_round",
+                        "candidate_s_per_round", "delta", "basis", "reason",
+                    )
+                    if k in rec
+                })
+            elif rtype == "retune_summary":
+                # Run-end retuner digest (last wins): decision/swap counts,
+                # the measured table, and the cache entry written back.
+                retune_final = {
+                    k: rec[k]
+                    for k in (
+                        "decisions", "swaps", "hysteresis", "measured",
+                        "cache_entry",
+                    )
+                    if k in rec
+                }
             elif rtype == "loadtest":
                 # Swarm-harness headline numbers (nanofed_tpu.loadgen), keyed
                 # by serving path; last record per mode wins (a re-run
@@ -397,6 +434,33 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         }
         if mttrs:
             out["recoveries"]["mttr"] = _digest(mttrs)
+    if compile_events:
+        # Compile-wall layer (tuning.autotuner / tuning.compile_cache): what
+        # the sweep/warm pass paid per program — the budget-pruning and
+        # warm-cache stories read straight off this block.
+        secs = [float(e.get("seconds", 0.0)) for e in compile_events]
+        out["compiles"] = {
+            "count": len(compile_events),
+            "total_s": round(math.fsum(secs), 4),
+            "max_s": round(max(secs), 4),
+            "by_program": {
+                str(e.get("program", "?")): round(float(e.get("seconds", 0.0)), 4)
+                for e in sorted(
+                    compile_events, key=lambda e: str(e.get("program", "?"))
+                )
+            },
+        }
+    if retune_events or retune_final is not None:
+        # Online-retuning layer (tuning.retuner): every boundary verdict plus
+        # the run-end digest — "did the measurements overrule the AOT pick".
+        proposed = [e for e in retune_events if e.get("swap")]
+        out["retunes"] = {
+            "decisions": len(retune_events),
+            "swaps_proposed": len(proposed),
+            "swaps_applied": sum(1 for e in proposed if e.get("applied")),
+            "events": retune_events,
+            **({"final": retune_final} if retune_final is not None else {}),
+        }
     if snapshot is not None:
         headline = {}
         for name in ("nanofed_rounds_total", "nanofed_bytes_received_total",

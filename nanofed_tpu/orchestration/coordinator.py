@@ -118,6 +118,15 @@ class CoordinatorConfig:
     # compilation cache is warm; `Coordinator.profile_programs()` runs the same
     # pass on demand either way.
     profile_programs: bool = False
+    # Closed-loop online retuning (tuning.retuner): every N completed rounds,
+    # re-rank the autotune candidate table by the walltimes the run actually
+    # realized and — at the next block boundary, never mid-block — hot-swap the
+    # live round program when the measurements disagree with the AOT cost model
+    # by more than the retuner's hysteresis.  0 = off.  Only engages on
+    # coordinators built via ``from_autotune`` (the sweep result IS the
+    # candidate table); measured numbers are written back into the autotune
+    # cache entry at run end so the NEXT run starts from reality.
+    retune_every: int = 0
 
     def __post_init__(self) -> None:
         if self.num_rounds < 1:
@@ -140,6 +149,8 @@ class CoordinatorConfig:
             raise ValueError("rounds_per_block must be >= 1")
         if self.client_metrics_every < 0:
             raise ValueError("client_metrics_every must be >= 0 (0 = never)")
+        if self.retune_every < 0:
+            raise ValueError("retune_every must be >= 0 (0 = off)")
         if not 0.0 < self.lr_decay_gamma <= 1.0:
             # gamma=0 would zero every update from the first decay on (full-cost
             # silent no-op rounds); gamma>1 silently GROWS the lr each decay.
@@ -244,6 +255,10 @@ class Coordinator:
             **({"artifact": result.artifact_path}
                if result.artifact_path else {}),
         }
+        if config.retune_every > 0:
+            # The sweep result IS the candidate table the online retuner
+            # re-ranks; measured numbers land back in the same cache entry.
+            coord.enable_retuning(result, cache_dir=autotune_cache_dir)
         if coord.telemetry is not None:
             coord.telemetry.record("autotune", **result.telemetry_payload())
         return coord
@@ -588,6 +603,16 @@ class Coordinator:
                     donate=True,
                     frozen_base=self._frozen_base,
                 )
+        # Everything a retune swap needs to REBUILD the round programs with a
+        # different (client_chunk, rounds_per_block): the swap path re-invokes
+        # the builders above with these frozen inputs (see _rebuild_round_programs)
+        # — only the two hot-swappable knobs vary.
+        self._client_chunk = client_chunk
+        self._builder_ctx: dict[str, Any] = dict(
+            grad_fn=grad_fn, local_fit=local_fit,
+            central_privacy=central_privacy, validation=validation,
+            robust=robust,
+        )
         # Compiled-program cost catalog (observability.profiling): every program
         # this coordinator built, registered with LAZY dispatch-shaped argument
         # factories — registration is free (no trace, no compile, nothing
@@ -656,6 +681,13 @@ class Coordinator:
         # full sweep result.  None on hand-configured coordinators.
         self.tuned_config: dict[str, Any] | None = None
         self.autotune_result = None
+        # Online retuning (tuning.retuner): attached by enable_retuning /
+        # from_autotune(retune_every > 0).  _retune_candidate is the live
+        # program's position in the candidate table; _last_retune_round the
+        # boundary the cadence counts from.
+        self.retuner = None
+        self._retune_candidate = None
+        self._last_retune_round = 0
 
         if self.strict:
             if self.scaffold:
@@ -959,6 +991,190 @@ class Coordinator:
         return reports
 
     # ------------------------------------------------------------------
+    # Online retuning (tuning.retuner)
+    # ------------------------------------------------------------------
+
+    def enable_retuning(
+        self,
+        result,
+        *,
+        cache_dir: str | Path | None = ".jax_cache",
+        hysteresis: float = 0.05,
+        min_rounds: int = 2,
+        current=None,
+    ):
+        """Attach an :class:`~nanofed_tpu.tuning.OnlineRetuner` over ``result``'s
+        candidate table (``from_autotune`` calls this when
+        ``config.retune_every > 0``; callable directly on a hand-built
+        coordinator whose configuration matches a table row).
+
+        ``current`` names the live program's position in the table (default:
+        ``result.winner``).  Measured walltimes flow in at every round/block
+        boundary; :meth:`start_training` asks for a swap every
+        ``config.retune_every`` rounds and writes the measurements back into
+        the autotune cache entry when the run completes."""
+        from nanofed_tpu.tuning.retuner import OnlineRetuner
+
+        if self.scaffold:
+            raise NanoFedError(
+                "online retuning does not cover the SCAFFOLD round program "
+                "(different signature; the autotuner never sweeps it)"
+            )
+        self.retuner = OnlineRetuner(
+            result, hysteresis=hysteresis, min_rounds=min_rounds,
+            cache_dir=cache_dir,
+        )
+        self._retune_candidate = current if current is not None else result.winner
+        self._last_retune_round = self.current_round
+        return self.retuner
+
+    def _observe_retune(
+        self, rounds: int, walltime_s: float, occupancy: float | None = None,
+    ) -> None:
+        """Feed one realized round/block walltime to the retuner (no-op when
+        retuning is off)."""
+        if self.retuner is None or self._retune_candidate is None:
+            return
+        self.retuner.observe(
+            self._retune_candidate, rounds, walltime_s, occupancy=occupancy,
+        )
+
+    def _maybe_retune(self) -> None:
+        """At a swap-safe boundary (between blocks, before the next dispatch),
+        ask the retuner for a verdict every ``config.retune_every`` rounds and
+        apply a proposed swap.  Every decision — swap, hold, or a swap the
+        coordinator refused — lands as a ``retune`` telemetry record."""
+        cfg = self.config
+        if self.retuner is None or cfg.retune_every <= 0:
+            return
+        if self.current_round <= 0 or self.current_round >= cfg.num_rounds:
+            return
+        if self.current_round - self._last_retune_round < cfg.retune_every:
+            return
+        self._last_retune_round = self.current_round
+        decision = self.retuner.propose(self._retune_candidate)
+        applied = False
+        if decision.swap:
+            applied = self._apply_retune(decision)
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "retune", round=self.current_round, applied=applied,
+                **decision.to_dict(),
+            )
+
+    def _apply_retune(self, decision) -> bool:
+        """Perform a proposed swap: rebuild the round programs under the new
+        (client_chunk, rounds_per_block) and re-register the catalog.  Returns
+        False (old programs untouched) when the coordinator refuses — the
+        rebuild is transactional, a failed swap never leaves a half-built
+        program live."""
+        from nanofed_tpu.tuning.autotuner import candidate_program_name
+
+        new = decision.new
+        try:
+            self._rebuild_round_programs(new.client_chunk, new.rounds_per_block)
+        except Exception as e:  # noqa: BLE001 — a refused swap must not kill the run
+            self._log.warning(
+                "retune swap to %s refused at the coordinator (%s); keeping %s",
+                candidate_program_name(new), e,
+                candidate_program_name(decision.old),
+            )
+            return False
+        self._retune_candidate = new
+        self._log.info(
+            "retune: swapped round program %s -> %s at round %d "
+            "(%s basis, %+.1f%% predicted win)",
+            candidate_program_name(decision.old), candidate_program_name(new),
+            self.current_round, decision.basis,
+            100.0 * (decision.delta or 0.0),
+        )
+        return True
+
+    def _rebuild_round_programs(
+        self, client_chunk: int | None, rounds_per_block: int,
+    ) -> None:
+        """Rebuild ``_round_step``/``_round_block`` for a hot-swapped
+        (client_chunk, rounds_per_block) — the only two knobs swappable without
+        resharding resident device state (the retuner's scope rule enforces the
+        rest).  Transactional: both programs build before either is installed.
+        The catalog re-registers (register REPLACES, so the ``nanofed_program_*``
+        gauges re-point at the next profile) and strict mode re-checks the new
+        programs' contracts."""
+        import dataclasses
+
+        if self.scaffold:
+            raise NanoFedError(
+                "online retuning does not cover the SCAFFOLD round program"
+            )
+        ctx = self._builder_ctx
+        if self._cohort_mode and client_chunk is not None:
+            n_dev = client_shard_count(self.mesh)
+            per_dev = pad_client_count(self.cohort_size, n_dev) // n_dev
+            if client_chunk < per_dev and per_dev % client_chunk != 0:
+                raise NanoFedError(
+                    f"client_chunk={client_chunk} does not divide the gathered "
+                    f"cohort layout ({per_dev} rows/device)"
+                )
+        round_step = build_round_step(
+            self.model.apply, self.training, self.mesh, self.strategy,
+            grad_fn=ctx["grad_fn"], local_fit=ctx["local_fit"],
+            central_privacy=ctx["central_privacy"],
+            validation=ctx["validation"], robust=ctx["robust"],
+            client_chunk=client_chunk, params_like=self.params, donate=True,
+            frozen_base=self._frozen_base,
+        )
+        round_block = None
+        if rounds_per_block > 1:
+            unsupported = [
+                name for name, active in (
+                    ("robust aggregation", ctx["robust"] is not None),
+                    ("central DP", ctx["central_privacy"] is not None),
+                    ("eval_every < rounds_per_block",
+                     0 < self.config.eval_every < rounds_per_block),
+                ) if active
+            ]
+            if unsupported:
+                raise NanoFedError(
+                    f"rounds_per_block={rounds_per_block} is not fused-capable "
+                    f"here ({' + '.join(unsupported)})"
+                )
+            round_block = build_round_block(
+                self.model.apply, self.training, self.mesh, self.strategy,
+                num_clients=self.num_clients,
+                padded_clients=self._padded_clients,
+                step_clients=self._step_clients,
+                cohort_size=self.cohort_size,
+                dropout_rate=self.config.dropout_rate,
+                min_completion_rate=self.config.min_completion_rate,
+                grad_fn=ctx["grad_fn"], local_fit=ctx["local_fit"],
+                validation=ctx["validation"],
+                client_chunk=client_chunk, params_like=self.params,
+                collect_client_detail=(
+                    self.config.save_metrics
+                    and self.config.client_metrics_every > 0
+                ),
+                cohort_mode=self._cohort_mode,
+                donate=True,
+                frozen_base=self._frozen_base,
+            )
+        # Commit — nothing above mutated coordinator state.
+        self._round_step = round_step
+        self._round_block = round_block
+        self._fused_fallback_reason = None
+        self._client_chunk = client_chunk
+        self.config = dataclasses.replace(
+            self.config, rounds_per_block=rounds_per_block
+        )
+        if round_block is None:
+            # A swap down to rpb=1 must not leave the OLD block program
+            # registered (the catalog would keep profiling a dead program).
+            self.program_catalog.remove("round_block")
+            self.program_catalog.remove("adapter_round_block")
+        self._register_programs()
+        if self.strict:
+            self._check_contracts()
+
+    # ------------------------------------------------------------------
     # Strict mode (analysis.contracts)
     # ------------------------------------------------------------------
 
@@ -1047,6 +1263,10 @@ class Coordinator:
         with self._log.context("coordinator"):
             try:
                 while self.current_round < self.config.num_rounds:
+                    # Retune checks run BETWEEN blocks (the swap-safe boundary):
+                    # the next dispatch picks up a swapped program, the one in
+                    # flight never changes under its own feet.
+                    self._maybe_retune()
                     n = self._block_len()
                     if n > 1:
                         # _train_block publishes + advances state for the whole
@@ -1070,6 +1290,21 @@ class Coordinator:
                 # a closed sink would silently drop every later record.  The cost
                 # of not closing on abandonment is an open line-buffered handle
                 # (every record is already flushed) and no metrics_snapshot line.
+                if (
+                    self.retuner is not None
+                    and self.current_round >= self.config.num_rounds
+                ):
+                    # Write the measured numbers back into the autotune cache
+                    # entry so the NEXT run's cache hit starts from reality,
+                    # and leave the run's retune digest in the telemetry.
+                    written = self.retuner.write_back()
+                    if self.telemetry is not None:
+                        self.telemetry.record(
+                            "retune_summary",
+                            **self.retuner.summary(),
+                            **({"cache_entry": str(written)}
+                               if written is not None else {}),
+                        )
                 if (
                     self.telemetry is not None
                     and self.current_round >= self.config.num_rounds
@@ -1397,7 +1632,8 @@ class Coordinator:
         # Derived occupancy: host_sync (host blocked ON the device) over
         # dispatch + host_sync + publish — updated at every block boundary so
         # /metrics always carries the current ratio (see observability.profiling).
-        update_device_occupancy(self._registry)
+        occupancy = update_device_occupancy(self._registry)
+        self._observe_retune(n, block_duration, occupancy)
 
         out: list[RoundMetrics] = []
         for i, r in enumerate(rounds):
@@ -1508,7 +1744,8 @@ class Coordinator:
         self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
         # Single-round occupancy basis: the local-train span blocks until the
         # device round completes, so its share of the round span IS device time.
-        update_device_occupancy(self._registry)
+        occupancy = update_device_occupancy(self._registry)
+        self._observe_retune(1, duration, occupancy)
         if self.telemetry is not None:
             self.telemetry.record(
                 "round", round=round_id, status=metrics.status.name,

@@ -557,11 +557,25 @@ def param_partition_spec(
     ``n_model_shards`` over the model axis; replicate leaves with no divisible
     dimension (scalars, odd-sized biases).  Ties pick the first largest dim.
     Pure shape arithmetic, so it works on traced values inside a jit as well as
-    on concrete arrays."""
+    on concrete arrays.
+
+    The LEADING dim of a rank>=3 leaf is never chosen: at rank 3+ that dim is a
+    stacking/window dim — scan-over-layers stacks the ``L`` transformer blocks
+    into ``[L, ...]`` leaves, conv kernels lead with window dims — and sharding
+    it over the model axis would split ACROSS layers/windows instead of within
+    a matrix, forcing a gather inside every scan step.  The rule must stay
+    pure-shape (``MeshLayout`` recomputes specs from ``x.shape`` inside traced
+    code where no path information exists), so the exclusion keys on rank
+    alone; a stacked rank-2 leaf (e.g. ``[L, D]`` layer-norm scales) can still
+    shard over ``L`` if ``L`` is its largest divisible dim — harmless (the
+    slice is still within one leaf) and unreachable for realistic configs
+    where width >= depth."""
     if n_model_shards <= 1:
         return P()
     best_dim, best_size = -1, 0
     for i, d in enumerate(shape):
+        if i == 0 and len(shape) >= 3:
+            continue
         if d % n_model_shards == 0 and d > best_size:
             best_dim, best_size = i, int(d)
     if best_dim < 0:

@@ -11,26 +11,49 @@ from nanofed_tpu.tuning.autotuner import (
     PopulationSpec,
     TuningSpace,
     autotune,
+    candidate_program_name,
     format_candidate_table,
+    order_by_predicted_compile_cost,
+    predicted_compile_cost,
     rank_candidates,
     resolve_hbm_budget,
+)
+from nanofed_tpu.tuning.compile_cache import (
+    WarmResult,
+    build_manifest,
+    install_compile_cache_metrics,
+    verify_manifest,
+    warm,
+    write_manifest,
 )
 from nanofed_tpu.tuning.epilogues import (
     profile_aggregation_epilogues,
     register_epilogue_programs,
 )
+from nanofed_tpu.tuning.retuner import OnlineRetuner, RetuneDecision
 
 __all__ = [
     "AutotuneError",
     "AutotuneResult",
     "CandidateConfig",
     "CandidateOutcome",
+    "OnlineRetuner",
     "PopulationSpec",
+    "RetuneDecision",
     "TuningSpace",
+    "WarmResult",
     "autotune",
+    "build_manifest",
+    "candidate_program_name",
     "format_candidate_table",
+    "install_compile_cache_metrics",
+    "order_by_predicted_compile_cost",
+    "predicted_compile_cost",
     "profile_aggregation_epilogues",
     "rank_candidates",
     "register_epilogue_programs",
     "resolve_hbm_budget",
+    "verify_manifest",
+    "warm",
+    "write_manifest",
 ]

@@ -61,6 +61,9 @@ __all__ = [
     "PopulationSpec",
     "TuningSpace",
     "autotune",
+    "candidate_program_name",
+    "order_by_predicted_compile_cost",
+    "predicted_compile_cost",
     "rank_candidates",
     "resolve_hbm_budget",
 ]
@@ -356,6 +359,44 @@ def rank_candidates(outcomes: Iterable[CandidateOutcome]) -> list[CandidateOutco
     return feasible + rejected
 
 
+def predicted_compile_cost(cand: CandidateConfig) -> float:
+    """A dimensionless predictor of how long a candidate's XLA compile takes,
+    for SWEEP ORDERING only (never for scoring): fused multi-round blocks trace
+    ``rounds_per_block`` bodies plus the cohort plumbing, client chunking adds
+    an inner scan, every extra mesh axis cell multiplies the SPMD partitioning
+    work, and the frozen-base adapter path adds the bind/merge prologue.  The
+    weights are coarse on purpose — the point is that a budget-killed sweep
+    dies in the expensive tail, not before the cheap feasible head compiled."""
+    return (
+        (1.0 if cand.rounds_per_block > 1 else 0.0)
+        + (0.5 if cand.client_chunk is not None else 0.0)
+        + float(cand.hosts * cand.model_shards - 1)
+        + (0.25 if cand.adapter_rank is not None else 0.0)
+    )
+
+
+def order_by_predicted_compile_cost(
+    candidates: Iterable[CandidateConfig],
+) -> list[CandidateConfig]:
+    """Cheapest-compile-first sweep order (stable: ties fall back to the
+    candidate key, so equal spaces sweep identically).  This is THE sweep
+    order of :func:`autotune` — under a compile budget the cheap single-round
+    candidates land first, so a budget- or wedge-killed sweep still holds a
+    feasible winner instead of dying inside the most expensive lowering (the
+    r14 failure mode, and the ``for_fleet`` rank-union sweep's worst case)."""
+    return sorted(candidates, key=lambda c: (predicted_compile_cost(c), c.key))
+
+
+def candidate_program_name(cand: CandidateConfig) -> str:
+    """The ``ProgramCatalog``/telemetry name a candidate's lowered round
+    program is registered and recorded under."""
+    return (
+        f"cand_chunk{cand.client_chunk or 0}_rpb{cand.rounds_per_block}"
+        f"_m{cand.model_shards}_b{cand.batch_size}_h{cand.hosts}"
+        + (f"_r{cand.adapter_rank}" if cand.adapter_rank is not None else "")
+    )
+
+
 def resolve_hbm_budget(
     explicit: int | None = None, devices: list | None = None
 ) -> tuple[int | None, str]:
@@ -406,6 +447,15 @@ class AutotuneResult:
     cache_hit: bool = False
     compiles: int = 0
     compile_seconds_total: float = 0.0
+    #: The sweep's compile budget (seconds), when one was set — candidates
+    #: beyond the budget are in ``outcomes`` with ``skipped: compile_budget``.
+    compile_budget_s: float | None = None
+    #: Candidates never compiled because the budget ran out or the sweep
+    #: wedged (counted so the artifact states its own incompleteness).
+    skipped: int = 0
+    #: Program name of the candidate whose compile blew the per-candidate
+    #: deadline, when one did — the r14 postmortem field.
+    wedged_at: str | None = None
     space: dict[str, Any] = field(default_factory=dict)
     population: dict[str, Any] = field(default_factory=dict)
     epilogues: dict[str, Any] = field(default_factory=dict)
@@ -430,6 +480,10 @@ class AutotuneResult:
             "cache_hit": self.cache_hit,
             "compiles": self.compiles,
             "compile_seconds_total": round(self.compile_seconds_total, 4),
+            **({"compile_budget_s": self.compile_budget_s}
+               if self.compile_budget_s is not None else {}),
+            **({"skipped": self.skipped} if self.skipped else {}),
+            **({"wedged_at": self.wedged_at} if self.wedged_at else {}),
             "space": self.space,
             "population": self.population,
             **({"epilogues": self.epilogues} if self.epilogues else {}),
@@ -451,6 +505,8 @@ class AutotuneResult:
             "cache_hit": self.cache_hit,
             "compiles": self.compiles,
             "compile_seconds_total": round(self.compile_seconds_total, 4),
+            **({"skipped": self.skipped} if self.skipped else {}),
+            **({"wedged_at": self.wedged_at} if self.wedged_at else {}),
             **({"best_score": feasible[0].score} if feasible else {}),
         }
 
@@ -472,6 +528,9 @@ class AutotuneResult:
             cache_hit=bool(d.get("cache_hit", False)),
             compiles=int(d.get("compiles", 0)),
             compile_seconds_total=float(d.get("compile_seconds_total", 0.0)),
+            compile_budget_s=d.get("compile_budget_s"),
+            skipped=int(d.get("skipped", 0)),
+            wedged_at=d.get("wedged_at"),
             space=d.get("space", {}),
             population=d.get("population", {}),
             epilogues=d.get("epilogues", {}),
@@ -514,10 +573,18 @@ def compute_cache_key(
     kind/count, and the RESOLVED memory budget (the budget changes which
     candidates are rejected, hence the winner).  Learning RATE is deliberately
     excluded — it never changes the compiled program's cost."""
+    import jax
+    import jaxlib
+
     payload = {
-        # v4: the swept space (and CandidateConfig) grew the adapter-rank axis
-        # — any pre-adapter cache entry must miss.  (v3 added the hosts axis.)
-        "v": 4,
+        # v5: jax/jaxlib versions and the backend platform join the key — a
+        # jaxlib upgrade changes compiled-program cost analysis, so it must
+        # not silently serve a stale tuned config.  (v4 grew the adapter-rank
+        # axis; v3 added the hosts axis.)
+        "v": 5,
+        "jax": str(jax.__version__),
+        "jaxlib": str(getattr(jaxlib, "__version__", jax.__version__)),
+        "platform": str(jax.devices()[0].platform),
         "adapter": adapter.to_dict() if adapter is not None else None,
         "hbm_budget": hbm_budget,
         "model": _model_fingerprint(model),
@@ -733,11 +800,7 @@ def _evaluate_candidate(
             ),
         )
 
-    name = (
-        f"cand_chunk{cand.client_chunk or 0}_rpb{cand.rounds_per_block}"
-        f"_m{cand.model_shards}_b{cand.batch_size}_h{cand.hosts}"
-        + (f"_r{cand.adapter_rank}" if cand.adapter_rank is not None else "")
-    )
+    name = candidate_program_name(cand)
     try:
         if cand.rounds_per_block == 1:
             fn = build_round_step(
@@ -848,6 +911,8 @@ def autotune(
     force: bool = False,
     include_epilogues: bool = True,
     adapter: Any = None,
+    compile_budget_s: float | None = None,
+    candidate_deadline_s: float | None = None,
 ) -> AutotuneResult:
     """Sweep the round-program configuration space with the compiler's cost
     model; returns the ranked :class:`AutotuneResult` (winner first).
@@ -867,6 +932,17 @@ def autotune(
     read-only model-sharded input), and the epilogue cost table is sized to
     the ADAPTER payload (the flattened client stack the q8 dequant-accumulate
     epilogue would actually reduce in adapter mode).
+
+    The sweep is compile-budget aware (the r14 wedge postmortem): candidates
+    compile in :func:`order_by_predicted_compile_cost` order (cheapest first);
+    ``compile_budget_s`` (env ``NANOFED_AUTOTUNE_COMPILE_BUDGET``) caps the
+    RUNNING compile-seconds total — once spent, remaining candidates are
+    recorded ``skipped: compile_budget`` instead of compiled; and
+    ``candidate_deadline_s`` (env ``NANOFED_AUTOTUNE_CANDIDATE_DEADLINE``)
+    bounds each single compile — a candidate that blows it is recorded as the
+    sweep's ``wedged_at`` and the rest are skipped (XLA compiles cannot be
+    preempted, so the wedged compile finishes in a daemon thread while the
+    sweep returns what it has).  Both default to unbounded.
     """
     import jax
 
@@ -910,15 +986,76 @@ def autotune(
             )
             _finish(cached, out_dir, telemetry)
             return cached
+    if compile_budget_s is None:
+        env_budget = os.environ.get("NANOFED_AUTOTUNE_COMPILE_BUDGET")
+        compile_budget_s = float(env_budget) if env_budget else None
+    if candidate_deadline_s is None:
+        env_deadline = os.environ.get("NANOFED_AUTOTUNE_CANDIDATE_DEADLINE")
+        candidate_deadline_s = float(env_deadline) if env_deadline else None
+
     outcomes: list[CandidateOutcome] = []
     compiles = 0
-    for cand in space.candidates():
-        outcome = _evaluate_candidate(
-            cand, model, population, training, participation, num_rounds,
-            eval_every, n_devices, budget, adapter=adapter,
-        )
-        if outcome.cost.get("compile_seconds") is not None:
+    skipped = 0
+    spent = 0.0
+    wedged_at: str | None = None
+    for cand in order_by_predicted_compile_cost(space.candidates()):
+        if wedged_at is not None:
+            skipped += 1
+            outcomes.append(CandidateOutcome(cand, False, reject_reason=(
+                f"skipped: compile_budget (sweep wedged at {wedged_at}, "
+                f"{spent:.1f}s compile spent over {compiles} compiles)"
+            )))
+            continue
+        if compile_budget_s is not None and spent >= compile_budget_s:
+            skipped += 1
+            outcomes.append(CandidateOutcome(cand, False, reject_reason=(
+                f"skipped: compile_budget ({spent:.1f}s of the "
+                f"{compile_budget_s:.1f}s compile budget spent over "
+                f"{compiles} compiles)"
+            )))
+            continue
+        if candidate_deadline_s is not None:
+            # XLA compiles cannot be preempted: run the evaluation in a daemon
+            # worker and give up waiting at the deadline.  A wedged compile
+            # keeps burning its core in the background, but the SWEEP survives
+            # with the candidates it already priced — the never-silent answer
+            # to the r14 watchdog kill.
+            import threading as _threading
+
+            box: list[CandidateOutcome] = []
+
+            def _work(cand=cand, box=box):
+                box.append(_evaluate_candidate(
+                    cand, model, population, training, participation,
+                    num_rounds, eval_every, n_devices, budget, adapter=adapter,
+                ))
+
+            worker = _threading.Thread(target=_work, daemon=True)
+            worker.start()
+            worker.join(candidate_deadline_s)
+            if not box:
+                wedged_at = candidate_program_name(cand)
+                outcome = CandidateOutcome(cand, False, reject_reason=(
+                    f"wedged: compile exceeded the {candidate_deadline_s:.1f}s "
+                    "candidate deadline"
+                ), cost={"wedged_at": round(float(candidate_deadline_s), 4)})
+            else:
+                outcome = box[0]
+        else:
+            outcome = _evaluate_candidate(
+                cand, model, population, training, participation, num_rounds,
+                eval_every, n_devices, budget, adapter=adapter,
+            )
+        cand_compile_s = outcome.cost.get("compile_seconds")
+        if cand_compile_s is not None:
             compiles += 1
+            spent += float(cand_compile_s)
+            if telemetry is not None:
+                telemetry.record(
+                    "compile", program=candidate_program_name(cand),
+                    seconds=round(float(cand_compile_s), 4),
+                    cache_key=key[:16],
+                )
         outcomes.append(outcome)
         _log.info(
             "autotune candidate %s: %s",
@@ -950,6 +1087,9 @@ def autotune(
         compile_seconds_total=math.fsum(
             o.cost.get("compile_seconds", 0.0) for o in outcomes
         ),
+        compile_budget_s=compile_budget_s,
+        skipped=skipped,
+        wedged_at=wedged_at,
         space=space.to_dict(),
         population=population.to_dict(),
     )
@@ -975,9 +1115,12 @@ def autotune(
         except Exception as e:  # the sweep result must not die on the side table
             result.epilogues = {"error": f"epilogue profiling failed: {e}"}
 
-    if cache_path is not None and result.winner is not None:
+    if cache_path is not None and result.winner is not None and skipped == 0:
         # Failed (all-rejected) sweeps are never cached: a later invocation
         # must re-reject — and re-raise — rather than return winner=None.
+        # Budget-truncated/wedged sweeps are not cached either — their winner
+        # is the best of an INCOMPLETE table, and the re-sweep is cheap: the
+        # already-compiled candidates hit the persistent XLA cache.
         _write_cache(cache_path, result)
     _finish(result, out_dir, telemetry)
     if result.winner is None:

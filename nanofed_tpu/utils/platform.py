@@ -127,4 +127,15 @@ def enable_compilation_cache(cache_dir: str | os.PathLike | None = None) -> str:
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # jax initializes its cache object ONCE, at the first compile — in a
+    # process that already jitted something (a warm coordinator, a test run)
+    # the object has latched (possibly to "no cache") and the config update
+    # above would silently never take effect.  Reset so the next compile
+    # re-initializes against the directory we just configured.
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # pragma: no cover - old/new jax layout drift
+        pass
     return path

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Record the scan-over-layers compile-wall evidence artifact.
+
+Measures the compile walltime of the REAL dispatched transformer round
+program (``build_round_step`` via the autotuner's lowering path — not a bare
+forward pass) at several depths, unrolled vs ``scan_layers=True``, and writes
+``runs/compile_r17_<stamp>.json``.  The claim under test: unrolled compile
+cost grows ~linearly in depth because XLA optimizes ``depth`` structurally
+identical block bodies independently, while the scanned layout hands XLA ONE
+block body regardless of depth, so its compile time is near-constant.
+
+The XLA persistent compilation cache is NOT enabled for these measurements
+(``jax_compilation_cache_dir`` stays unset and the autotune result cache is
+off), so every number is a real from-scratch compile.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DEPTHS = (2, 4, 8)
+VOCAB, SEQ_LEN, WIDTH, HEADS = 64, 16, 32, 4
+
+
+def main() -> int:
+    import jax
+
+    from nanofed_tpu.models.transformer import transformer_lm
+    from nanofed_tpu.trainer import TrainingConfig
+    from nanofed_tpu.tuning import PopulationSpec, TuningSpace
+    from nanofed_tpu.tuning.autotuner import autotune
+
+    assert jax.config.jax_compilation_cache_dir is None, (
+        "persistent compilation cache must be OFF while measuring compiles"
+    )
+
+    space = TuningSpace(client_chunks=(None,), rounds_per_blocks=(1,),
+                        model_shards=(1,), batch_sizes=(16,))
+    pop = PopulationSpec(num_clients=8, capacity=32, sample_shape=(SEQ_LEN,),
+                         x_dtype="int32")
+    training = TrainingConfig(batch_size=16, local_epochs=1, learning_rate=0.1)
+
+    rows = []
+    for depth in DEPTHS:
+        row = {"depth": depth}
+        for scan in (False, True):
+            model = transformer_lm(vocab=VOCAB, seq_len=SEQ_LEN, width=WIDTH,
+                                   depth=depth, heads=HEADS, scan_layers=scan)
+            result = autotune(model, pop, training, num_rounds=4, space=space,
+                              cache_dir=None, out_dir=None,
+                              include_epilogues=False)
+            outcome = result.outcomes[0]
+            assert outcome.feasible, outcome.reject_reason
+            key = "scan" if scan else "unrolled"
+            row[f"{key}_compile_s"] = outcome.cost["compile_seconds"]
+        row["scan_over_unrolled"] = round(
+            row["scan_compile_s"] / row["unrolled_compile_s"], 4
+        )
+        rows.append(row)
+        print(f"depth={depth}: unrolled={row['unrolled_compile_s']}s "
+              f"scan={row['scan_compile_s']}s", file=sys.stderr)
+
+    first, last = rows[0], rows[-1]
+    dev = jax.devices()[0]
+    artifact = {
+        "what": (
+            "compile walltime of the dispatched transformer ROUND PROGRAM "
+            "(build_round_step lowered+compiled through the autotuner path) "
+            "at increasing depth, unrolled blocks vs scan-over-layers"
+        ),
+        "basis": (
+            f"measured wall-clock of XLA compilation on platform="
+            f"{dev.platform!r} device_kind={dev.device_kind!r} "
+            f"(jax {jax.__version__}); the persistent compilation cache and "
+            "the autotune result cache were both disabled, so every compile "
+            "is from scratch.  CPU compile walltimes — absolute seconds will "
+            "differ on TPU toolchains, the GROWTH SHAPE in depth is the claim."
+        ),
+        "model": {"vocab": VOCAB, "seq_len": SEQ_LEN, "width": WIDTH,
+                  "heads": HEADS, "depths": list(DEPTHS)},
+        "depths": rows,
+        "growth": {
+            "depth_ratio": last["depth"] / first["depth"],
+            "unrolled_compile_ratio": round(
+                last["unrolled_compile_s"] / first["unrolled_compile_s"], 4
+            ),
+            "scan_compile_ratio": round(
+                last["scan_compile_s"] / first["scan_compile_s"], 4
+            ),
+            "claim": (
+                "unrolled compile grows with depth; scan compile is "
+                "near-constant (ratio ~1) because XLA sees one block body"
+            ),
+        },
+        "parity": (
+            "scan == unrolled layer math (identical logits, identical init "
+            "values, identical RNG splits) is pinned by "
+            "tests/unit/models/test_transformer.py"
+        ),
+    }
+
+    out_dir = Path(__file__).resolve().parent.parent / "runs"
+    out_dir.mkdir(exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    out = out_dir / f"compile_r17_{stamp}.json"
+    out.write_text(json.dumps(artifact, indent=2, sort_keys=True) + "\n")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

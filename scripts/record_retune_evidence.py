@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Record the closed-loop online-retuning evidence artifact.
+
+The claim under test: the autotuner's AOT ranking can be WRONG about measured
+walltime, and the online retuner corrects it mid-run.  On CPU the bytes-
+accessed ordering prefers ``client_chunk=1`` (streaming clients one at a time
+minimizes materialized bytes), but executing that stream is a sequential XLA
+loop — measurably slower per round than the vectorized ``client_chunk=None``
+program on the same workload.
+
+The script runs the real lifecycle, no synthetic numbers anywhere:
+
+1. AOT sweep over {client_chunk None vs 1} x {rounds_per_block 2} — the AOT
+   winner is the chunked candidate.
+2. A probe run measures the ALTERNATIVE (``client_chunk=None``) for real:
+   a coordinator pinned to it trains, and its per-round walltimes (first
+   block dropped — it pays the compile) seed the retuner's measured table.
+3. The closed loop: ``Coordinator.from_autotune`` starts on the AOT winner
+   with ``retune_every=2``; the first blocks measure the incumbent, the
+   retuner re-ranks on measured walltime, the swap lands at a block
+   boundary, and the remaining rounds run the corrected program.
+4. ``write_back()`` stamps the measured numbers into the cached autotune
+   entry, so the next run on this host starts from measured reality.
+
+Writes ``runs/retune_r17_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+NUM_ROUNDS = 16
+RPB = 2
+# Two blocks before the first decision: the incumbent's first block pays its
+# compile, the second is steady state — so the run itself holds a compile-free
+# incumbent measurement to compare the post-swap rounds against.
+RETUNE_EVERY = 4
+PROBE_ROUNDS = 6
+
+
+def read_jsonl(path: Path) -> list[dict]:
+    return [json.loads(line) for line in path.read_text().splitlines() if line]
+
+
+def telemetry_records(tel_dir: Path) -> list[dict]:
+    files = sorted(tel_dir.glob("*.jsonl"))
+    assert files, f"no telemetry written under {tel_dir}"
+    out: list[dict] = []
+    for f in files:
+        out.extend(read_jsonl(f))
+    return out
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+
+    from nanofed_tpu.data import federate, synthetic_classification
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+    from nanofed_tpu.trainer import TrainingConfig
+    from nanofed_tpu.tuning import CandidateConfig, TuningSpace, candidate_program_name
+
+    work = Path(tempfile.mkdtemp(prefix="retune_evidence_"))
+    model = get_model("digits_mlp")
+    train = synthetic_classification(1024, 10, (8, 8, 1), seed=0)
+    data = federate(train, num_clients=32, scheme="iid", batch_size=16, seed=0)
+    training = TrainingConfig(batch_size=16, local_epochs=1, learning_rate=0.1)
+    space = TuningSpace(client_chunks=(None, 1), rounds_per_blocks=(RPB,),
+                        model_shards=(1,), batch_sizes=(16,))
+    alt = CandidateConfig(client_chunk=None, rounds_per_block=RPB,
+                          model_shards=1, batch_size=16)
+
+    # --- 2. Probe the alternative for real ------------------------------------
+    # A short pinned run measures the ALTERNATIVE's steady-state rate (first
+    # block dropped — it pays the program compile); this seeds the retuner's
+    # measured table.  The incumbent needs no probe: the closed loop itself
+    # measures it before the first decision.
+    probe = Coordinator(
+        model=model, train_data=data,
+        config=CoordinatorConfig(num_rounds=PROBE_ROUNDS, seed=0,
+                                 base_dir=work / "probe",
+                                 rounds_per_block=RPB),
+        training=training, client_chunk=None,
+        telemetry_dir=work / "probe_tel",
+    )
+    for _ in probe.start_training():
+        pass
+    probe_rounds = [r for r in telemetry_records(work / "probe_tel")
+                    if r.get("type") == "round"][RPB:]
+    assert len(probe_rounds) >= 2, "probe too short to satisfy min_rounds"
+    probe_n = len(probe_rounds)
+    probe_walltime = sum(r["duration_s"] for r in probe_rounds)
+
+    # --- 1 + 3. The closed loop on the AOT winner -----------------------------
+    coord = Coordinator.from_autotune(
+        model, data,
+        CoordinatorConfig(num_rounds=NUM_ROUNDS, seed=0,
+                          base_dir=work / "runs",
+                          retune_every=RETUNE_EVERY),
+        training, tuning_space=space,
+        autotune_cache_dir=work / "cache",
+        telemetry_dir=work / "tel",
+    )
+    result = coord.autotune_result
+    aot_ranking = [
+        {
+            "program": candidate_program_name(o.config),
+            "config": o.config.to_dict(),
+            "aot_score": o.score,
+            "aot_rank": i,
+        }
+        for i, o in enumerate(sorted(
+            (o for o in result.outcomes if o.feasible),
+            key=lambda o: o.score,
+        ))
+    ]
+    incumbent = coord._retune_candidate
+    assert incumbent.client_chunk == 1, (
+        "expected the AOT model to pick the chunked candidate on CPU "
+        f"(got {incumbent.to_dict()}) — the disagreement premise is gone"
+    )
+    coord.retuner.observe(alt, rounds=probe_n, walltime_s=probe_walltime)
+
+    for _ in coord.start_training():
+        pass
+
+    # --- Harvest --------------------------------------------------------------
+    records = telemetry_records(work / "tel")
+    retunes = [r for r in records if r.get("type") == "retune"]
+    summaries = [r for r in records if r.get("type") == "retune_summary"]
+    assert summaries, "no retune_summary record — write_back never ran"
+    summary = summaries[-1]
+    swaps = [r for r in retunes if r.get("swap") and r.get("applied")]
+    assert len(swaps) == 1, f"expected exactly one applied swap, got {swaps}"
+    swap = swaps[0]
+    assert swap["round"] % RPB == 0, "swap did not land on a block boundary"
+
+    table = coord.retuner.measured_table()
+    inc_name = candidate_program_name(incumbent)
+    alt_name = candidate_program_name(alt)
+    inc_measured = table[inc_name]
+    alt_measured = table[alt_name]
+    # The alternative's table row mixes the probe seed with the post-swap
+    # blocks; subtract the probe to report the post-swap rounds alone.
+    post_swap_rounds = alt_measured["rounds"] - probe_n
+    post_swap_s_per_round = (
+        alt_measured["rounds"] * alt_measured["s_per_round"] - probe_walltime
+    ) / post_swap_rounds
+    assert post_swap_rounds == NUM_ROUNDS - swap["round"]
+    assert post_swap_s_per_round < inc_measured["s_per_round"], (
+        "post-swap rounds were not faster than the incumbent's measured rate"
+    )
+
+    # Steady-state rates straight from the run's own round telemetry, so the
+    # comparison is within one process (cross-phase walltimes on a 1-core
+    # host drift too much to compare).  Rounds are 0-indexed; each program's
+    # FIRST block pays its compile and is dropped:
+    #   [0, RPB)                incumbent compile block
+    #   [RPB, swap)             incumbent steady state
+    #   [swap, swap+RPB)        swapped-in program's compile block
+    #   [swap+RPB, NUM_ROUNDS)  post-swap steady state
+    round_records = {r["round"]: r for r in records if r.get("type") == "round"}
+    inc_steady_rounds = [
+        round_records[i]["duration_s"] for i in range(RPB, swap["round"])
+    ]
+    post_steady_rounds = [
+        round_records[i]["duration_s"]
+        for i in range(swap["round"] + RPB, NUM_ROUNDS)
+    ]
+    assert inc_steady_rounds and post_steady_rounds
+    inc_steady = sum(inc_steady_rounds) / len(inc_steady_rounds)
+    post_swap_steady = sum(post_steady_rounds) / len(post_steady_rounds)
+    assert post_swap_steady < inc_steady, (
+        f"steady post-swap rounds ({post_swap_steady:.6f}s) were not faster "
+        f"than the incumbent's steady block ({inc_steady:.6f}s)"
+    )
+
+    cache_entry = summary.get("cache_entry")
+    assert cache_entry, "retune_summary carries no cache_entry path"
+    entry = json.loads(Path(cache_entry).read_text())
+    assert "measured" in entry, "write-back left no measured block in the entry"
+
+    dev = jax.devices()[0]
+    artifact = {
+        "what": (
+            "closed-loop online retuning on a real workload: the AOT ranking "
+            "prefers the chunked round program, measured walltime prefers "
+            "the vectorized one, and the retuner swaps mid-run"
+        ),
+        "basis": (
+            f"measured wall-clock per round on platform={dev.platform!r} "
+            f"device_kind={dev.device_kind!r} (jax {jax.__version__}); the "
+            "AOT scores are the sweep's bytes-accessed ordering (CPU has no "
+            "published peaks).  digits_mlp, 32 clients iid, synthetic "
+            f"classification, {NUM_ROUNDS} rounds, rounds_per_block={RPB}, "
+            f"retune_every={RETUNE_EVERY}."
+        ),
+        "aot_ranking": aot_ranking,
+        "probe": {
+            "program": alt_name,
+            "rounds": probe_n,
+            "walltime_s": round(probe_walltime, 6),
+            "s_per_round": round(probe_walltime / probe_n, 6),
+            "note": "first block dropped (compile), fed to the retuner as "
+                    "the alternative's real measurement",
+        },
+        "disagreement": {
+            "aot_winner": inc_name,
+            "measured_winner": alt_name,
+            "aot_says": f"{inc_name} beats {alt_name}",
+            "measured_says": (
+                f"{alt_name} at {post_swap_steady:.6f}s/round steady-state "
+                f"beats {inc_name} at {inc_steady:.6f}s/round steady-state, "
+                "both measured inside the same closed-loop run"
+            ),
+            "steady_state_s_per_round": {
+                inc_name: round(inc_steady, 6),
+                alt_name: round(post_swap_steady, 6),
+            },
+            "note": (
+                "steady-state = the run's own per-round telemetry walltimes "
+                "with each program's first (compile-paying) block dropped; "
+                "the retuner's in-run measurements additionally charge the "
+                "incumbent its first-block compile, which is real cost too"
+            ),
+        },
+        "swap": {
+            "round": swap["round"],
+            "block_boundary": True,
+            "old_program": swap.get("old_program"),
+            "new_program": swap.get("new_program"),
+            "delta": swap.get("delta"),
+            "basis": swap.get("basis"),
+        },
+        "post_swap": {
+            "rounds": post_swap_rounds,
+            "s_per_round": round(post_swap_s_per_round, 6),
+            "incumbent_s_per_round": inc_measured["s_per_round"],
+            "speedup_pct": round(100.0 * (
+                1.0 - post_swap_s_per_round / inc_measured["s_per_round"]
+            ), 2),
+            "steady_s_per_round": round(post_swap_steady, 6),
+            "steady_rounds": len(post_steady_rounds),
+            "steady_vs_incumbent_steady_speedup_pct": round(100.0 * (
+                1.0 - post_swap_steady / inc_steady
+            ), 2),
+        },
+        "decisions": [
+            {k: r.get(k) for k in (
+                "round", "swap", "applied", "old_program", "new_program",
+                "measured_s_per_round", "candidate_s_per_round", "delta",
+                "basis", "reason",
+            ) if r.get(k) is not None}
+            for r in retunes
+        ],
+        "write_back": {
+            "cache_entry": cache_entry,
+            "measured": entry["measured"],
+        },
+    }
+
+    out_dir = Path(__file__).resolve().parent.parent / "runs"
+    out_dir.mkdir(exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    out = out_dir / f"retune_r17_{stamp}.json"
+    out.write_text(json.dumps(artifact, indent=2, sort_keys=True) + "\n")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -175,6 +175,54 @@ def test_works_on_abstract_trees(transformer_base):
     assert all(isinstance(x, np.ndarray) for x in jax.tree.leaves(ad))
 
 
+def test_stacked_scan_leaves_get_per_layer_adapters():
+    """scan_layers=True stacks block kernels into [L, d_in, d_out] leaves; the
+    adapter algebra must address per-layer slices — A [L, d_in, r], B
+    [L, r, d_out] — and stay numerically identical to adapting each layer of
+    the unrolled tree."""
+    mu = get_model(
+        "transformer_lm", vocab=32, seq_len=8, width=16, depth=3, heads=2
+    )
+    ms = get_model(
+        "transformer_lm_scan", vocab=32, seq_len=8, width=16, depth=3, heads=2
+    )
+    pu, ps = mu.init(jax.random.key(1)), ms.init(jax.random.key(1))
+    spec = AdapterSpec(rank=2)
+    au = init_adapters(spec, pu, rng=0)
+    a_s = init_adapters(spec, ps, rng=0)
+
+    # Stacked A/B leaves carry the leading layer dim.
+    wq = a_s["blocks"]["attn"]["wq"]["kernel"]
+    assert wq["A"].shape == (3, 16, 2) and wq["B"].shape == (3, 2, 16)
+
+    # Trainable count matches the unrolled tree (same adapted surface).
+    assert (
+        adapter_param_count(spec, ps)["adapter_params"]
+        == adapter_param_count(spec, pu)["adapter_params"]
+    )
+
+    # B=0 start: merge is the identity.
+    merged0 = merge_adapters(ps, a_s, spec)
+    for a, b in zip(jax.tree.leaves(merged0), jax.tree.leaves(ps)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # With nonzero B the batched delta equals the per-layer matmul.
+    bumped = jax.tree.map(lambda x: x + 0.1, a_s)
+    delta = adapter_delta(spec, ps, bumped)
+    d = np.asarray(delta["blocks"]["attn"]["wq"]["kernel"])
+    A = np.asarray(bumped["blocks"]["attn"]["wq"]["kernel"]["A"])
+    B = np.asarray(bumped["blocks"]["attn"]["wq"]["kernel"]["B"])
+    for layer in range(3):
+        np.testing.assert_allclose(
+            d[layer], spec.scaling * A[layer] @ B[layer], atol=1e-6
+        )
+
+    # Merge/unmerge still round-trips on the stacked tree.
+    out = unmerge_adapters(merge_adapters(ps, bumped, spec), bumped, spec)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ps)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_adapter_tree_rides_checkpoint_layout(transformer_base):
     """The adapter tree round-trips through the '/'-path npz codec like any
     params tree — a captured adapter payload IS a loadable checkpoint."""

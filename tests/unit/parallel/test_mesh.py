@@ -115,6 +115,21 @@ def test_param_partition_spec_single_shard_replicates():
     assert param_partition_spec((8, 16), 1) == P()
 
 
+def test_param_partition_spec_never_shards_stacked_layer_dim():
+    """Rank>=3 leaves are scan-stacked layer params [L, ...]: the leading dim
+    indexes layers, so sharding it across the model axis would split the scan
+    carry — dim 0 must never be chosen even when it is the largest divisible
+    dim."""
+    # L=8 divisible and largest: still skipped, largest remaining dim wins.
+    assert param_partition_spec((8, 4, 6), 2) == P(None, None, MODEL_AXIS)
+    # Only dim 0 divisible -> replicate rather than split the stack.
+    assert param_partition_spec((8, 3, 5), 2) == P()
+    # Rank-2 leaves keep the old behavior (dim 0 eligible).
+    assert param_partition_spec((8, 5), 2) == P(MODEL_AXIS)
+    # Stacked conv-style rank-4 leaves also skip dim 0.
+    assert param_partition_spec((4, 3, 8, 5), 4) == P(None, None, MODEL_AXIS)
+
+
 def test_param_sharding_mixed_tree(devices):
     mesh = make_mesh(shape=(2, 4))
     tree = {"kernel": jnp.zeros((8, 16)), "odd_bias": jnp.zeros((3,)), "s": jnp.zeros(())}

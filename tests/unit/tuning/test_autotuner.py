@@ -294,6 +294,175 @@ class TestHostsAxis:
         )
         assert one != two
 
+class TestCompileBudget:
+    """Compile-budget-aware sweep pruning: cheapest-predicted-compile first,
+    budget exhaustion skips (stated, never silent), a deadline-blown compile
+    wedges the sweep without killing it — the r14 postmortem features."""
+
+    def _fake_eval(self, seconds_by_rpb):
+        from nanofed_tpu.tuning.autotuner import CandidateOutcome
+
+        def fake(cand, *a, **kw):
+            s = seconds_by_rpb.get(cand.rounds_per_block, 0.1)
+            return CandidateOutcome(
+                cand, True, score=100.0 - cand.rounds_per_block,
+                cost={"compile_seconds": s, "peak_bytes": 1,
+                      "bytes_accessed_per_round": 100.0},
+            )
+        return fake
+
+    def test_sweep_order_is_cheapest_compile_first(self):
+        from nanofed_tpu.tuning.autotuner import (
+            order_by_predicted_compile_cost,
+            predicted_compile_cost,
+        )
+
+        space = TuningSpace(
+            client_chunks=(None, 1), rounds_per_blocks=(1, 4),
+            model_shards=(1, 2), batch_sizes=(16,),
+        )
+        ordered = order_by_predicted_compile_cost(space.candidates())
+        costs = [predicted_compile_cost(c) for c in ordered]
+        assert costs == sorted(costs)
+        # The plain single-round unchunked unsharded candidate compiles first,
+        # the fused+chunked+sharded one last.
+        assert (ordered[0].client_chunk, ordered[0].rounds_per_block,
+                ordered[0].model_shards) == (None, 1, 1)
+        assert ordered[-1].rounds_per_block == 4
+        assert ordered[-1].model_shards == 2
+        # Deterministic: re-ordering the same set is a fixpoint.
+        assert order_by_predicted_compile_cost(ordered) == ordered
+
+    def test_budget_exhaustion_skips_remaining_stated(self, tmp_path, monkeypatch):
+        from nanofed_tpu.tuning import autotuner
+
+        monkeypatch.setattr(
+            autotuner, "_evaluate_candidate", self._fake_eval({1: 5.0, 2: 5.0})
+        )
+        result = _sweep(
+            tmp_path, compile_budget_s=6.0, cache_dir=None, out_dir=None,
+        )
+        skipped = [o for o in result.outcomes
+                   if o.reject_reason and o.reject_reason.startswith("skipped:")]
+        assert result.skipped == len(skipped) > 0
+        assert result.compiles + result.skipped == len(result.outcomes)
+        assert all("compile_budget" in o.reject_reason for o in skipped)
+        assert result.compile_budget_s == 6.0
+        # The cheap head still produced a feasible winner.
+        assert result.winner is not None
+        assert result.to_dict()["skipped"] == result.skipped
+
+    def test_budget_truncated_sweep_is_not_cached(self, tmp_path, monkeypatch):
+        from nanofed_tpu.tuning import autotuner
+
+        monkeypatch.setattr(
+            autotuner, "_evaluate_candidate", self._fake_eval({1: 5.0, 2: 5.0})
+        )
+        _sweep(tmp_path, compile_budget_s=6.0)
+        assert not list((tmp_path / "cache").glob("autotune_*.json"))
+        # A complete sweep under the same key IS cached.
+        full = _sweep(tmp_path)
+        assert full.skipped == 0
+        assert list((tmp_path / "cache").glob("autotune_*.json"))
+
+    def test_candidate_deadline_records_wedged_at(self, tmp_path, monkeypatch):
+        import time as _time
+
+        from nanofed_tpu.tuning import autotuner
+        from nanofed_tpu.tuning.autotuner import (
+            CandidateOutcome,
+            candidate_program_name,
+        )
+
+        def slow_eval(cand, *a, **kw):
+            if cand.rounds_per_block > 1:
+                _time.sleep(5.0)
+            return CandidateOutcome(
+                cand, True, score=1.0,
+                cost={"compile_seconds": 0.01, "peak_bytes": 1},
+            )
+
+        monkeypatch.setattr(autotuner, "_evaluate_candidate", slow_eval)
+        result = _sweep(
+            tmp_path, candidate_deadline_s=0.2, cache_dir=None, out_dir=None,
+        )
+        assert result.wedged_at is not None
+        assert result.wedged_at.startswith("cand_")
+        wedged = [o for o in result.outcomes
+                  if o.reject_reason and o.reject_reason.startswith("wedged:")]
+        assert len(wedged) == 1
+        assert candidate_program_name(wedged[0].config) == result.wedged_at
+        assert wedged[0].cost["wedged_at"] == pytest.approx(0.2)
+        # Everything ordered after the wedge is skipped with the wedge named.
+        after = [o for o in result.outcomes
+                 if o.reject_reason and o.reject_reason.startswith("skipped:")]
+        assert all(result.wedged_at in o.reject_reason for o in after)
+        # The cheap candidates that compiled BEFORE the wedge hold the winner.
+        assert result.winner is not None
+        assert result.to_dict()["wedged_at"] == result.wedged_at
+
+    def test_env_var_budget(self, tmp_path, monkeypatch):
+        from nanofed_tpu.tuning import autotuner
+
+        monkeypatch.setattr(
+            autotuner, "_evaluate_candidate", self._fake_eval({1: 5.0, 2: 5.0})
+        )
+        monkeypatch.setenv("NANOFED_AUTOTUNE_COMPILE_BUDGET", "6.0")
+        result = _sweep(tmp_path, cache_dir=None, out_dir=None)
+        assert result.compile_budget_s == 6.0
+        assert result.skipped > 0
+
+    def test_compile_telemetry_records(self, tmp_path, monkeypatch):
+        from nanofed_tpu.tuning import autotuner
+
+        class FakeTelemetry:
+            def __init__(self):
+                self.records = []
+
+            def record(self, rtype, **fields):
+                self.records.append({"type": rtype, **fields})
+
+        monkeypatch.setattr(
+            autotuner, "_evaluate_candidate", self._fake_eval({})
+        )
+        tel = FakeTelemetry()
+        result = _sweep(tmp_path, cache_dir=None, out_dir=None, telemetry=tel)
+        compiles = [r for r in tel.records if r["type"] == "compile"]
+        assert len(compiles) == result.compiles > 0
+        for r in compiles:
+            assert r["program"].startswith("cand_")
+            assert r["seconds"] > 0
+            assert r["cache_key"] == result.cache_key[:16]
+
+
+class TestCacheKeyV5:
+    def test_cache_key_folds_in_jax_versions_and_platform(self, monkeypatch):
+        """v5 regression: a jaxlib upgrade (or a backend change) must MISS the
+        cache — stale tuned configs from another toolchain are worse than a
+        re-sweep."""
+        import jax
+
+        from nanofed_tpu.tuning.autotuner import compute_cache_key
+
+        kwargs = dict(
+            model=MODEL, population=POP, training=TRAINING,
+            space=TINY_SPACE, participation=1.0, num_rounds=4, eval_every=0,
+            device_kind="cpu", num_devices=8, hbm_budget=None,
+        )
+        before = compute_cache_key(**kwargs)
+        monkeypatch.setattr(jax, "__version__", "0.0.0-other")
+        after = compute_cache_key(**kwargs)
+        assert before != after
+
+        import jaxlib
+
+        monkeypatch.undo()
+        assert compute_cache_key(**kwargs) == before
+        monkeypatch.setattr(
+            jaxlib, "__version__", "0.0.0-other", raising=False
+        )
+        assert compute_cache_key(**kwargs) != before
+
     def test_winner_hosts_survives_artifact_round_trip(self):
         from nanofed_tpu.tuning.autotuner import AutotuneResult
 

@@ -1,0 +1,167 @@
+"""Compile-cache lifecycle tests: the hit/miss counter bridge, the manifest
+round-trip + toolchain verification, and the ``warm()`` pre-compile pass."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nanofed_tpu.models import get_model
+from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.trainer import TrainingConfig
+from nanofed_tpu.tuning import (
+    PopulationSpec,
+    TuningSpace,
+    build_manifest,
+    verify_manifest,
+    warm,
+    write_manifest,
+)
+from nanofed_tpu.tuning import compile_cache
+from nanofed_tpu.utils.platform import enable_compilation_cache
+from nanofed_tpu.tuning.compile_cache import (
+    COMPILE_CACHE_HITS,
+    COMPILE_CACHE_MISSES,
+    install_compile_cache_metrics,
+)
+
+MODEL = get_model("digits_mlp")
+POP = PopulationSpec(num_clients=8, capacity=32, sample_shape=(8, 8, 1))
+TRAINING = TrainingConfig(batch_size=16, local_epochs=1, learning_rate=0.1)
+ONE_CAND_SPACE = TuningSpace(
+    client_chunks=(None,), rounds_per_blocks=(1,), model_shards=(1,),
+    batch_sizes=(16,),
+)
+
+# jax.monitoring keeps listeners forever, so the FIRST install in the process
+# wins the registry (another test in the same pytest run — e.g. warm() — may
+# have already installed with the default registry); read the counters from
+# whichever registry the bridge actually adopted.
+REGISTRY = MetricsRegistry()
+
+
+def adopted_registry() -> MetricsRegistry:
+    assert install_compile_cache_metrics(REGISTRY) is True
+    return compile_cache._metrics_registry
+
+
+class TestCounterBridge:
+    def test_install_is_idempotent(self):
+        reg = adopted_registry()
+        assert install_compile_cache_metrics(MetricsRegistry()) is True
+        # Later registries are NOT adopted (first-caller rule) — the counters
+        # live in the first caller's registry and nowhere else.
+        assert COMPILE_CACHE_HITS in reg.snapshot()
+
+    def test_miss_then_hit_counted(self, tmp_path):
+        REGISTRY = adopted_registry()
+        # Route through enable_compilation_cache: it resets jax's latched
+        # cache object, so this works even after earlier tests compiled with
+        # a different (or no) cache dir in this process.
+        enable_compilation_cache(tmp_path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            def misses():
+                snap = REGISTRY.snapshot()
+                return snap[COMPILE_CACHE_MISSES]["values"].get("", 0)
+
+            def hits():
+                snap = REGISTRY.snapshot()
+                return snap[COMPILE_CACHE_HITS]["values"].get("", 0)
+
+            m0, h0 = misses(), hits()
+            x = jnp.ones((16, 16))
+            jax.jit(lambda a: jnp.tanh(a) @ a.T)(x).block_until_ready()
+            # XLA emits one miss event per cached module part, so assert
+            # direction, not an exact count.
+            m1, h1 = misses(), hits()
+            assert m1 > m0 and h1 == h0
+            # A DISTINCT jit of the same jaxpr replays from the persistent
+            # cache: hits, no new miss.
+            jax.jit(lambda a: jnp.tanh(a) @ a.T)(x).block_until_ready()
+            assert hits() > h1 and misses() == m1
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+
+class TestManifest:
+    def test_build_and_write_round_trip(self, tmp_path):
+        (tmp_path / "xla_entry_0").write_bytes(b"\x00" * 64)
+        (tmp_path / "autotune_deadbeef.json").write_text(json.dumps(
+            {"cache_key": "deadbeef" * 8, "winner": {"rounds_per_block": 2}}
+        ))
+        path = write_manifest(tmp_path)
+        d = json.loads(path.read_text())
+        assert d["xla_entries"] == 1 and d["xla_bytes"] == 64
+        assert d["autotune_entries"][0]["cache_key"] == ("deadbeef" * 8)[:16]
+        assert d["autotune_entries"][0]["winner"] == {"rounds_per_block": 2}
+        assert d["toolchain"]["jax"] == str(jax.__version__)
+        # Re-building does not count the manifest itself as an entry.
+        assert build_manifest(tmp_path)["xla_entries"] == 1
+
+    def test_verify_matching_toolchain(self, tmp_path):
+        write_manifest(tmp_path)
+        v = verify_manifest(tmp_path)
+        assert v["compatible"] is True and v["reasons"] == []
+
+    def test_verify_flags_foreign_jaxlib(self, tmp_path, monkeypatch):
+        write_manifest(tmp_path)
+        import jaxlib
+
+        monkeypatch.setattr(jaxlib, "__version__", "0.0.0-foreign", raising=False)
+        v = verify_manifest(tmp_path)
+        assert v["compatible"] is False
+        assert any("jaxlib" in r for r in v["reasons"])
+
+    def test_verify_missing_manifest_is_stated_not_raised(self, tmp_path):
+        v = verify_manifest(tmp_path / "nowhere")
+        assert v["compatible"] is False
+        assert any("no manifest" in r for r in v["reasons"])
+        assert v["manifest"] is None
+
+
+class TestWarm:
+    def test_warm_compiles_and_stamps_manifest(self, tmp_path):
+        cache = tmp_path / "cache"
+        result = warm(
+            MODEL, POP, TRAINING, num_rounds=2, space=ONE_CAND_SPACE,
+            cache_dir=cache,
+        )
+        assert result.autotune.compiles == 1
+        assert result.programs[0]["program"].startswith("cand_")
+        assert result.programs[0]["compile_seconds"] > 0
+        d = json.loads((cache / "manifest.json").read_text())
+        assert d["warmed"]["compiles"] == 1
+        assert d["warmed"]["model"] == MODEL.name
+        assert d["warmed"]["cache_key"] == result.autotune.cache_key[:16]
+        # The sweep table itself shipped into the cache dir.
+        assert d["autotune_entries"]
+        assert verify_manifest(cache)["compatible"] is True
+
+    def test_rewarm_hits_the_autotune_cache(self, tmp_path):
+        cache = tmp_path / "cache"
+        warm(MODEL, POP, TRAINING, num_rounds=2, space=ONE_CAND_SPACE,
+             cache_dir=cache)
+        again = warm(MODEL, POP, TRAINING, num_rounds=2, space=ONE_CAND_SPACE,
+                     cache_dir=cache)
+        assert again.autotune.cache_hit is True
+        assert again.autotune.compiles == 0
+        assert again.programs == []
+        manifest = json.loads((cache / "manifest.json").read_text())
+        assert manifest["warmed"]["cache_hit"] is True
+
+    def test_warm_emits_compile_records(self, tmp_path):
+        class FakeTelemetry:
+            def __init__(self):
+                self.records = []
+
+            def record(self, rtype, **fields):
+                self.records.append({"type": rtype, **fields})
+
+        tel = FakeTelemetry()
+        warm(
+            MODEL, POP, TRAINING, num_rounds=2, space=ONE_CAND_SPACE,
+            cache_dir=tmp_path / "cache", telemetry=tel, force=True,
+        )
+        assert [r for r in tel.records if r["type"] == "compile"]

@@ -1,0 +1,206 @@
+"""OnlineRetuner unit tests: the measurement table, the eligibility scope
+rule, hysteresis, the AOT-disagreement swap, and the cache write-back —
+all pure control-loop arithmetic, zero compiles."""
+
+import json
+
+import pytest
+
+from nanofed_tpu.tuning import (
+    AutotuneResult,
+    CandidateConfig,
+    CandidateOutcome,
+    OnlineRetuner,
+)
+from nanofed_tpu.tuning.autotuner import candidate_program_name
+
+# The AOT table ranks RPB4 best (score 1.0) over RPB1 (score 2.0) —
+# measurements will say otherwise.
+RPB4 = CandidateConfig(None, 4, 1, 16)
+RPB1 = CandidateConfig(None, 1, 1, 16)
+CHUNKED = CandidateConfig(2, 1, 1, 16)
+OTHER_MESH = CandidateConfig(None, 1, 2, 16)
+OTHER_BATCH = CandidateConfig(None, 1, 1, 32)
+
+
+def make_result(tmp_path=None, cache_key="k" * 64):
+    outcomes = [
+        CandidateOutcome(RPB4, True, score=1.0,
+                         cost={"peak_bytes": 10, "compile_seconds": 1.0}),
+        CandidateOutcome(RPB1, True, score=2.0,
+                         cost={"peak_bytes": 5, "compile_seconds": 0.5}),
+        CandidateOutcome(CHUNKED, True, score=3.0,
+                         cost={"peak_bytes": 4, "compile_seconds": 0.5}),
+        CandidateOutcome(OTHER_MESH, True, score=0.5,
+                         cost={"peak_bytes": 6, "compile_seconds": 2.0}),
+        CandidateOutcome(OTHER_BATCH, True, score=0.4,
+                         cost={"peak_bytes": 6, "compile_seconds": 2.0}),
+    ]
+    return AutotuneResult(
+        winner=RPB4, outcomes=outcomes,
+        scoring_basis="test", platform="cpu", device_kind="cpu",
+        num_devices=1, hbm_budget_bytes=None, budget_basis="none",
+        cache_key=cache_key,
+    )
+
+
+def retuner(**kw):
+    kw.setdefault("cache_dir", None)
+    return OnlineRetuner(make_result(), **kw)
+
+
+class TestObserve:
+    def test_accumulates_and_averages(self):
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=2.0, occupancy=0.5)
+        rt.observe(RPB4, rounds=4, walltime_s=4.0, occupancy=0.7)
+        assert rt.measured_s_per_round(RPB4) == pytest.approx(0.75)
+        table = rt.measured_table()
+        row = table[candidate_program_name(RPB4)]
+        assert row["rounds"] == 8
+        assert row["occupancy_mean"] == pytest.approx(0.6)
+
+    def test_garbage_measurements_dropped(self):
+        rt = retuner()
+        rt.observe(RPB4, rounds=0, walltime_s=1.0)
+        rt.observe(RPB4, rounds=2, walltime_s=float("nan"))
+        rt.observe(RPB4, rounds=2, walltime_s=-1.0)
+        assert rt.measured_s_per_round(RPB4) is None
+
+
+class TestPropose:
+    def test_insufficient_measurements_holds(self):
+        rt = retuner(min_rounds=4)
+        rt.observe(RPB4, rounds=2, walltime_s=1.0)
+        d = rt.propose(RPB4)
+        assert not d.swap
+        assert "insufficient measurements" in d.reason
+
+    def test_measured_ranking_beats_aot_ranking(self):
+        """The headline loop: AOT ranked RPB4 over RPB1 (score 1.0 < 2.0), but
+        measurements say RPB4 realizes 1.0 s/round — the calibrated estimate
+        for RPB1 wins only if its own MEASUREMENT says so."""
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)     # 1.0 s/round realized
+        rt.observe(RPB1, rounds=2, walltime_s=0.5)     # 0.25 s/round realized
+        d = rt.propose(RPB4)
+        assert d.swap and d.new == RPB1
+        assert d.basis == "measured"
+        assert d.measured_s_per_round == pytest.approx(1.0)
+        assert d.candidate_s_per_round == pytest.approx(0.25)
+        assert d.delta == pytest.approx(0.75)
+
+    def test_calibrated_estimate_never_swaps_uphill(self):
+        """With only the incumbent measured, estimates scale by AOT score
+        ratio — every alternative scores WORSE than the incumbent here, so
+        no estimate can cross the hysteresis bar."""
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        d = rt.propose(RPB4)
+        assert not d.swap
+        assert "hysteresis" in d.reason
+
+    def test_calibrated_estimate_can_swap_downhill(self):
+        """Incumbent RPB1 (score 2.0) measured; RPB4 (score 1.0) estimates at
+        half the measured time — swap fires on the estimate basis."""
+        rt = retuner()
+        rt.observe(RPB1, rounds=4, walltime_s=4.0)
+        d = rt.propose(RPB1)
+        assert d.swap and d.new == RPB4
+        assert d.basis.startswith("estimated")
+        assert d.candidate_s_per_round == pytest.approx(0.5)
+
+    def test_hysteresis_blocks_marginal_wins(self):
+        rt = retuner(hysteresis=0.2)
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        rt.observe(RPB1, rounds=4, walltime_s=3.6)  # only 10% better
+        d = rt.propose(RPB4)
+        assert not d.swap
+        assert "hysteresis" in d.reason
+        assert d.candidate_s_per_round == pytest.approx(0.9)
+
+    def test_scope_rule_marks_ineligible_with_reasons(self):
+        """Mesh/batch/rank-changing candidates would reshard the resident
+        world — they are considered, stated ineligible, never swapped to."""
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        rt.observe(OTHER_MESH, rounds=4, walltime_s=0.1)   # fastest, ineligible
+        rt.observe(OTHER_BATCH, rounds=4, walltime_s=0.1)
+        d = rt.propose(RPB4)
+        assert d.new != OTHER_MESH and d.new != OTHER_BATCH
+        rows = {json.dumps(r["config"], sort_keys=True): r for r in d.considered}
+        mesh_row = rows[json.dumps(OTHER_MESH.to_dict(), sort_keys=True)]
+        batch_row = rows[json.dumps(OTHER_BATCH.to_dict(), sort_keys=True)]
+        assert "mesh shape" in mesh_row["ineligible"]
+        assert "batch size" in batch_row["ineligible"]
+
+    def test_decision_serializes_for_telemetry(self):
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        rt.observe(RPB1, rounds=4, walltime_s=1.0)
+        d = rt.propose(RPB4).to_dict()
+        assert d["swap"] is True
+        assert d["old_program"] == candidate_program_name(RPB4)
+        assert d["new_program"] == candidate_program_name(RPB1)
+        assert d["considered"]
+        json.dumps(d)  # JSON-clean
+
+    def test_summary_counts_swaps(self):
+        rt = retuner()
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        rt.propose(RPB4)                    # hold (hysteresis)
+        rt.observe(RPB1, rounds=4, walltime_s=1.0)
+        rt.propose(RPB4)                    # swap
+        s = rt.summary()
+        assert s["decisions"] == 2 and s["swaps"] == 1
+        assert s["swap_history"][0]["new"] == RPB1.to_dict()
+
+
+class TestWriteBack:
+    def _seed_cache(self, tmp_path, result):
+        path = tmp_path / f"autotune_{result.cache_key[:16]}.json"
+        path.write_text(json.dumps(result.to_dict()))
+        return path
+
+    def test_measured_numbers_land_in_cache_entry(self, tmp_path):
+        result = make_result()
+        path = self._seed_cache(tmp_path, result)
+        rt = OnlineRetuner(result, cache_dir=tmp_path)
+        rt.observe(RPB4, rounds=4, walltime_s=4.0, occupancy=0.8)
+        rt.observe(RPB1, rounds=4, walltime_s=1.0)
+        rt.propose(RPB4)
+        out = rt.write_back()
+        assert out == path
+        d = json.loads(path.read_text())
+        by_cfg = {
+            json.dumps(c["config"], sort_keys=True): c for c in d["candidates"]
+        }
+        row4 = by_cfg[json.dumps(RPB4.to_dict(), sort_keys=True)]
+        row1 = by_cfg[json.dumps(RPB1.to_dict(), sort_keys=True)]
+        assert row4["cost"]["measured_s_per_round"] == pytest.approx(1.0)
+        assert row4["cost"]["measured_rounds"] == 4
+        assert row4["cost"]["measured_occupancy_mean"] == pytest.approx(0.8)
+        assert row1["cost"]["measured_s_per_round"] == pytest.approx(0.25)
+        # The AOT numbers survive beside the measured ones.
+        assert row4["cost"]["compile_seconds"] == 1.0
+        assert d["measured"]["swaps"][0]["new"] == RPB1.to_dict()
+        assert d["cache_key"] == result.cache_key
+
+    def test_foreign_cache_entry_left_alone(self, tmp_path):
+        result = make_result()
+        path = tmp_path / f"autotune_{result.cache_key[:16]}.json"
+        path.write_text(json.dumps({"cache_key": "different"}))
+        rt = OnlineRetuner(result, cache_dir=tmp_path)
+        rt.observe(RPB4, rounds=4, walltime_s=4.0)
+        assert rt.write_back() is None
+        assert json.loads(path.read_text()) == {"cache_key": "different"}
+
+    def test_nothing_measured_writes_nothing(self, tmp_path):
+        result = make_result()
+        self._seed_cache(tmp_path, result)
+        rt = OnlineRetuner(result, cache_dir=tmp_path)
+        assert rt.write_back() is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="hysteresis"):
+            retuner(hysteresis=1.5)
