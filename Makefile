@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed audit-smoke bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -17,6 +17,14 @@ lint:
 # intentional sites carry `# fedlint: disable=FEDxxx (reason)` suppressions.
 lint-fed:
 	python -m nanofed_tpu.analysis nanofed_tpu/
+
+# Program audit (analysis.program_audit): lint the tree AND audit the
+# six-variant reference program catalog at the jaxpr/AOT level (collective
+# schedules, mesh discipline, donation, dtype drift, host transfers), then
+# prove every check fires via the seeded mutation suite.  Tier-1-safe:
+# tiny models on the 8-device CPU topology, ~30s, zero execution.
+audit-smoke:
+	python -m nanofed_tpu.analysis --programs --mutants nanofed_tpu/
 
 bench:
 	python bench.py

@@ -1,11 +1,18 @@
-"""Static analysis + runtime contracts for round programs.
+"""Static analysis + program audit + runtime contracts for round programs.
 
-Two halves, one goal — turn the execution contract of the fused SPMD round
+Three layers, one goal — turn the execution contract of the fused SPMD round
 engine from tribal knowledge into enforced fact:
 
 * :mod:`nanofed_tpu.analysis.fedlint` — the AST-based static pass (rules
-  FED001–FED006, pure stdlib).  Run it with ``python -m nanofed_tpu.analysis``
+  FED001–FED010, pure stdlib).  Run it with ``python -m nanofed_tpu.analysis``
   or ``make lint-fed``; it gates CI.
+* :mod:`nanofed_tpu.analysis.program_audit` — the jaxpr/AOT-level auditor:
+  collective-schedule consistency across ``cond`` branches, mesh discipline
+  (declared axes, hosts-after-clients hierarchy, the one-cross-host-tensor
+  byte budget), donation verification against ``memory_analysis``, dtype
+  drift on program inputs, and embedded host transfers.  Zero execution.
+  Run it with ``python -m nanofed_tpu.analysis --programs``, the CLI
+  ``audit`` subcommand, or ``ProgramCatalog.audit()``.
 * :mod:`nanofed_tpu.analysis.contracts` — runtime strict mode:
   :func:`check_round_step` / :func:`check_round_block` validate a round
   program's output shapes/dtypes/structure via ``jax.eval_shape`` without
@@ -27,15 +34,31 @@ from nanofed_tpu.analysis.fedlint import (
     lint_source,
     render_text,
 )
+from nanofed_tpu.analysis.program_audit import (
+    AUDIT_CHECKS,
+    AuditFinding,
+    AuditReport,
+    audit_program,
+    format_audit_reports,
+    run_mutation_suite,
+    seeded_mutants,
+)
 
 __all__ = [
+    "AUDIT_CHECKS",
     "RULES",
+    "AuditFinding",
+    "AuditReport",
     "ContractViolation",
     "Diagnostic",
+    "audit_program",
     "check_round_block",
     "check_round_step",
+    "format_audit_reports",
     "lint_paths",
     "lint_source",
     "render_text",
+    "run_mutation_suite",
+    "seeded_mutants",
     "strict_mode",
 ]

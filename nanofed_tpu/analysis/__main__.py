@@ -1,23 +1,41 @@
-"""``python -m nanofed_tpu.analysis`` — run fedlint from the command line.
+"""``python -m nanofed_tpu.analysis`` — run the analysis passes from the CLI.
 
-Exit code 0 when the tree is clean (or every finding is explicitly suppressed
-with a reason), 1 when findings remain, 2 on usage errors.  ``make lint-fed``
-and the CI ``lint-fed`` step both call this entry point.
+Default: fedlint over the given paths.  ``--programs`` additionally audits the
+six-variant reference program catalog (``analysis.program_audit``) at the
+jaxpr/AOT level; ``--mutants`` runs the mutation self-test (every seeded
+broken program must trigger exactly its audit check — proof no check is
+vacuous).  One exit-code contract across all passes: 0 when everything is
+clean (or explicitly suppressed with a reason), 1 when findings remain or a
+mutant fails to fire, 2 on usage errors.  ``make lint-fed`` and
+``make audit-smoke`` both call this entry point.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from nanofed_tpu.analysis.fedlint import RULES, lint_paths, render_text
 
 
+def _ensure_virtual_devices(count: int = 8) -> None:
+    """The reference catalog and the mesh mutants need the standard 8-device
+    CPU topology; harmless when a real backend is attached (the flag only
+    affects the host platform) or when jax already initialized."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={count}".strip()
+        )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m nanofed_tpu.analysis",
-        description="fedlint: JAX-aware static analysis for federated round programs",
+        description="fedlint + program audit: static analysis for federated "
+                    "round programs",
     )
     parser.add_argument(
         "paths", nargs="*", default=["nanofed_tpu"],
@@ -33,6 +51,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue and exit"
+    )
+    parser.add_argument(
+        "--programs", action="store_true",
+        help="also audit the six-variant reference program catalog at the "
+             "jaxpr/AOT level (compiles tiny programs; needs 8 devices)",
+    )
+    parser.add_argument(
+        "--mutants", action="store_true",
+        help="run the audit mutation self-test: each seeded broken program "
+             "must trigger exactly its check",
     )
     args = parser.parse_args(argv)
 
@@ -51,18 +79,56 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     diagnostics = lint_paths(args.paths, select=select)
-    if args.format == "json":
-        print(json.dumps(
-            [
-                {"path": d.path, "line": d.line, "col": d.col, "code": d.code,
-                 "message": d.message}
-                for d in diagnostics
-            ],
-            indent=2,
-        ))
-    else:
+    failed = bool(diagnostics)
+    out: dict[str, object] = {
+        "fedlint": [
+            {"path": d.path, "line": d.line, "col": d.col, "code": d.code,
+             "message": d.message}
+            for d in diagnostics
+        ]
+    }
+    if args.format == "text":
         print(render_text(diagnostics))
-    return 1 if diagnostics else 0
+
+    if args.programs or args.mutants:
+        _ensure_virtual_devices()
+
+    if args.programs:
+        from nanofed_tpu.analysis.program_audit import (
+            format_audit_reports, reference_catalog,
+        )
+
+        reports = reference_catalog().audit_all()
+        failed = failed or any(not r.ok for r in reports)
+        out["audit"] = [r.to_dict() for r in reports]
+        if args.format == "text":
+            print()
+            print(format_audit_reports(reports))
+
+    if args.mutants:
+        from nanofed_tpu.analysis.program_audit import run_mutation_suite
+
+        results = run_mutation_suite()
+        failed = failed or any(not r["ok"] for r in results.values())
+        out["mutants"] = results
+        if args.format == "text":
+            print()
+            for name, r in results.items():
+                status = "fires" if r["ok"] else (
+                    f"FAILED (expected [{r['expected']}], got {r['fired']})"
+                )
+                print(f"{name}: {r['expected']} {status}")
+            n_ok = sum(r["ok"] for r in results.values())
+            print(f"mutation suite: {n_ok}/{len(results)} checks proven")
+
+    if args.format == "json":
+        # One object across all passes when the extra passes ran; the plain
+        # lint invocation keeps its original list-shaped output.
+        if args.programs or args.mutants:
+            print(json.dumps(out, indent=2, default=str))
+        else:
+            print(json.dumps(out["fedlint"], indent=2))
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -45,10 +45,33 @@ Rules
   (``await request.read()``/``.json()``/``.text()`` without
   ``asyncio.wait_for``): a peer trickling bytes — slowloris — holds the
   handler, and any admission slot it occupies, open forever.
+- **FED007** — raw collective with a hardcoded axis-name string in the
+  ``parallel``/``aggregation`` layers (``lax.psum(x, "clients")``): axis names
+  are mesh topology, owned by ``MeshLayout`` and the ``mesh.py`` axis
+  constants — a builder that inlines the string silently decouples from the
+  mesh it runs on (the ROADMAP's "no free-function drift" rule, mechanized).
+- **FED008** — fire-and-forget task: an ``asyncio.create_task``/
+  ``ensure_future`` whose task reference is dropped, or whose exceptions have
+  no sink (no ``add_done_callback``, and every await of it is shield-wrapped
+  or swallowed by a broad ``except Exception: pass``) — the task's traceback
+  vanishes into "exception was never retrieved" at interpreter exit.  Use
+  ``nanofed_tpu.utils.aio.spawn_logged`` or attach an explicit sink.
+- **FED009** — blocking file I/O inside ``async def`` (``json.dump``,
+  ``pickle``, ``os.replace``, ``shutil``, ``Path.mkdir``/``unlink``) outside
+  ``asyncio.to_thread``: complements FED006's ``open()`` check — the dump
+  call blocks the loop even when the file object came from elsewhere.
+  Nested ``def``s are exempt (they are what gets shipped to ``to_thread``).
+- **FED010** — wall-clock time (``time.time()``/``datetime.now()``) in the
+  Clock-injected subsystems (``communication``/``loadgen``/``faults``/
+  ``service``/``observability``): these layers take an injectable
+  ``utils.clock.Clock`` precisely so virtual-clock tests and deterministic
+  replays work — a stray wall-clock read re-couples them to real time.
+  Forensics-only stamps (artifact timestamps) need a reasoned suppression.
 
-Traced scope is resolved by following ``jit``/``shard_map``/``lax.scan``/
-``vmap`` wrapper applications and then propagating over call edges within the
-analyzed files (a helper called from a ``shard_map`` body is traced too).
+Traced scope is resolved by following ``jit``/``shard_map``/``pallas_call``/
+``lax.scan``/``vmap`` wrapper applications and then propagating over call
+edges within the analyzed files (a helper called from a ``shard_map`` body is
+traced too).
 
 Suppressions: ``# fedlint: disable=FED001,FED003 (why this site is intentional)``
 on the flagged line or on a standalone comment line directly above it;
@@ -80,6 +103,10 @@ RULES: dict[str, str] = {
     "FED004": "jit of params-shaped state without donate_argnums",
     "FED005": "unlocked mutation of lock-guarded shared state",
     "FED006": "blocking call inside async code / unbounded await in a request handler",
+    "FED007": "raw collective with a hardcoded axis-name string (axis names belong to MeshLayout)",
+    "FED008": "fire-and-forget task without an exception sink",
+    "FED009": "blocking file I/O inside async code outside to_thread",
+    "FED010": "wall-clock time in a Clock-injected subsystem",
 }
 
 #: jit-like wrappers whose function argument (or decorated function) executes traced.
@@ -137,6 +164,48 @@ _UNBOUNDED_AWAIT_METHODS = {"read", "json", "text", "receive"}
 #: (the round-dispatch hot path): block_until_ready / device_get there must be
 #: either traced-scope-clean or carry a documented suppression.
 _HOT_PATH_PREFIXES = ("nanofed_tpu.orchestration", "nanofed_tpu.parallel")
+
+#: Layers where collective axis names are MeshLayout's business (FED007).
+_AXIS_OWNER_PREFIXES = ("nanofed_tpu.parallel", "nanofed_tpu.aggregation")
+
+#: ``jax.lax`` collectives whose axis argument FED007 inspects.  ``axis_index``
+#: takes the axis as its FIRST positional; the rest take it second.
+_RAW_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "psum_scatter", "reduce_scatter", "pshuffle", "axis_index",
+}
+
+#: Task-spawning call names (last dotted segment) tracked by FED008.
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+#: Awaits that count as an exception sink for a task passed as a direct
+#: argument (FED008).  ``shield`` is deliberately absent: a shield-wrapped
+#: await abandons the task's exception on timeout-cancel.
+_TASK_AWAITERS = {"gather", "wait", "wait_for"}
+
+#: Blocking file-I/O calls inside ``async def`` (FED009).  Complements
+#: FED006's ``open()``/``write_text`` set — these block on a file object or
+#: path produced elsewhere.
+_BLOCKING_IO_CALLS = {
+    "json.dump", "json.load", "pickle.dump", "pickle.load",
+    "os.replace", "os.rename", "os.remove", "os.unlink",
+    "os.makedirs", "os.mkdir", "os.rmdir",
+    "shutil.copy", "shutil.copy2", "shutil.copyfile", "shutil.copytree",
+    "shutil.move", "shutil.rmtree",
+}
+_BLOCKING_IO_METHODS = {"mkdir", "unlink", "rmdir", "touch", "rename"}
+
+#: Subsystems built around the injectable ``utils.clock.Clock`` (FED010).
+_CLOCKED_PREFIXES = (
+    "nanofed_tpu.communication", "nanofed_tpu.loadgen", "nanofed_tpu.faults",
+    "nanofed_tpu.service", "nanofed_tpu.observability",
+)
+
+#: Wall-clock reads FED010 flags in the clocked subsystems.
+_WALL_CLOCK_CALLS = {
+    "time.time", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
 
 _SUPPRESS_RE = re.compile(
     r"#\s*fedlint:\s*(disable|disable-file)\s*=\s*([A-Z0-9,\s]+?)\s*(?:\(([^)]*)\))?\s*$"
@@ -340,7 +409,12 @@ def _function_refs(model: _FileModel, expr: ast.AST, scopes: tuple[str, ...]):
 def _is_traced_wrapper(name: str | None) -> bool:
     if name is None:
         return False
-    return name in _TRACED_WRAPPERS or name.rsplit(".", 1)[-1] == "shard_map"
+    # shard_map moved namespaces across JAX versions and pallas_call lives
+    # under jax.experimental.pallas — match both by their unambiguous last
+    # segment rather than pinning an import path.
+    return name in _TRACED_WRAPPERS or name.rsplit(".", 1)[-1] in (
+        "shard_map", "pallas_call"
+    )
 
 
 def _seed_traced(models: dict[str, _FileModel]) -> None:
@@ -965,6 +1039,231 @@ def _check_async_blocking(model: _FileModel, out: list[Diagnostic]) -> None:
                 ))
 
 
+def _has_string_literal(expr: ast.AST | None) -> bool:
+    """Is ``expr`` a string constant, or a tuple/list containing one?"""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return True
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_has_string_literal(e) for e in expr.elts)
+    return False
+
+
+def _check_raw_collective(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED007: ``lax.psum(x, "clients")``-style hardcoded axis names in the
+    layers where MeshLayout owns the topology."""
+    if not model.module.startswith(_AXIS_OWNER_PREFIXES):
+        return
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = model.resolve(node.func)
+        if not name:
+            continue
+        fn = name.rsplit(".", 1)[-1]
+        if fn not in _RAW_COLLECTIVES or ".lax." not in f".{name}":
+            continue
+        axis_pos = 0 if fn == "axis_index" else 1
+        axis_exprs = [
+            kw.value for kw in node.keywords if kw.arg in ("axis_name", "axes")
+        ]
+        if len(node.args) > axis_pos:
+            axis_exprs.append(node.args[axis_pos])
+        if any(_has_string_literal(e) for e in axis_exprs):
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED007",
+                f"lax.{fn} with a hardcoded axis-name string in {model.module}: "
+                "axis names are mesh topology — take them from MeshLayout "
+                "(client_psum/client_all_gather) or the mesh.py axis "
+                "constants, so the builder follows the mesh it runs on",
+            ))
+
+
+def _spawner_name(model: _FileModel, node: ast.Call) -> str | None:
+    """The resolved name when ``node`` spawns a task (create_task/
+    ensure_future on asyncio or a loop object), else None."""
+    name = model.resolve(node.func)
+    if name and "." in name and name.rsplit(".", 1)[-1] in _TASK_SPAWNERS:
+        return name
+    return None
+
+
+def _broadly_swallowed(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> bool:
+    """Is ``node`` inside a ``try`` whose handler catches Exception (or bare)
+    and does nothing?  Such an await retrieves the task's exception only to
+    drop it — not a sink."""
+    cur = node
+    while cur in parents:
+        parent = parents[cur]
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            for handler in parent.handlers:
+                broad = handler.type is None or any(
+                    isinstance(n, ast.Name)
+                    and n.id in ("Exception", "BaseException")
+                    for n in ast.walk(handler.type)
+                )
+                inert = all(
+                    isinstance(s, ast.Pass)
+                    or (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))
+                    for s in handler.body
+                )
+                if broad and inert:
+                    return True
+        cur = parent
+    return False
+
+
+def _direct_args(call: ast.Call) -> list[ast.AST]:
+    """A call's positional args, flattened through container literals (for
+    ``asyncio.wait({task, timer})``)."""
+    flat: list[ast.AST] = []
+    for a in call.args:
+        if isinstance(a, (ast.Tuple, ast.List, ast.Set)):
+            flat.extend(a.elts)
+        elif isinstance(a, ast.Starred):
+            flat.append(a.value)
+        else:
+            flat.append(a)
+    return flat
+
+
+def _check_task_sink(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED008: every spawned task needs an exception sink somewhere."""
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(model.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    def matches(expr: ast.AST, var: str | None, attr: str | None) -> bool:
+        if var is not None:
+            return isinstance(expr, ast.Name) and expr.id == var
+        return _self_attr(expr) == attr
+
+    def has_sink(scope: ast.AST, var: str | None, attr: str | None) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Await):
+                val = node.value
+                if matches(val, var, attr):
+                    if not _broadly_swallowed(node, parents):
+                        return True
+                elif isinstance(val, ast.Call):
+                    fname = model.resolve(val.func) or ""
+                    if fname.rsplit(".", 1)[-1] in _TASK_AWAITERS and any(
+                        matches(a, var, attr) for a in _direct_args(val)
+                    ):
+                        return True
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in ("add_done_callback", "result") and \
+                        matches(node.func.value, var, attr):
+                    return True
+            elif isinstance(node, ast.Return) and node.value is not None \
+                    and matches(node.value, var, attr):
+                return True
+        return False
+
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        spawner = _spawner_name(model, node)
+        if spawner is None:
+            continue
+        stmt = parents.get(node)
+        if isinstance(stmt, ast.Expr):
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED008",
+                f"{spawner.rsplit('.', 1)[-1]} result dropped: the task runs "
+                "unreferenced (eligible for GC mid-flight) and its exception "
+                "is never retrieved — keep the reference and give it a sink "
+                "(utils.aio.spawn_logged)",
+            ))
+            continue
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        target = stmt.targets[0]
+        var: str | None = None
+        attr: str | None = None
+        scope: ast.AST | None = None
+        if isinstance(target, ast.Name):
+            var = target.id
+            cur = stmt
+            while cur in parents and scope is None:
+                cur = parents[cur]
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope = cur
+            scope = scope or model.tree
+        elif _self_attr(target) is not None:
+            attr = _self_attr(target)
+            scope = model.tree
+        else:
+            continue
+        if not has_sink(scope, var, attr):
+            what = var or f"self.{attr}"
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED008",
+                f"task {what!r} has no exception sink: no add_done_callback, "
+                "and no await that could surface its exception (shield-"
+                "wrapped and except-Exception-pass awaits do not count) — "
+                "its traceback vanishes into 'exception was never retrieved'; "
+                "use utils.aio.spawn_logged or attach a sink",
+            ))
+
+
+def _check_async_file_io(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED009: blocking file I/O lexically inside ``async def``, nested
+    functions exempt (they are to_thread/executor payloads)."""
+    for info in model.functions.values():
+        if not isinstance(info.node, ast.AsyncFunctionDef):
+            continue
+        nested = {
+            n for q, f in model.functions.items()
+            if q != info.qualname and q.startswith(info.qualname + ".")
+            for n in ast.walk(f.node)
+        }
+        for node in ast.walk(info.node):
+            if node in nested or not isinstance(node, ast.Call):
+                continue
+            name = model.resolve(node.func)
+            blocking = None
+            if name in _BLOCKING_IO_CALLS:
+                blocking = name
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_IO_METHODS
+                and not (name and name.startswith(("os.", "shutil.")))
+            ):
+                blocking = f".{node.func.attr}()"
+            if blocking:
+                out.append(Diagnostic(
+                    model.path, node.lineno, node.col_offset, "FED009",
+                    f"blocking file I/O {blocking} inside async function "
+                    f"{info.qualname!r}: the dump/rename blocks the event "
+                    "loop even though the file object came from elsewhere — "
+                    "ship it to asyncio.to_thread",
+                ))
+
+
+def _check_wall_clock(model: _FileModel, out: list[Diagnostic]) -> None:
+    """FED010: wall-clock reads in the Clock-injected subsystems."""
+    if not model.module.startswith(_CLOCKED_PREFIXES):
+        return
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = model.resolve(node.func)
+        if name in _WALL_CLOCK_CALLS:
+            out.append(Diagnostic(
+                model.path, node.lineno, node.col_offset, "FED010",
+                f"{name}() in {model.module}: this subsystem takes an "
+                "injectable utils.clock.Clock so virtual-clock tests and "
+                "deterministic replays hold — read the injected clock, or "
+                "suppress with the reason this stamp is forensics-only",
+            ))
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
@@ -1007,6 +1306,10 @@ def _lint_models(
         _check_jit_donation(model, raw)
         _check_lock_discipline(model, raw)
         _check_async_blocking(model, raw)
+        _check_raw_collective(model, raw)
+        _check_task_sink(model, raw)
+        _check_async_file_io(model, raw)
+        _check_wall_clock(model, raw)
 
     by_path = {m.path: m for m in models.values()}
     final: list[Diagnostic] = []

@@ -676,6 +676,38 @@ def _cmd_metrics_summary(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_audit(args: argparse.Namespace) -> int:
+    """Audit the round programs at the jaxpr/AOT level WITHOUT running a
+    federation (``analysis.program_audit``): collective-schedule consistency
+    across cond branches, mesh discipline (declared axes, hosts-after-clients,
+    the one-cross-host-tensor budget), donation vs memory_analysis, dtype
+    drift, embedded host transfers.  Exit 1 on findings."""
+    from nanofed_tpu.analysis.__main__ import _ensure_virtual_devices
+    from nanofed_tpu.analysis.program_audit import (
+        format_audit_reports, reference_catalog,
+    )
+
+    # The reference catalog needs the standard 8-device topology; on a bare
+    # CPU host this must land in XLA_FLAGS before the backend initializes.
+    _ensure_virtual_devices()
+    catalog = reference_catalog()
+    reports = catalog.audit_all(compile=not args.no_compile)
+
+    if args.telemetry_dir is not None:
+        from nanofed_tpu.observability import RunTelemetry
+
+        telemetry = RunTelemetry(args.telemetry_dir)
+        for report in reports:
+            telemetry.record("audit", **report.to_dict())
+        telemetry.close()
+
+    if args.json:
+        print(json.dumps([r.to_dict() for r in reports], indent=2))
+    else:
+        print(format_audit_reports(reports))
+    return 0 if all(r.ok for r in reports) else 1
+
+
 def _cmd_loadtest(args: argparse.Namespace) -> int:
     """Run the synthetic client swarm against one (or both) serving paths and
     print the artifact (also written under --out-dir)."""
@@ -1174,6 +1206,28 @@ def main(argv: list[str] | None = None) -> int:
         "(read back with `nanofed-tpu metrics-summary`)",
     )
 
+    audit = sub.add_parser(
+        "audit",
+        help="audit the round programs at the jaxpr/AOT level WITHOUT running "
+        "a federation: collective schedules (cond-branch consistency), mesh "
+        "discipline (declared axes, hosts-after-clients hierarchy, cross-host "
+        "byte budget), donation vs memory_analysis, dtype drift, embedded "
+        "host transfers — across single-step, fused-block, SCAFFOLD, 2-D "
+        "FSDP, 3-axis hierarchical, and adapter variants; exit 1 on findings",
+    )
+    audit.add_argument(
+        "--no-compile", action="store_true",
+        help="trace-only audit: skip the AOT compile (and with it the "
+        "donation check) — faster on a cold compile cache",
+    )
+    audit.add_argument("--json", action="store_true",
+                       help="full report dicts as JSON instead of the table")
+    audit.add_argument(
+        "--telemetry-dir", default=None,
+        help="also append an `audit` record per program to a telemetry.jsonl "
+        "here (read back with `nanofed-tpu metrics-summary`)",
+    )
+
     loadtest = sub.add_parser(
         "loadtest",
         help="synthetic client swarm load harness (nanofed_tpu.loadgen): "
@@ -1313,6 +1367,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_metrics_summary(args)
     if args.cmd == "profile":
         return _cmd_profile(args)
+    if args.cmd == "audit":
+        return _cmd_audit(args)
     if args.cmd == "loadtest":
         return _cmd_loadtest(args)
     if args.cmd == "tenants":

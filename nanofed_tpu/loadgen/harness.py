@@ -30,6 +30,7 @@ from nanofed_tpu.communication.network_coordinator import (
 )
 from nanofed_tpu.loadgen.swarm import SwarmConfig, latency_digest, run_swarm
 from nanofed_tpu.observability.registry import MetricsRegistry
+from nanofed_tpu.utils.aio import spawn_logged
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock, VirtualClock
 from nanofed_tpu.utils.logger import Logger
 
@@ -167,7 +168,9 @@ def run_loadtest(
                 finally:
                     coord_wall = time.perf_counter() - t
 
-            coord_task = asyncio.create_task(_timed_run())
+            # spawn_logged: on the timeout path below the cancel swallow would
+            # otherwise drop a real coordinator crash silently (FED008).
+            coord_task = spawn_logged(_timed_run(), name="loadtest-coordinator")
             swarm = await run_swarm(
                 f"http://127.0.0.1:{chosen_port}", params, swarm_config,
                 clock=clock, registry=registry,

@@ -43,6 +43,7 @@ from nanofed_tpu.communication.http_server import (
 )
 from nanofed_tpu.communication.retry import RetryPolicy, parse_retry_after
 from nanofed_tpu.core.types import Params
+from nanofed_tpu.utils.aio import spawn_logged
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 __all__ = [
@@ -228,7 +229,9 @@ class _RoundTracker:
 
     async def start(self) -> None:
         await self._refresh()
-        self._task = asyncio.create_task(self._loop())
+        # spawn_logged: stop() deliberately swallows the poller's exception to
+        # protect the measurement — the sink here keeps the traceback (FED008).
+        self._task = spawn_logged(self._loop(), name="round-tracker")
 
     async def stop(self) -> None:
         if self._task is not None:

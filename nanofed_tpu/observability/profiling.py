@@ -385,6 +385,18 @@ class ProgramCatalog:
         with self._lock:
             return sorted(self._entries)
 
+    def registration(
+        self, name: str
+    ) -> tuple[Callable, Callable[[], tuple[tuple, dict]], int, dict[str, Any]]:
+        """The raw registration ``(fn, args_factory, rounds, attrs)`` — what a
+        catalog aggregator (``analysis.program_audit.reference_catalog``)
+        needs to re-register an entry under another name."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"no program {name!r} registered (have {self.names()})")
+        return entry.fn, entry.args_factory, entry.rounds, dict(entry.attrs)
+
     def report(self, name: str) -> ProgramCostReport | None:
         """The cached report, or None if ``profile`` has not run for it."""
         with self._lock:
@@ -415,6 +427,28 @@ class ProgramCatalog:
 
     def profile_all(self, force: bool = False) -> list[ProgramCostReport]:
         return [self.profile(name, force=force) for name in self.names()]
+
+    def audit(self, name: str, compile: bool = True):
+        """Run the jaxpr/AOT program audit (``analysis.program_audit``) on one
+        registered program; returns its ``AuditReport`` (findings included —
+        never raises on findings).  ``compile=False`` is trace-only (skips the
+        donation check along with the AOT compile)."""
+        from nanofed_tpu.analysis.program_audit import audit_program
+
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"no program {name!r} registered (have {self.names()})")
+        args, kwargs = entry.args_factory()
+        return audit_program(
+            name, entry.fn, *args, rounds=entry.rounds,
+            mesh=entry.attrs.get("mesh"), compile=compile,
+            attrs={k: v for k, v in entry.attrs.items() if k != "mesh"},
+            **kwargs,
+        )
+
+    def audit_all(self, compile: bool = True) -> list:
+        return [self.audit(name, compile=compile) for name in self.names()]
 
     def publish(self, report: ProgramCostReport) -> None:
         """Expose one report on the metrics registry: per-program gauges

@@ -75,6 +75,7 @@ class RunTelemetry:
     def record(self, record_type: str, **fields: Any) -> None:
         """Append one typed JSON line; silently a no-op after ``close()`` (a late
         straggler span must not raise inside a finally block)."""
+        # fedlint: disable=FED010 (forensics-only: the `t` stamp exists to line telemetry.jsonl up against external logs/dashboards by real wall time — a virtual clock here would date every record 1970 and break cross-artifact correlation)
         line = json.dumps({"type": record_type, "t": round(time.time(), 3), **fields})
         with self._lock:
             if self._closed:
@@ -88,6 +89,7 @@ class RunTelemetry:
             if self._closed:
                 return
             snapshot = json.dumps(
+                # fedlint: disable=FED010 (forensics-only: same wall-time stamp contract as record above — the closing snapshot must date-align with the stream it closes)
                 {"type": "metrics_snapshot", "t": round(time.time(), 3),
                  "metrics": self.registry.snapshot()}
             )
@@ -181,6 +183,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     program_profiles: dict[str, dict[str, Any]] = {}
     loadtests: dict[str, dict[str, Any]] = {}
     autotunes: dict[str, dict[str, Any]] = {}
+    audits: dict[str, dict[str, Any]] = {}
     topology: dict[str, Any] | None = None
     host_failures: list[dict[str, Any]] = []
     recoveries: list[dict[str, Any]] = []
@@ -236,6 +239,20 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                         "num_devices", "candidates_total",
                         "candidates_feasible", "cache_hit", "compiles",
                         "compile_seconds_total", "best_score",
+                    )
+                    if k in rec
+                }
+            elif rtype == "audit":
+                # Program-auditor verdict (analysis.program_audit via
+                # Coordinator.audit_programs or the CLI `audit` subcommand):
+                # last record per program wins (a re-audit supersedes) — the
+                # same policy as program_profile.  The digest keeps the
+                # verdict, the findings, and the collective-schedule shape.
+                audits[str(rec.get("program", "?"))] = {
+                    k: rec[k]
+                    for k in (
+                        "ok", "findings", "schedule", "mesh_axes", "checks",
+                        "compiled",
                     )
                     if k in rec
                 }
@@ -401,6 +418,15 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # Autotuner layer (nanofed_tpu.tuning): the winner config, scoring
         # basis, and sweep economics per swept configuration.
         out["autotunes"] = dict(sorted(autotunes.items()))
+    if audits:
+        # Program-audit layer (analysis.program_audit): per-program verdict
+        # on collective schedules, mesh discipline, donation, dtype drift,
+        # and host transfers — plus a headline clean/dirty count.
+        out["audits"] = {
+            "programs": dict(sorted(audits.items())),
+            "clean": sum(1 for a in audits.values() if a.get("ok")),
+            "dirty": sum(1 for a in audits.values() if not a.get("ok")),
+        }
     if adapter:
         # Parameter-efficient federation (nanofed_tpu.adapters): rank, the
         # trainable-vs-frozen split, merge count, and — when a wire harness

@@ -697,6 +697,12 @@ class Coordinator:
                 )
             else:
                 self._check_contracts()
+            # Program audit (analysis.program_audit): trace-only here —
+            # collective schedules, mesh discipline, dtype drift, host
+            # transfers — signature-agnostic, so SCAFFOLD is covered too.
+            # The AOT donation check runs in audit_programs() (compile-time
+            # cost belongs to an explicit call, not construction).
+            self._audit_strict()
 
         self.base_dir = Path(config.base_dir)
         if config.save_metrics:
@@ -990,6 +996,52 @@ class Coordinator:
             reports.append(report)
         return reports
 
+    def _audit_strict(self) -> None:
+        """Construction-time program audit: trace-only (no AOT compile), and
+        findings RAISE — strict mode means a divergent collective schedule or
+        an upcast leaf never reaches a dispatch."""
+        from nanofed_tpu.analysis.contracts import ContractViolation
+
+        findings = [
+            f for report in self.program_catalog.audit_all(compile=False)
+            for f in report.findings
+        ]
+        if findings:
+            raise ContractViolation(
+                "program audit failed:\n"
+                + "\n".join(f.render() for f in findings)
+            )
+        self._log.info(
+            "strict: program audit ok (%s)",
+            ", ".join(self.program_catalog.names()),
+        )
+
+    def audit_programs(self, compile: bool = True) -> list:
+        """Audit every catalogued round program at the jaxpr/AOT level
+        (``analysis.program_audit``): collective schedules, mesh discipline,
+        donation-vs-memory_analysis, dtype drift, embedded host transfers.
+
+        Appends an ``audit`` record per program to ``telemetry.jsonl`` when
+        telemetry is on and returns the reports; findings are REPORTED, not
+        raised — the CLI decides the exit code, strict mode has its own
+        construction-time raise."""
+        reports = []
+        for name in self.program_catalog.names():
+            with self._tracer.span("program-audit", program=name):
+                report = self.program_catalog.audit(name, compile=compile)
+            if self.telemetry is not None:
+                self.telemetry.record("audit", **report.to_dict())
+            self._log.info(
+                "audit %s: %s (%d collectives, axes %s%s)",
+                name,
+                "ok" if report.ok else f"{len(report.findings)} finding(s)",
+                len(report.schedule),
+                ",".join(report.mesh_axes) or "-",
+                "" if report.compiled else ", trace-only",
+            )
+            reports.append(report)
+        return reports
+
     # ------------------------------------------------------------------
     # Online retuning (tuning.retuner)
     # ------------------------------------------------------------------
@@ -1173,6 +1225,9 @@ class Coordinator:
         self._register_programs()
         if self.strict:
             self._check_contracts()
+            # A retuned program is a NEW program: re-audit its schedules
+            # before the swap's first dispatch, same bar as construction.
+            self._audit_strict()
 
     # ------------------------------------------------------------------
     # Strict mode (analysis.contracts)

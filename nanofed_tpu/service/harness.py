@@ -35,6 +35,7 @@ from nanofed_tpu.faults.plan import FaultEvent, FaultPlan
 from nanofed_tpu.loadgen.swarm import SwarmConfig, latency_digest, run_swarm
 from nanofed_tpu.service.service import FederationService, free_port
 from nanofed_tpu.service.tenant import TenantQuota, TenantSpec
+from nanofed_tpu.utils.aio import spawn_logged
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock, VirtualClock
 from nanofed_tpu.utils.logger import Logger
 
@@ -168,7 +169,9 @@ async def _drive(
     base = f"http://127.0.0.1:{service.transport.port}"
     try:
         t0 = time.perf_counter()
-        run_task = asyncio.create_task(service.run())
+        # spawn_logged: the timeout path below cancels and swallows — a real
+        # service crash must still leave its traceback in the log (FED008).
+        run_task = spawn_logged(service.run(), name="tenant-service")
         swarm_results = await asyncio.gather(*(
             run_swarm(
                 tenant_base_url(base, spec.name),

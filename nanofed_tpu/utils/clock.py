@@ -109,8 +109,13 @@ class VirtualClock(Clock):
             or self._advancer.get_loop() is not loop
         ):
             # A fresh asyncio.run() gets a fresh advancer: tasks cannot cross
-            # event loops, but a VirtualClock instance may outlive one.
+            # event loops, but a VirtualClock instance may outlive one.  The
+            # advancer is never awaited — a crash in it would hang every
+            # virtual sleeper silently without the logging sink (FED008).
+            from nanofed_tpu.utils.aio import log_task_exception
+
             self._advancer = loop.create_task(self._advance_loop())
+            self._advancer.add_done_callback(log_task_exception)
 
     async def _advance_loop(self) -> None:
         while self._sleepers:

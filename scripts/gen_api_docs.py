@@ -80,12 +80,14 @@ MODULES = [
     ("tuning", ["nanofed_tpu.tuning.autotuner",
                 "nanofed_tpu.tuning.epilogues"]),
     ("analysis", ["nanofed_tpu.analysis.fedlint",
+                  "nanofed_tpu.analysis.program_audit",
                   "nanofed_tpu.analysis.contracts"]),
     ("ops", ["nanofed_tpu.ops.reduce", "nanofed_tpu.ops.dp_reduce",
              "nanofed_tpu.ops.quantize"]),
     ("utils", ["nanofed_tpu.utils.logger", "nanofed_tpu.utils.profiling",
                "nanofed_tpu.utils.trees", "nanofed_tpu.utils.platform",
-               "nanofed_tpu.utils.clock", "nanofed_tpu.utils.dates"]),
+               "nanofed_tpu.utils.clock", "nanofed_tpu.utils.aio",
+               "nanofed_tpu.utils.dates"]),
     ("top-level", ["nanofed_tpu.experiments", "nanofed_tpu.benchmarks",
                    "nanofed_tpu.cli"]),
 ]

@@ -1,0 +1,165 @@
+"""Program auditor end to end: the six-variant reference catalog audits clean
+at the jaxpr/AOT level, every seeded mutant trips exactly its check (no check
+is vacuous), the Coordinator wires audits into strict mode and telemetry, and
+``metrics-summary`` digests the ``audit`` records into an ``audits`` block."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from nanofed_tpu.analysis import AUDIT_CHECKS, run_mutation_suite
+from nanofed_tpu.analysis.program_audit import reference_catalog
+from nanofed_tpu.data import federate, synthetic_classification
+from nanofed_tpu.models import get_model
+from nanofed_tpu.observability import summarize_telemetry
+from nanofed_tpu.orchestration import Coordinator, CoordinatorConfig
+from nanofed_tpu.trainer import TrainingConfig
+
+VARIANTS = {
+    "single_step": {"clients"},
+    "fused_block": {"clients"},
+    "scaffold": {"clients"},
+    "fsdp_2d": {"clients", "model"},
+    "hier_3axis": {"hosts", "clients", "model"},
+    "adapter": {"clients"},
+}
+
+
+@pytest.fixture(scope="module")
+def catalog(devices):
+    return reference_catalog()
+
+
+@pytest.fixture(scope="module")
+def reports(catalog):
+    """One compile pass for the whole module: every test reads these."""
+    return {r.program: r for r in catalog.audit_all(compile=True)}
+
+
+def test_all_six_variants_audit_clean(reports):
+    assert set(reports) == set(VARIANTS)
+    for name, rep in reports.items():
+        assert rep.ok, f"{name}: {[f.render() for f in rep.findings]}"
+        assert rep.compiled
+        assert set(rep.checks) == set(AUDIT_CHECKS)
+
+
+def test_schedules_and_mesh_axes_are_real(reports):
+    for name, rep in reports.items():
+        # Zero-execution does not mean zero insight: the walker must surface
+        # the actual collective schedule and the mesh axes it runs over.
+        assert rep.schedule, f"{name}: empty collective schedule"
+        assert set(rep.mesh_axes) == VARIANTS[name]
+        assert rep.attrs["variant"] == name
+
+
+def test_hierarchical_variant_orders_its_reduces(reports):
+    # The 3-axis program reduces over hosts somewhere AND passes the
+    # hosts-after-clients hierarchy check (rep.ok above); assert the hosts
+    # reduce is really in the schedule so the check had something to order.
+    hier = reports["hier_3axis"]
+    assert any("hosts" in entry for entry in hier.schedule)
+    assert any("clients" in entry for entry in hier.schedule)
+
+
+def test_trace_only_audit_skips_donation(catalog):
+    rep = catalog.audit("single_step", compile=False)
+    assert not rep.compiled
+    assert set(rep.checks) == set(AUDIT_CHECKS) - {"donation"}
+    assert rep.ok
+
+
+def test_mutation_suite_proves_every_check(devices):
+    results = run_mutation_suite()
+    assert set(r["expected"] for r in results.values()) == set(AUDIT_CHECKS)
+    for name, r in results.items():
+        assert r["ok"], f"mutant {name}: expected [{r['expected']}], fired {r['fired']}"
+
+
+def _tiny_coordinator(tmp_path, **kw):
+    ds = synthetic_classification(256, 3, (8,), seed=0)
+    return Coordinator(
+        model=get_model("mlp", in_features=8, hidden=16, num_classes=3),
+        train_data=federate(ds, num_clients=8, scheme="iid", batch_size=16),
+        config=CoordinatorConfig(num_rounds=1, base_dir=tmp_path),
+        training=TrainingConfig(batch_size=16, local_epochs=1,
+                                learning_rate=0.1),
+        **kw,
+    )
+
+
+def test_coordinator_audit_reaches_telemetry_and_summary(tmp_path, devices):
+    coord = _tiny_coordinator(tmp_path)
+    reports = coord.audit_programs()
+    assert [r.program for r in reports] == ["round_step"]
+    assert all(r.ok for r in reports)
+
+    records = {}
+    with (tmp_path / "telemetry.jsonl").open() as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "audit":
+                records[rec["program"]] = rec
+    assert set(records) == {"round_step"}
+    assert records["round_step"]["ok"] is True
+    assert records["round_step"]["schedule"]
+
+    summary = summarize_telemetry(tmp_path / "telemetry.jsonl")
+    audits = summary["audits"]
+    assert audits["clean"] == 1 and audits["dirty"] == 0
+    assert audits["programs"]["round_step"]["ok"] is True
+
+
+def test_strict_coordinator_audits_at_construction(tmp_path, devices):
+    # strict=True runs the trace-level audit during construction: a clean
+    # build must come up (and still run), a dirty program would raise
+    # ContractViolation — the mutation suite proves the raising side.
+    coord = _tiny_coordinator(tmp_path, strict=True)
+    coord.run()
+    assert all(m.status.name == "COMPLETED" for m in coord.history)
+
+
+def test_module_entry_point_exit_contract(tmp_path):
+    # `python -m nanofed_tpu.analysis --mutants` shares the lint exit-code
+    # contract: 0 only when every seeded mutant fires exactly its check.
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "nanofed_tpu.analysis", "--mutants",
+         "--format", "json", str(clean)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["fedlint"] == []
+    assert set(out["mutants"]) and all(
+        r["ok"] for r in out["mutants"].values()
+    )
+
+
+def test_audit_records_last_wins(tmp_path):
+    # Pure summarize path: a re-audit record supersedes the first one.
+    tel = tmp_path / "telemetry.jsonl"
+    rows = [
+        {"type": "audit", "program": "round_step", "ok": False,
+         "findings": [{"check": "donation", "message": "stale"}],
+         "schedule": [], "mesh_axes": [], "checks": [], "compiled": True},
+        {"type": "audit", "program": "round_step", "ok": True,
+         "findings": [], "schedule": ["psum@clients"],
+         "mesh_axes": ["clients"], "checks": list(AUDIT_CHECKS),
+         "compiled": True},
+    ]
+    tel.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    summary = summarize_telemetry(tel)
+    audits = summary["audits"]
+    assert audits == {
+        "programs": {"round_step": {
+            "ok": True, "findings": [], "schedule": ["psum@clients"],
+            "mesh_axes": ["clients"], "checks": list(AUDIT_CHECKS),
+            "compiled": True,
+        }},
+        "clean": 1,
+        "dirty": 0,
+    }

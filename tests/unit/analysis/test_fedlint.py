@@ -596,6 +596,413 @@ class TestFed006UnboundedAwait:
 
 
 # ---------------------------------------------------------------------------
+# FED007 — raw collective with a hardcoded axis-name string
+# ---------------------------------------------------------------------------
+
+
+class TestFed007:
+    def test_hardcoded_axis_string_flagged(self):
+        diags = _lint(
+            """
+            from jax import lax
+
+            def reduce_update(u):
+                return lax.psum(u, "clients")
+            """,
+            module="nanofed_tpu.parallel.fixture",
+        )
+        assert _codes(diags) == ["FED007"]
+        assert diags[0].line == 5
+
+    def test_keyword_axis_and_axis_index_flagged(self):
+        diags = _lint(
+            """
+            from jax import lax
+
+            def gather(u):
+                i = lax.axis_index("clients")
+                return lax.all_gather(u, axis_name="clients"), i
+            """,
+            module="nanofed_tpu.aggregation.fixture",
+        )
+        assert _codes(diags) == ["FED007", "FED007"]
+
+    def test_axis_tuple_with_string_flagged(self):
+        diags = _lint(
+            """
+            from jax import lax
+            from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+
+            def hierarchical(u):
+                return lax.psum(u, (CLIENT_AXIS, "hosts"))
+            """,
+            module="nanofed_tpu.parallel.fixture",
+        )
+        assert _codes(diags) == ["FED007"]
+
+    def test_axis_constant_is_clean(self):
+        diags = _lint(
+            """
+            from jax import lax
+            from nanofed_tpu.parallel.mesh import CLIENT_AXIS
+
+            def reduce_update(u, layout):
+                a = lax.psum(u, CLIENT_AXIS)
+                b = lax.pmean(u, layout.client_axis)
+                return a + b
+            """,
+            module="nanofed_tpu.parallel.fixture",
+        )
+        assert diags == []
+
+    def test_other_packages_out_of_scope(self):
+        # MeshLayout does not own axis names outside parallel/aggregation —
+        # a model-layer experiment may hardcode freely.
+        diags = _lint(
+            """
+            from jax import lax
+
+            def reduce_update(u):
+                return lax.psum(u, "clients")
+            """,
+            module="nanofed_tpu.models.fixture",
+        )
+        assert diags == []
+
+    def test_non_lax_namesake_is_clean(self):
+        diags = _lint(
+            """
+            def reduce_update(u, layout):
+                return layout.psum(u, "clients")
+            """,
+            module="nanofed_tpu.parallel.fixture",
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            from jax import lax
+
+            def reduce_update(u):
+                return lax.psum(u, "clients")  # fedlint: disable=FED007 (single-mesh microbenchmark: axis fixed by design)
+            """,
+            module="nanofed_tpu.parallel.fixture",
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED008 — fire-and-forget task without an exception sink
+# ---------------------------------------------------------------------------
+
+
+class TestFed008:
+    def test_dropped_result_flagged(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro):
+                asyncio.create_task(coro)
+            """
+        )
+        assert _codes(diags) == ["FED008"]
+        assert "result dropped" in diags[0].message
+
+    def test_assigned_but_never_sunk_flagged(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro):
+                task = asyncio.create_task(coro)
+                await asyncio.sleep(1)
+            """
+        )
+        assert _codes(diags) == ["FED008"]
+        assert diags[0].line == 5
+
+    def test_done_callback_is_a_sink(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro, log_exc):
+                task = asyncio.create_task(coro)
+                task.add_done_callback(log_exc)
+                await asyncio.sleep(1)
+            """
+        )
+        assert diags == []
+
+    def test_plain_await_is_a_sink(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro):
+                task = asyncio.create_task(coro)
+                return await task
+            """
+        )
+        assert diags == []
+
+    def test_broadly_swallowed_await_is_not_a_sink(self):
+        # The timeout-path idiom: `except Exception: pass` retrieves the
+        # exception only to drop it — the traceback still vanishes.
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro):
+                task = asyncio.create_task(coro)
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            """
+        )
+        assert _codes(diags) == ["FED008"]
+
+    def test_gather_and_wait_count_as_sinks(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(a, b):
+                t1 = asyncio.create_task(a)
+                t2 = asyncio.ensure_future(b)
+                await asyncio.gather(t1)
+                done, _ = await asyncio.wait({t2})
+            """
+        )
+        assert diags == []
+
+    def test_self_attribute_sunk_in_other_method_is_clean(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            class Tracker:
+                def start(self, coro):
+                    self._task = asyncio.create_task(coro)
+
+                async def stop(self):
+                    await self._task
+            """
+        )
+        assert diags == []
+
+    def test_self_attribute_never_sunk_flagged(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            class Tracker:
+                def start(self, coro):
+                    self._task = asyncio.create_task(coro)
+            """
+        )
+        assert _codes(diags) == ["FED008"]
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import asyncio
+
+            async def kick(coro):
+                asyncio.create_task(coro)  # fedlint: disable=FED008 (daemon heartbeat: failure is surfaced by the watchdog)
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED009 — blocking file I/O inside async code
+# ---------------------------------------------------------------------------
+
+
+class TestFed009:
+    def test_json_dump_in_async_def_flagged(self):
+        diags = _lint(
+            """
+            import json
+
+            async def persist(state, f):
+                json.dump(state, f)
+            """
+        )
+        assert _codes(diags) == ["FED009"]
+        assert diags[0].line == 5
+
+    def test_path_method_flagged(self):
+        diags = _lint(
+            """
+            async def cleanup(path):
+                path.unlink()
+            """
+        )
+        assert _codes(diags) == ["FED009"]
+
+    def test_nested_def_payload_is_exempt(self):
+        # The fix idiom: the blocking body lives in a nested def shipped to
+        # a thread — the async frame itself never blocks.
+        diags = _lint(
+            """
+            import asyncio
+            import json
+
+            async def persist(state, f):
+                def _write():
+                    json.dump(state, f)
+                await asyncio.to_thread(_write)
+            """
+        )
+        assert diags == []
+
+    def test_sync_function_is_out_of_scope(self):
+        diags = _lint(
+            """
+            import json
+
+            def persist(state, f):
+                json.dump(state, f)
+            """
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import os
+
+            async def rotate(src, dst):
+                os.replace(src, dst)  # fedlint: disable=FED009 (atomic rename on tmpfs: sub-microsecond, cheaper than a thread hop)
+            """
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# FED010 — wall-clock reads in Clock-injected subsystems
+# ---------------------------------------------------------------------------
+
+
+class TestFed010:
+    def test_time_time_in_communication_flagged(self):
+        diags = _lint(
+            """
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+            module="nanofed_tpu.communication.fixture",
+        )
+        assert _codes(diags) == ["FED010"]
+        assert diags[0].line == 5
+
+    def test_datetime_now_in_service_flagged(self):
+        diags = _lint(
+            """
+            import datetime
+
+            def stamp():
+                return datetime.datetime.now()
+            """,
+            module="nanofed_tpu.service.fixture",
+        )
+        assert _codes(diags) == ["FED010"]
+
+    def test_injected_clock_is_clean(self):
+        diags = _lint(
+            """
+            def stamp(clock):
+                return clock.now()
+            """,
+            module="nanofed_tpu.loadgen.fixture",
+        )
+        assert diags == []
+
+    def test_other_packages_out_of_scope(self):
+        diags = _lint(
+            """
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+            module="nanofed_tpu.models.fixture",
+        )
+        assert diags == []
+
+    def test_suppression_honored(self):
+        diags = _lint(
+            """
+            import time
+
+            def stamp():
+                return time.time()  # fedlint: disable=FED010 (forensics-only stamp: aligns the jsonl with external logs)
+            """,
+            module="nanofed_tpu.observability.fixture",
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# Traced-scope seeding v2: pallas_call + cross-module call edges
+# ---------------------------------------------------------------------------
+
+
+class TestTracedSeedingV2:
+    def test_pallas_kernel_is_traced(self):
+        diags = _lint(
+            """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                v = x_ref[...]
+                o_ref[...] = v * v.sum().item()
+
+            def run(x, shape):
+                return pl.pallas_call(_kernel, out_shape=shape)(x)
+            """
+        )
+        assert _codes(diags) == ["FED001"]
+        assert diags[0].line == 6
+
+    def test_call_edge_propagates_into_fleet_module(self, tmp_path):
+        # Cross-file: a fleet-module round body is passed to shard_map and
+        # delegates to a helper in a sibling module — traced-ness follows the
+        # import edge, so the helper's host sync is flagged in ITS file.
+        from nanofed_tpu.analysis import lint_paths
+
+        pkg = tmp_path / "nanofed_tpu" / "fleet"
+        pkg.mkdir(parents=True)
+        (pkg / "helper.py").write_text(
+            "def scale_update(u):\n"
+            "    return u * u.sum().item()\n"
+        )
+        (pkg / "runner.py").write_text(
+            "from nanofed_tpu.fleet.helper import scale_update\n"
+            "from nanofed_tpu.parallel.mesh import shard_map\n"
+            "\n"
+            "def _body(u):\n"
+            "    return scale_update(u)\n"
+            "\n"
+            "def build(mesh, spec):\n"
+            "    return shard_map(_body, mesh=mesh, in_specs=(spec,),\n"
+            "                     out_specs=spec)\n"
+        )
+        diags = lint_paths([tmp_path / "nanofed_tpu"])
+        assert _codes(diags) == ["FED001"]
+        assert diags[0].path.endswith("helper.py")
+        assert diags[0].line == 2
+
+
+# ---------------------------------------------------------------------------
 # Engine plumbing
 # ---------------------------------------------------------------------------
 
