@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed audit-smoke bench bench-smoke chaos-smoke hostchaos-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed audit-smoke bench bench-smoke chaos-smoke hostchaos-smoke federation-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -54,6 +54,20 @@ hostchaos-smoke:
 	  --rounds 6 --block-size 2 --timeout 240 --out-dir /tmp/nanofed_hostchaos_runs
 	python -m nanofed_tpu.cli metrics-summary /tmp/nanofed_multihost/telemetry | \
 	  python -c "import json,sys; d=json.load(sys.stdin); assert d['host_failures'] and d['recoveries'], d; print('metrics-summary digests host_failure/recovery OK')"
+
+# Federation smoke (the one-stack path): a REAL 2-process jax.distributed
+# mesh where each host runs an HTTP listener + device ingest buffer, a
+# ~400-client wire swarm (VirtualClock schedule, real sockets) submits
+# against the listeners, each round is host-local partial drains joined by
+# ONE cross-host psum (communication.federation), and the run asserts every
+# host drained rounds + zero lost submits before writing the artifact.  The
+# digest check proves metrics-summary reads the new federation record.
+federation-smoke:
+	python scripts/multihost_harness.py federate --num-processes 2 \
+	  --clients 400 --round-quota 100 --ingest-capacity 1024 \
+	  --round-timeout-s 20 --timeout 300 --out-dir /tmp/nanofed_federation_runs
+	python -m nanofed_tpu.cli metrics-summary /tmp/nanofed_multihost/fed_telemetry | \
+	  python -c "import json,sys; d=json.load(sys.stdin); f=d['federations']; assert f['count'] >= 1 and f['zero_lost_submits'], f; print('metrics-summary digests federation OK')"
 
 # Loadtest smoke (nanofed_tpu.loadgen): a ~200-client synthetic swarm on a
 # VirtualClock drives BOTH serving paths — per-submit and batched device

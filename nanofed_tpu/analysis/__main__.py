@@ -1,7 +1,7 @@
 """``python -m nanofed_tpu.analysis`` — run the analysis passes from the CLI.
 
 Default: fedlint over the given paths.  ``--programs`` additionally audits the
-six-variant reference program catalog (``analysis.program_audit``) at the
+seven-variant reference program catalog (``analysis.program_audit``) at the
 jaxpr/AOT level; ``--mutants`` runs the mutation self-test (every seeded
 broken program must trigger exactly its audit check — proof no check is
 vacuous).  One exit-code contract across all passes: 0 when everything is
@@ -54,7 +54,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--programs", action="store_true",
-        help="also audit the six-variant reference program catalog at the "
+        help="also audit the seven-variant reference program catalog at the "
              "jaxpr/AOT level (compiles tiny programs; needs 8 devices)",
     )
     parser.add_argument(

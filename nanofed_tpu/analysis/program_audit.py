@@ -578,6 +578,54 @@ def reference_catalog():
                 attrs={**attrs, "variant": variant, "source_program": prog,
                        "mesh": coord.mesh},
             )
+
+    # The wire→mesh bridge's fused drained-ingest reduce (ingest slabs →
+    # host-local `coefs @ buf` → ONE hosts psum of the [P+1] row → FedAvg
+    # apply).  Registered dispatch-shaped so the mesh-discipline check — the
+    # clients reduce must close before the hosts reduce, and exactly one
+    # model-sized cross-host tensor may move per round — machine-checks the
+    # fusion invariant on every `nanofed-tpu audit`.
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PSpec
+
+    from nanofed_tpu.communication.federation import (
+        build_drained_ingest_reduce,
+    )
+    from nanofed_tpu.parallel.mesh import make_mesh, replicated_sharding
+
+    ingest_mesh = make_mesh(shape=(2, 2, 2))
+    ingest_cap, ingest_flat = 4, 96
+    drained = build_drained_ingest_reduce(ingest_mesh, ingest_cap, ingest_flat)
+
+    def _drained_args():
+        shards = int(
+            ingest_mesh.shape[HOST_AXIS] * ingest_mesh.shape[CLIENT_AXIS]
+        )
+        spec = NamedSharding(ingest_mesh, PSpec((HOST_AXIS, CLIENT_AXIS)))
+        rng = np.random.default_rng(0)
+        buf = jax.device_put(
+            rng.normal(size=(shards, ingest_cap, ingest_flat)).astype(
+                np.float32
+            ),
+            spec,
+        )
+        coefs = jax.device_put(
+            np.abs(rng.normal(size=(shards, ingest_cap))).astype(np.float32),
+            spec,
+        )
+        base = jax.device_put(
+            np.zeros(ingest_flat, np.float32),
+            replicated_sharding(ingest_mesh),
+        )
+        return (buf, coefs, base), {}
+
+    catalog.register(
+        "drained_ingest", drained,
+        args_factory=_drained_args, rounds=1,
+        attrs={"variant": "drained_ingest",
+               "source_program": "drained_ingest_reduce",
+               "mesh": ingest_mesh},
+    )
     return catalog
 
 

@@ -146,10 +146,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 "error: `run` drives the single-controller Coordinator, "
                 "which cannot feed a multi-process mesh (its host-built "
                 "round inputs are process-local). Drive real multi-process "
-                "rounds with scripts/multihost_harness.py (smoke|bench), "
-                "which computes every round input as a replicated jitted "
-                "program on each process; single-process `--hosts N` "
-                "exercises the same hierarchical program on virtual hosts.",
+                "rounds with scripts/multihost_harness.py: `federate` runs "
+                "the full stack (a wire listener + ingest buffer per host "
+                "draining into one cross-host psum per round), "
+                "`smoke`/`bench` drive the simulated-client hierarchical "
+                "program; single-process `--hosts N` exercises the same "
+                "hierarchy on virtual hosts.",
                 file=sys.stderr,
             )
             return 2
@@ -882,8 +884,8 @@ def main(argv: list[str] | None = None) -> int:
         "so jax.devices() is the GLOBAL device list. Single-process "
         "environments make this a documented no-op; an ACTUAL multi-process "
         "environment is refused here — the Coordinator is single-controller, "
-        "and scripts/multihost_harness.py (smoke|bench) is the end-to-end "
-        "multi-process driver",
+        "and scripts/multihost_harness.py (federate|smoke|bench) is the "
+        "end-to-end multi-process driver",
     )
     run.add_argument(
         "--rounds-per-block", type=int, default=1,

@@ -446,6 +446,27 @@ class HTTPServer:
                 staleness_exponent=staleness_exponent, server_lr=server_lr,
             )
 
+    async def drain_ingest_fedavg_partial(self) -> tuple[Any | None, float, list[Any]]:
+        """Hierarchical sync-round drain, HOST-LOCAL stage: the batched
+        reduce of every buffered delta as the UNNORMALIZED
+        ``(Σ w_i δ_i, Σ w_i, slot_metas)`` — the federate mesh worker psums
+        the partials over the ``hosts`` axis and applies base + num/den once
+        (see ``communication.federation``).  ``(None, 0.0, [])`` when nothing
+        is buffered: a zero-mass host still participates in the psum."""
+        async with self._lock:
+            return self._ingest_pipeline.drain_fedavg_partial()
+
+    async def drain_ingest_fedbuff_partial(
+        self, k: int, current_version: int, staleness_exponent: float = 0.5,
+    ) -> tuple[Any, list[Any], dict[str, Any]]:
+        """Hierarchical async-mode drain, HOST-LOCAL stage: the unnormalized
+        discounted sum of this host's K oldest in-window deltas (``server_lr``
+        and the global ``1/K`` apply after the cross-host psum)."""
+        async with self._lock:
+            return self._ingest_pipeline.drain_fedbuff_partial(
+                k, current_version, staleness_exponent=staleness_exponent,
+            )
+
     def stop_training(self) -> None:
         """Signal clients to stop polling (parity: ``server.py:313-317``)."""
         self._training_active = False

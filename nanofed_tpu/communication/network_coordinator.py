@@ -38,6 +38,7 @@ from nanofed_tpu.faults.plan import InjectedServerCrash
 from nanofed_tpu.observability.registry import MetricsRegistry
 from nanofed_tpu.observability.spans import SpanTracer
 from nanofed_tpu.observability.telemetry import RunTelemetry
+from nanofed_tpu.orchestration.engine import RoundLedger, completion_required
 from nanofed_tpu.security.validation import (
     ValidationConfig,
     ValidationResult,
@@ -398,15 +399,10 @@ class NetworkCoordinator:
             # long-lived engine must not accumulate every round's records.
             else SpanTracer(registry=self.metrics_registry, keep_records=False)
         )
-        self._m_rounds = self.metrics_registry.counter(
-            "nanofed_rounds_total", "Federation rounds by outcome", labels=("status",)
-        )
-        self._m_round_duration = self.metrics_registry.histogram(
-            "nanofed_round_duration_seconds", "Wall time per federation round"
-        )
-        self._m_cohort = self.metrics_registry.gauge(
-            "nanofed_cohort_size", "Clients whose updates entered the last aggregate"
-        )
+        # Round-outcome accounting delegates to the shared engine: this wire
+        # front, the SPMD coordinator, and the federate mesh workers all
+        # charge the same ledger (same instruments, same `round` record).
+        self._ledger = RoundLedger(self.metrics_registry, telemetry=self.telemetry)
         self._m_validation_rejects = self.metrics_registry.counter(
             "nanofed_validation_rejections_total",
             "Drained updates rejected by host-path validation",
@@ -441,8 +437,10 @@ class NetworkCoordinator:
         population (min_clients minus evicted stragglers) — graceful
         degradation, so a permanently-dead client costs ``straggler_evict_after``
         timed-out rounds and then stops failing the federation."""
-        expected = max(1, self.config.min_clients - len(self._evicted_stragglers))
-        return max(1, math.ceil(expected * self.config.min_completion_rate))
+        return completion_required(
+            self.config.min_clients - len(self._evicted_stragglers),
+            self.config.min_completion_rate,
+        )
 
     def _note_participation(self, reported: set[str]) -> list[str]:
         """Track per-client absences after a sync round's drain; returns the
@@ -725,11 +723,11 @@ class NetworkCoordinator:
         with self._tracer.span("round", round=round_number):
             record = await self._train_round_inner(round_number)
         duration = time.perf_counter() - t0
-        self._m_rounds.inc(status=str(record.get("status", "?")).lower())
-        self._m_round_duration.observe(duration)
-        self._m_cohort.set(record.get("num_clients", 0))
-        if self.telemetry is not None:
-            self.telemetry.record("round", duration_s=round(duration, 6), **record)
+        self._ledger.charge(
+            status=str(record.get("status", "?")),
+            num_clients=record.get("num_clients", 0), duration_s=duration,
+            telemetry_fields={"duration_s": round(duration, 6), **record},
+        )
         await self._checkpoint_round(round_number, record)
         return record
 
@@ -1007,14 +1005,14 @@ class NetworkCoordinator:
                         )
             self.history.append(record)
             duration = time.perf_counter() - t0
-            self._m_rounds.inc(status=record["status"].lower())
-            self._m_round_duration.observe(duration)
-            self._m_cohort.set(record["num_clients"])
-            if self.telemetry is not None:
-                self.telemetry.record(
-                    "round", duration_s=round(duration, 6),
+            self._ledger.charge(
+                status=record["status"], num_clients=record["num_clients"],
+                duration_s=duration,
+                telemetry_fields={
+                    "duration_s": round(duration, 6),
                     **{key: v for key, v in record.items() if key != "discounts"},
-                )
+                },
+            )
             if record["status"] == "COMPLETED":
                 # Keyed by the PRODUCED version: a resumed engine starts its
                 # next aggregation from exactly this model.

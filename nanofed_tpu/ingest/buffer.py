@@ -265,6 +265,71 @@ class DeviceIngestBuffer:
         self._release([m.slot for m in metas])
         return out, metas
 
+    def drain_fedavg_partial(
+        self,
+    ) -> tuple[jax.Array | None, float, list[SlotMeta]]:
+        """Drain EVERY occupied slot as the HOST-LOCAL stage of a hierarchical
+        FedAvg: returns ``(Σ w_i δ_i, Σ w_i, metas)`` — UNNORMALIZED, because
+        the normalizer is global.  Summing the partials across hosts (ONE
+        cross-host psum of ``[P]`` numerators ‖ scalar weight masses) and
+        dividing once reproduces ``drain_fedavg`` of the union exactly:
+        ``Σ_h Σ_{i∈h} w_i δ_i / Σ_h Σ_{i∈h} w_i`` IS the union's weighted
+        mean.  ``drain_fedavg``'s local ``w_i/Σw`` normalization cannot
+        compose this way — each host would divide by its own mass.  Empty
+        buffer returns ``(None, 0.0, [])`` (a zero-mass host contributes
+        zeros to the psum)."""
+        metas = self.occupied()
+        if not metas:
+            return None, 0.0, []
+        coefs = np.zeros(self.capacity, np.float32)
+        for m in metas:
+            coefs[m.slot] = m.weight
+        out = self._run_reduce(coefs, np.zeros(self.flat_size, np.float32))
+        self._release([m.slot for m in metas])
+        return out, float(sum(m.weight for m in metas)), metas
+
+    def drain_fedbuff_partial(
+        self,
+        k: int,
+        current_version: int,
+        valid_versions: Iterable[int],
+        staleness_exponent: float = 0.5,
+    ) -> tuple[jax.Array, list[SlotMeta], dict[str, Any]]:
+        """Host-local stage of a hierarchical FedBuff step: drain this host's
+        K oldest in-window slots as the UNNORMALIZED discounted sum
+        ``Σ (1+s_i)^-α δ_i`` (no ``server_lr``, no ``1/K`` — both are global:
+        the cross-host psum carries numerator ‖ live-count, and the apply
+        divides by the GLOBAL K once).  Same window/skip/consume contract as
+        :meth:`drain_fedbuff`, including the all-out-of-window ``ValueError``."""
+        window = set(int(v) for v in valid_versions)
+        metas = self.occupied()[: max(1, int(k))]
+        live = [m for m in metas if m.round_number in window]
+        skipped = len(metas) - len(live)
+        if not live:
+            self._release([m.slot for m in metas])
+            raise ValueError(
+                f"no aggregatable updates: all {skipped} buffered bases have "
+                "left the version window"
+            )
+        coefs = np.zeros(self.capacity, np.float32)
+        staleness, discounts = [], []
+        for m in live:
+            s = current_version - m.round_number
+            d = (1.0 + s) ** (-staleness_exponent)
+            staleness.append(s)
+            discounts.append(d)
+            coefs[m.slot] = d
+        out = self._run_reduce(coefs, np.zeros(self.flat_size, np.float32))
+        self._release([m.slot for m in metas])
+        stats = {
+            "num_aggregated": len(live),
+            "num_skipped_out_of_window": skipped,
+            "staleness": staleness,
+            "mean_staleness": float(np.mean(staleness)),
+            "discounts": [round(float(d), 4) for d in discounts],
+        }
+        return out, live, stats
+
     def drain_fedbuff(
         self,
         k: int,

@@ -237,6 +237,41 @@ class IngestPipeline:
         self._m_fill.set(self.buffer.fill)
         return out, metas
 
+    def drain_fedavg_partial(
+        self,
+    ) -> tuple[jax.Array | None, float, list[SlotMeta]]:
+        """Host-local stage of a hierarchical FedAvg drain: the UNNORMALIZED
+        ``(Σ w_i δ_i, Σ w_i, metas)`` of every occupied slot — no base applied
+        (the apply happens once, after the cross-host psum of the partials).
+        See :meth:`DeviceIngestBuffer.drain_fedavg_partial`."""
+        out, mass, metas = self.buffer.drain_fedavg_partial()
+        if metas:
+            self._m_drains.inc(policy="fedavg_partial")
+            self._m_batch.observe(len(metas))
+        self._m_fill.set(self.buffer.fill)
+        return out, mass, metas
+
+    def drain_fedbuff_partial(
+        self,
+        k: int,
+        current_version: int,
+        staleness_exponent: float = 0.5,
+    ) -> tuple[jax.Array, list[SlotMeta], dict[str, Any]]:
+        """Host-local stage of a hierarchical FedBuff drain: the UNNORMALIZED
+        discounted sum of this host's K oldest in-window slots (``server_lr``
+        and the global ``1/K`` apply after the cross-host psum).  The cached
+        version window is the in-window authority, as in :meth:`drain_fedbuff`."""
+        try:
+            out, metas, stats = self.buffer.drain_fedbuff_partial(
+                k, current_version, self._version_flat,
+                staleness_exponent=staleness_exponent,
+            )
+        finally:
+            self._m_fill.set(self.buffer.fill)
+        self._m_drains.inc(policy="fedbuff_partial")
+        self._m_batch.observe(len(metas))
+        return out, metas, stats
+
     def drain_fedbuff(
         self,
         k: int,

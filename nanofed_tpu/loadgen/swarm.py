@@ -104,6 +104,12 @@ class SwarmConfig:
     tier: str | None = None
     #: Client-id prefix — sub-swarms sharing one server need disjoint spaces.
     client_prefix: str = "swarm"
+    #: Alternate server base URLs a client rotates to after an attempt run
+    #: dies entirely at the connection level (its host was killed): the
+    #: federation path's reroute — every mesh host serves the same model, so
+    #: any survivor is a valid target and server-side dedup absorbs any
+    #: double-delivery.  Rotation is sticky per client.
+    failover_urls: tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         if self.num_clients < 1:
@@ -135,7 +141,11 @@ class SwarmResult:
     stale_refreshes: int = 0
     failed: int = 0  # logical submits that never got a 200
     terminated_early: int = 0  # submits abandoned because training ended
+    reroutes: int = 0  # failover rotations to a surviving server
     wall_s: float = 0.0
+    #: Client indices whose EVERY logical submit got a 200 — the re-drive set
+    #: after a host kill is the complement of this.
+    completed_indices: list[int] = field(default_factory=list)
 
 
 def latency_digest(latencies_s: list[float]) -> dict[str, Any]:
@@ -269,8 +279,8 @@ class _RoundTracker:
 
 async def _submit_once(
     session: aiohttp.ClientSession,
-    update_url: str,
-    tracker: _RoundTracker,
+    targets: list[tuple[str, _RoundTracker]],
+    target_ref: list[int],
     body: bytes,
     client_id: str,
     seq: int,
@@ -279,9 +289,11 @@ async def _submit_once(
     clock: Clock,
     result: SwarmResult,
     sem: asyncio.Semaphore,
-) -> None:
+    stop: asyncio.Event | None = None,
+) -> bool:
     """One LOGICAL submit: same bytes + idempotency key through every retry,
-    a fresh key (and refreshed round) after a stale-round 400.
+    a fresh key (and refreshed round) after a stale-round 400.  Returns True
+    iff the submit landed (200, accepted or duplicate).
 
     The round header is stamped when the request actually reaches the wire
     (inside ``sem``, which caps in-flight submits at the connector limit) —
@@ -289,94 +301,127 @@ async def _submit_once(
     task-creation time instead would let ten thousand queued requests age
     behind the connector and arrive carrying a round the server left long
     ago: a self-inflicted stale-refresh storm that measures the QUEUE, not
-    the server."""
+    the server.
+
+    Failover: when an attempt run exhausts with a CONNECTION-level failure
+    (status -1 — the socket never reached a live server, the signature of a
+    killed host; a live-but-overloaded server answers 429/5xx and stays
+    primary), the client rotates ``target_ref`` to the next failover target
+    and re-enters as a fresh logical submit stamped from the NEW target's
+    round tracker.  Rotation is sticky across this client's later submits
+    and bounded to one full cycle per logical submit."""
     policy = config.retry
     rng = policy.rng_for(client_id) if policy is not None else None
     metrics_header = json.dumps(
         {"num_samples": weight, "loss": 0.5, "accuracy": 0.5}
     )
     t0 = time.perf_counter()
-    for refresh in range(config.max_stale_refreshes + 1):
-        if not tracker.training_active:
-            result.terminated_early += 1
-            return
-        headers: dict[str, str] | None = None
-        submitted_round = tracker.round
-        deadline = (
-            clock.time() + policy.budget_s
-            if policy is not None and policy.budget_s is not None
-            else None
-        )
-        attempt = 1
-        while True:
-            retry_after = None
-            status = -1
-            duplicate = False
-            try:
-                async with sem:
-                    if headers is None:
-                        # First wire entry for this logical submit: stamp the
-                        # CURRENT round + key.  Retries re-send these exact
-                        # headers (the idempotency contract).
-                        submitted_round = tracker.round
-                        headers = {
-                            HEADER_CLIENT: client_id,
-                            HEADER_ROUND: str(submitted_round),
-                            HEADER_METRICS: metrics_header,
-                            HEADER_SUBMIT:
-                                f"{client_id}:{submitted_round}:{seq}:{refresh}",
-                        }
-                        if config.encoding != "npz":
-                            headers[HEADER_ENCODING] = config.encoding
-                        if config.tier is not None:
-                            headers[HEADER_TIER] = config.tier
-                    async with session.post(
-                        update_url, data=body, headers=headers
-                    ) as resp:
-                        status = resp.status
-                        if status == 200:
-                            try:
-                                duplicate = bool(
-                                    (await resp.json()).get("duplicate")
-                                )
-                            except Exception:
-                                duplicate = False
-                        elif status == 429:
-                            result.rejected_429 += 1
-                            retry_after = parse_retry_after(
-                                resp.headers.get("Retry-After")
-                            )
-                        else:
-                            await resp.read()
-            except (aiohttp.ClientError, asyncio.TimeoutError):
+    rotations_left = len(targets) - 1
+    while True:
+        update_url, tracker = targets[target_ref[0] % len(targets)]
+        rotate = False
+        for refresh in range(config.max_stale_refreshes + 1):
+            if stop is not None and stop.is_set():
+                result.terminated_early += 1
+                return False
+            if not tracker.training_active:
+                result.terminated_early += 1
+                return False
+            headers: dict[str, str] | None = None
+            submitted_round = tracker.round
+            deadline = (
+                clock.time() + policy.budget_s
+                if policy is not None and policy.budget_s is not None
+                else None
+            )
+            attempt = 1
+            while True:
+                retry_after = None
                 status = -1
-            if status == 200:
-                result.latencies_s.append(time.perf_counter() - t0)
-                if duplicate:
-                    result.duplicates += 1
-                else:
-                    result.accepted += 1
-                return
-            if status == 400:
-                # Protocol-final for THIS round: refresh and re-submit as a
-                # new logical submit (the straggler re-sync path).
+                duplicate = False
+                try:
+                    async with sem:
+                        if headers is None:
+                            # First wire entry for this logical submit: stamp
+                            # the CURRENT round + key.  Retries re-send these
+                            # exact headers (the idempotency contract).
+                            submitted_round = tracker.round
+                            headers = {
+                                HEADER_CLIENT: client_id,
+                                HEADER_ROUND: str(submitted_round),
+                                HEADER_METRICS: metrics_header,
+                                HEADER_SUBMIT: (
+                                    f"{client_id}:{submitted_round}"
+                                    f":{seq}:{refresh}"
+                                ),
+                            }
+                            if config.encoding != "npz":
+                                headers[HEADER_ENCODING] = config.encoding
+                            if config.tier is not None:
+                                headers[HEADER_TIER] = config.tier
+                        async with session.post(
+                            update_url, data=body, headers=headers
+                        ) as resp:
+                            status = resp.status
+                            if status == 200:
+                                try:
+                                    duplicate = bool(
+                                        (await resp.json()).get("duplicate")
+                                    )
+                                except Exception:
+                                    duplicate = False
+                            elif status == 429:
+                                result.rejected_429 += 1
+                                retry_after = parse_retry_after(
+                                    resp.headers.get("Retry-After")
+                                )
+                            else:
+                                await resp.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    status = -1
+                if status == 200:
+                    result.latencies_s.append(time.perf_counter() - t0)
+                    if duplicate:
+                        result.duplicates += 1
+                    else:
+                        result.accepted += 1
+                    return True
+                if status == 400:
+                    # Protocol-final for THIS round: refresh and re-submit as
+                    # a new logical submit (the straggler re-sync path).
+                    break
+                retryable = status in (429, 502, 503, 504) or status == -1
+                exhausted = (
+                    policy is None
+                    or not retryable
+                    or attempt >= policy.max_attempts
+                )
+                if not exhausted:
+                    delay = policy.backoff_s(attempt, rng, retry_after)
+                    if deadline is not None and clock.time() + delay > deadline:
+                        exhausted = True
+                if exhausted:
+                    if status == -1 and rotations_left > 0:
+                        rotate = True
+                        break
+                    result.failed += 1
+                    return False
+                result.retries += 1
+                await clock.sleep(delay)
+                attempt += 1
+            if rotate:
                 break
-            retryable = status in (429, 502, 503, 504) or status == -1
-            if policy is None or not retryable or attempt >= policy.max_attempts:
-                result.failed += 1
-                return
-            delay = policy.backoff_s(attempt, rng, retry_after)
-            if deadline is not None and clock.time() + delay > deadline:
-                result.failed += 1
-                return
-            result.retries += 1
-            await clock.sleep(delay)
-            attempt += 1
-        # stale-round fallthrough: re-read the round before the next try
-        result.stale_refreshes += 1
-        if tracker.round == submitted_round:
-            await clock.sleep(0.05)
-    result.failed += 1
+            # stale-round fallthrough: re-read the round before the next try
+            result.stale_refreshes += 1
+            if tracker.round == submitted_round:
+                await clock.sleep(0.05)
+        if rotate:
+            rotations_left -= 1
+            target_ref[0] = (target_ref[0] + 1) % len(targets)
+            result.reroutes += 1
+            continue
+        result.failed += 1
+        return False
 
 
 def _record_swarm_metrics(result: SwarmResult, registry: Any) -> None:
@@ -407,6 +452,12 @@ def _record_swarm_metrics(result: SwarmResult, registry: Any) -> None:
     )
     if result.retries:
         retries.inc(result.retries)
+    reroutes = registry.counter(
+        "nanofed_loadtest_reroutes_total",
+        "Swarm clients rotated to a failover server after connection loss",
+    )
+    if result.reroutes:
+        reroutes.inc(result.reroutes)
 
 
 async def run_swarm(
@@ -415,11 +466,22 @@ async def run_swarm(
     config: SwarmConfig,
     clock: Clock | None = None,
     registry: Any | None = None,
+    stop: asyncio.Event | None = None,
+    client_indices: Any | None = None,
 ) -> SwarmResult:
     """Drive the whole population against a live server; returns the raw
     counts + latencies (published to ``registry`` as ``nanofed_loadtest_*``
     when given).  Every client is one coroutine: sleep to its arrival offset,
-    then issue ``submits_per_client`` logical submits back to back."""
+    then issue ``submits_per_client`` logical submits back to back.
+
+    ``config.failover_urls`` adds reroute targets (one shared round tracker
+    per URL; clients rotate on connection-level exhaustion).  ``stop``, when
+    set, abandons pending submits as ``terminated_early`` — the supervisor's
+    lever when a fleet is going down and survivors will be re-driven.
+    ``client_indices`` restricts the population to those indices (same ids,
+    offsets, weights, bodies as the full run — the re-drive after a kill
+    replays EXACTLY the incomplete clients); ``completed_indices`` on the
+    result is the set whose every submit landed."""
     clock = clock or SYSTEM_CLOCK
     bodies = make_canned_payloads(base_params, config)
     offsets = arrival_offsets(config)
@@ -432,37 +494,57 @@ async def run_swarm(
     result = SwarmResult(latencies_s=[])
     connector = aiohttp.TCPConnector(limit=config.connector_limit)
     timeout = aiohttp.ClientTimeout(total=300.0)
+    urls = [server_url, *config.failover_urls]
     t0 = time.perf_counter()
     async with aiohttp.ClientSession(
         connector=connector, timeout=timeout
     ) as session:
-        tracker = _RoundTracker(
-            session, server_url.rstrip("/") + "/status", clock
-        )
-        await tracker.start()
-        update_url = server_url.rstrip("/") + "/update"
+        trackers = [
+            _RoundTracker(session, u.rstrip("/") + "/status", clock)
+            for u in urls
+        ]
+        for tracker in trackers:
+            await tracker.start()
+        targets = [
+            (u.rstrip("/") + "/update", tr) for u, tr in zip(urls, trackers)
+        ]
         # In-flight cap = the connector limit: requests are stamped (round,
         # key) only once a slot frees, so headers are fresh at wire time.
         sem = asyncio.Semaphore(config.connector_limit)
 
         async def one_client(i: int) -> None:
+            target_ref = [0]  # sticky failover rotation, shared across seqs
             await clock.sleep(float(offsets[i]))
+            landed_all = True
             for s in range(config.submits_per_client):
+                if stop is not None and stop.is_set():
+                    result.terminated_early += 1
+                    landed_all = False
+                    continue
+                tracker = targets[target_ref[0] % len(targets)][1]
                 if not tracker.training_active:
                     result.terminated_early += 1
+                    landed_all = False
                     continue
-                await _submit_once(
-                    session, update_url, tracker, bodies[i % len(bodies)],
+                landed = await _submit_once(
+                    session, targets, target_ref, bodies[i % len(bodies)],
                     f"{config.client_prefix}_{i}", s, float(weights[i]),
-                    config, clock, result, sem,
+                    config, clock, result, sem, stop,
                 )
+                landed_all = landed_all and landed
+            if landed_all:
+                result.completed_indices.append(i)
 
+        indices = (
+            range(config.num_clients)
+            if client_indices is None
+            else [int(i) for i in client_indices]
+        )
         try:
-            await asyncio.gather(
-                *(one_client(i) for i in range(config.num_clients))
-            )
+            await asyncio.gather(*(one_client(i) for i in indices))
         finally:
-            await tracker.stop()
+            for tracker in trackers:
+                await tracker.stop()
     result.wall_s = time.perf_counter() - t0
     if registry is not None:
         _record_swarm_metrics(result, registry)

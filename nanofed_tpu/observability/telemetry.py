@@ -189,6 +189,7 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     recoveries: list[dict[str, Any]] = []
     tenants: dict[str, dict[str, Any]] = {}
     fleets: dict[str, dict[str, Any]] = {}
+    federations: list[dict[str, Any]] = []
     adapter: dict[str, Any] = {}
     compile_events: list[dict[str, Any]] = []
     retune_events: list[dict[str, Any]] = []
@@ -338,6 +339,24 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     )
                     if k in rec
                 })
+            elif rtype == "federation":
+                # Fused wire→mesh campaigns (multihost_harness federate):
+                # one record per campaign — population, mesh geometry before/
+                # after chaos, round throughput, submit p99, and the reroute
+                # + zero-lost-submits accounting.  Campaigns accumulate (a
+                # telemetry dir may hold a no-chaos run and a kill drill).
+                federations.append({
+                    k: rec[k]
+                    for k in (
+                        "wire_clients", "hosts", "survivors", "rounds",
+                        "rounds_per_sec", "p99_submit_s", "accepted",
+                        "duplicates", "failed", "reroutes",
+                        "rerouted_updates_drained",
+                        "terminated_early_redriven", "zero_lost_submits",
+                        "host_killed", "kill_round",
+                    )
+                    if k in rec
+                })
             elif rtype == "compile":
                 # One XLA compile paid by the autotune sweep / warm pass
                 # (tuning.autotuner / tuning.compile_cache): which program,
@@ -442,6 +461,17 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         # mix, per-tier wire bytes and submit p99, and the dense-vs-padded
         # aggregation parity — the tiered-federation story in one block.
         out["fleets"] = dict(sorted(fleets.items()))
+    if federations:
+        # One-stack layer (multihost_harness federate): wire swarm → per-host
+        # ingest drains → one cross-host psum per round, with the chaos
+        # reroute ledger — the wire-to-mesh fusion story in one block.
+        out["federations"] = {
+            "count": len(federations),
+            "zero_lost_submits": all(
+                f.get("zero_lost_submits") for f in federations
+            ),
+            "campaigns": federations,
+        }
     if host_failures:
         # Host fault-tolerance layer (parallel.resilience): every detected
         # host failure, by kind, plus the recovery outcomes with MTTR — a

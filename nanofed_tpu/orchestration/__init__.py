@@ -2,6 +2,7 @@
 Coordinator/CoordinatorConfig/coordinate and the round types)."""
 
 from nanofed_tpu.orchestration.coordinator import Coordinator, CoordinatorConfig
+from nanofed_tpu.orchestration.engine import RoundLedger, completion_required
 from nanofed_tpu.orchestration.types import (
     ClientInfo,
     RoundMetrics,
@@ -14,8 +15,10 @@ __all__ = [
     "ClientInfo",
     "Coordinator",
     "CoordinatorConfig",
+    "RoundLedger",
     "RoundMetrics",
     "RoundStatus",
     "TrainingProgress",
     "cohort_size",
+    "completion_required",
 ]

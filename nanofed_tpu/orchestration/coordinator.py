@@ -48,6 +48,7 @@ from nanofed_tpu.observability.profiling import (
 from nanofed_tpu.observability.registry import get_registry
 from nanofed_tpu.observability.spans import SpanTracer
 from nanofed_tpu.observability.telemetry import RunTelemetry, install_jax_event_bridge
+from nanofed_tpu.orchestration.engine import RoundLedger, completion_required
 from nanofed_tpu.orchestration.types import RoundMetrics, RoundStatus, TrainingProgress
 from nanofed_tpu.parallel.mesh import (
     MODEL_AXIS,
@@ -757,17 +758,11 @@ class Coordinator:
         # Program-cost gauges publish into the same registry every other
         # instrument uses, so one /metrics scrape carries them too.
         self.program_catalog.registry = _registry
-        self._m_rounds = _registry.counter(
-            "nanofed_rounds_total", "Federation rounds by outcome", labels=("status",)
-        )
-        self._m_round_duration = _registry.histogram(
-            "nanofed_round_duration_seconds", "Wall time per federation round"
-        )
-        self._m_cohort = _registry.gauge(
-            "nanofed_cohort_size", "Clients whose updates entered the last aggregate"
-        )
-        self._m_dropouts = _registry.counter(
-            "nanofed_dropouts_total", "Sampled clients that dropped out of a round"
+        # Round-outcome accounting is the shared engine's, not this front's:
+        # the wire coordinator and the federate mesh workers charge the same
+        # ledger, so "one stack" is one set of round instruments.
+        self._ledger = RoundLedger(
+            _registry, telemetry=self.telemetry, track_dropouts=True
         )
 
         # Resume (improvement over the reference, where recovery isn't integrated).
@@ -1628,7 +1623,7 @@ class Coordinator:
         cfg = self.config
         first = self.current_round
         rounds = list(range(first, first + n))
-        required = max(1, int(np.ceil(self.cohort_size * cfg.min_completion_rate)))
+        required = completion_required(self.cohort_size, cfg.min_completion_rate)
         t0 = time.perf_counter()
 
         with self._tracer.span("dispatch", round=first, rounds=n):
@@ -1743,17 +1738,16 @@ class Coordinator:
                     timestamp=_now_iso(),
                 )
 
-            self._m_rounds.inc(status=metrics.status.name.lower())
-            self._m_round_duration.observe(per_round_s)
-            self._m_cohort.set(metrics.num_clients)
-            self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
-            if self.telemetry is not None:
-                self.telemetry.record(
-                    "round", round=r, status=metrics.status.name,
+            self._ledger.charge(
+                status=metrics.status.name, num_clients=metrics.num_clients,
+                duration_s=per_round_s, expected=self.cohort_size,
+                telemetry_fields=dict(
+                    round=r, status=metrics.status.name,
                     num_clients=metrics.num_clients,
                     duration_s=round(per_round_s, 6), fused=True,
                     rounds_per_block=n,
-                )
+                ),
+            )
 
             self._last_client_detail = None
             if (
@@ -1793,19 +1787,18 @@ class Coordinator:
         with self._tracer.span("round", round=round_id):
             metrics = self._train_round_impl(round_id)
         duration = time.perf_counter() - t0
-        self._m_rounds.inc(status=metrics.status.name.lower())
-        self._m_round_duration.observe(duration)
-        self._m_cohort.set(metrics.num_clients)
-        self._m_dropouts.inc(max(0, self.cohort_size - metrics.num_clients))
+        self._ledger.charge(
+            status=metrics.status.name, num_clients=metrics.num_clients,
+            duration_s=duration, expected=self.cohort_size,
+            telemetry_fields=dict(
+                round=round_id, status=metrics.status.name,
+                num_clients=metrics.num_clients, duration_s=round(duration, 6),
+            ),
+        )
         # Single-round occupancy basis: the local-train span blocks until the
         # device round completes, so its share of the round span IS device time.
         occupancy = update_device_occupancy(self._registry)
         self._observe_retune(1, duration, occupancy)
-        if self.telemetry is not None:
-            self.telemetry.record(
-                "round", round=round_id, status=metrics.status.name,
-                num_clients=metrics.num_clients, duration_s=round(duration, 6),
-            )
         return metrics
 
     def _train_round_impl(self, round_id: int) -> RoundMetrics:
@@ -1813,8 +1806,8 @@ class Coordinator:
         cohort = self.cohort_size
         with self._tracer.span("cohort-sample", round=round_id):
             survived = self._sample_cohort(round_id)
-        required = int(np.ceil(cohort * self.config.min_completion_rate))
-        if len(survived) < max(required, 1):
+        required = completion_required(cohort, self.config.min_completion_rate)
+        if len(survived) < required:
             self._log.warning(
                 "round %d FAILED: %d/%d clients completed (< %d required)",
                 round_id, len(survived), cohort, required,

@@ -169,8 +169,12 @@ def build_round_block(
             "cohort_mode=False runs the full population: step_clients must equal "
             f"padded_clients (got {step_clients} != {padded_clients})"
         )
-    # Same floor the single-round coordinator applies before dispatching a round.
-    required = max(1, math.ceil(cohort_size * min_completion_rate))
+    # The shared engine's gate, baked into the fused program as a static value.
+    # Local import: parallel is imported by orchestration's module body, so a
+    # top-level import back into orchestration would be a cycle.
+    from nanofed_tpu.orchestration.engine import completion_required
+
+    required = completion_required(cohort_size, min_completion_rate)
 
     # Frozen-base rounds (adapters): the base is a LOOP-INVARIANT input of the
     # scanned program — it enters the jit once, feeds every scanned round

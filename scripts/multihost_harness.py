@@ -38,6 +38,24 @@ so the whole hosts-axis path is testable without a pod:
   parity vs an unfailed run on the same shrunk mesh from the same recovery
   point, and a zero-orphans check over every pid ever spawned.
 
+* ``federate`` (``make federation-smoke``): ONE STACK — the wire tier drains
+  straight into the hierarchical mesh reduce.  Every mesh host runs an
+  ``HTTPServer`` + ``DeviceIngestBuffer`` front end; the ``loadgen`` swarm
+  drives the wire population against the listeners (VirtualClock arrival
+  schedule, real sockets, real submit latencies); each round is host-local
+  partial drains (the buffer's batched ``coefs @ buffer`` reduce, drained
+  UNNORMALIZED) joined by ONE cross-host psum
+  (``communication.federation.build_cross_host_row_psum`` on a hosts-only
+  mesh — one device per process, one gloo stream per beat — with the FedAvg
+  apply landing host-side via ``apply_summed_row``), with a stop-vote
+  control lane riding the same collective so hosts reach round-count
+  consensus without a side channel.  With ``--kill-round`` a seeded plan
+  crashes one host mid-campaign: its wire clients reroute to survivors LIVE
+  (retry/rotation/dedup), the supervisor re-forms the mesh over the
+  survivors from the newest committed generation, re-drives the dead host's
+  population, and asserts ZERO lost submits across the whole campaign.
+  Artifact: ``runs/federation_*.json``.
+
 Launcher (default entry) spawns the worker processes of itself; workers rendez-
 vous through ``jax.distributed`` on a loopback coordinator.  Every knob rides
 argv so the launcher and workers cannot drift.
@@ -145,6 +163,9 @@ def run_worker(args: argparse.Namespace) -> int:
 
     log(f"up: {len(devices)} global devices across "
         f"{info['process_count']} process(es)")
+
+    if args.job == "federate":
+        return _federate_worker(args, info, log)
 
     if args.hosts > 1:
         shape = (args.hosts, len(devices) // args.hosts, 1)
@@ -398,6 +419,316 @@ def _hostchaos_rounds(
                 "mesh_shape": list(mesh_shape(mesh)),
             },
         }, indent=2))
+        log(f"wrote {args.out}")
+    return 0
+
+
+def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
+    """One federate mesh host: a live HTTP listener + device ingest buffer
+    front end, drained HOST-LOCALLY each round (the buffer's batched
+    ``coefs @ buffer`` reduce is the host-local aggregation stage), then ONE
+    cross-host psum over ``hosts`` (``communication.federation``) applies the
+    global FedAvg step.  The psum row carries a stop-vote lane: workers agree
+    on the final round THROUGH the collective they already run — a worker
+    that exited on a local condition alone would deadlock its peers' next
+    psum.  The collective runs in an executor thread so the listener keeps
+    accepting (and a swarm keeps rerouting INTO this host) while gloo blocks."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.flatten_util import ravel_pytree
+
+    from nanofed_tpu.communication.federation import (
+        apply_summed_row,
+        assemble_host_rows,
+        build_cross_host_row_psum,
+        host_partial_row,
+    )
+    from nanofed_tpu.communication.http_server import HTTPServer
+    from nanofed_tpu.faults import ChaosSchedule, FaultPlan, HostChaosInjector
+    from nanofed_tpu.ingest import IngestConfig
+    from nanofed_tpu.models import get_model
+    from nanofed_tpu.observability.registry import MetricsRegistry
+    from nanofed_tpu.orchestration.engine import (
+        RoundLedger,
+        completion_required,
+    )
+    from nanofed_tpu.parallel import (
+        CollectiveWatchdog,
+        Heartbeat,
+        HostFailure,
+        make_mesh,
+        mesh_shape,
+        replicated_sharding,
+    )
+    from nanofed_tpu.persistence import GenerationStore
+
+    host = args.host_id
+    hosts_list = [int(h) for h in args.hosts_list.split(",")]
+    # Hosts-only mesh: ONE device per process.  A populated clients axis
+    # would split the psum into one replica group per client column — several
+    # concurrent gloo streams per round — and concurrent streams cross in
+    # gloo's async slot sequencing (op.preamble.length <= op.nbytes aborts,
+    # observed at 4 processes).  One device per host ⇒ one replica group ⇒
+    # one gloo stream per beat.  The host-local stage needs no mesh at all:
+    # it IS the ingest buffer's batched drain on this process's devices.
+    mesh = make_mesh(
+        devices=[
+            jax.local_devices(process_index=p)[0]
+            for p in range(jax.process_count())
+        ],
+        shape=(args.hosts, 1, 1),
+    )
+    model = get_model(args.model)
+    flat0, unravel = ravel_pytree(model.init(jax.random.key(args.seed)))
+    flat_size = int(flat0.size)
+
+    def to_tree(flat: "np.ndarray"):
+        return jax.tree.map(np.asarray, unravel(jnp.asarray(flat)))
+
+    psum_fn = build_cross_host_row_psum(mesh)
+
+    injector = None
+    if args.fault_plan:
+        injector = HostChaosInjector(
+            ChaosSchedule(FaultPlan.load(args.fault_plan)), host=host
+        )
+    hb = Heartbeat(args.hb_dir, host)
+    store = GenerationStore(args.ckpt_dir, host=host)
+    watchdog = CollectiveWatchdog(args.watchdog_deadline)
+    stop_file = Path(args.stop_file) if args.stop_file else None
+
+    flat = np.asarray(flat0, np.float32)
+    start_round = 0
+    if args.resume:
+        rec = store.latest_complete()
+        if rec is not None:
+            flat = np.asarray(ravel_pytree(rec.params)[0], np.float32)
+            start_round = rec.round_number
+            log(f"resumed generation {rec.generation} at round {start_round} "
+                f"(committed by hosts {list(rec.hosts)})")
+        else:
+            log("resume requested but no complete generation — fresh start")
+
+    # Warm dispatch: compiles the cross-host program AND doubles as the
+    # bring-up barrier — a listener only opens once every peer reached this
+    # collective (zero-mass rows change nothing; the mass floor keeps it
+    # finite).
+    warm = host_partial_row(None, 0.0, flat_size, extra=(0.0,))
+    jax.block_until_ready(psum_fn(assemble_host_rows(mesh, warm)))
+    # The warm psum is a barrier, so every host's anchor is within collective-
+    # completion skew (ms on loopback) of its peers'.  Round deadlines derive
+    # from this shared epoch — NOT from each host's own round start — so
+    # dispatch skew across hosts stays bounded by one beat period plus drain
+    # variance.  Load-bearing: XLA's CPU collectives carry a fixed internal
+    # 30 s gloo timeout (CollectiveThunk::DefaultCollectiveTimeout), and a
+    # host that reaches the psum a full unanchored round-timeout before a
+    # quiet peer trips it, aborting the fleet mid-campaign with a torn-pair
+    # gloo error instead of a clean round.
+    anchor = time.monotonic()
+    log(f"cross-host reduce compiled on mesh {mesh_shape(mesh)} "
+        "(bring-up barrier passed)")
+
+    registry = MetricsRegistry()
+    ledger = RoundLedger(registry, track_dropouts=True)
+    required = completion_required(args.round_quota, args.min_completion_rate)
+    n_hosts = len(hosts_list)
+    progress = Path(args.progress) if args.progress else None
+
+    async def _serve() -> dict:
+        server = HTTPServer(
+            port=args.wire_port + host,
+            registry=registry,
+            max_inflight=512,
+            # >= 1 is load-bearing: at window 0 publish_model CLEARS the
+            # ingest buffer every round, silently dropping submits that were
+            # accepted but not yet drained.
+            staleness_window=max(1, args.staleness_window),
+            ingest=IngestConfig(capacity=args.ingest_capacity),
+        )
+        await server.start()
+        await server.publish_model(to_tree(flat), start_round)
+        if args.ready_file:
+            ready = Path(args.ready_file)
+            tmp_path = ready.with_suffix(".tmp")
+            tmp_path.write_text(json.dumps({
+                "host": host,
+                "url": f"http://127.0.0.1:{args.wire_port + host}",
+                "round": start_round,
+            }))
+            tmp_path.replace(ready)  # atomic: the supervisor never sees torn
+        log(f"listener up on :{args.wire_port + host} at round {start_round}")
+
+        loop = asyncio.get_running_loop()
+        base = flat
+        rounds_meta: list[dict] = []
+        clients_seen: set[str] = set()
+        rerouted_total = 0
+        r = start_round
+        while True:
+            if injector is not None:
+                injector.maybe_fail(r)  # the planned host_crash: os._exit
+                delay = injector.dcn_delay_s(r)
+                if delay:
+                    await asyncio.sleep(delay)
+            hb.beat(round_number=r, status="collecting")
+            t_round = time.perf_counter()
+            # Shared beat: every host's round-r deadline is the same offset
+            # from the warm-psum epoch, and the beat is STRICT — a full
+            # quota never dispatches early.  Both halves are load-bearing:
+            # hosts must enter the psum near-simultaneously (XLA CPU
+            # collectives carry a fixed internal 30 s gloo timeout), and
+            # back-to-back collective bundles fired sub-second by a hot host
+            # race gloo's async slot sequencing (observed as op.preamble
+            # size-mismatch aborts when a 100k swarm concentrated on one
+            # listener).  The quota gates the LEDGER outcome, not dispatch.
+            deadline = anchor + (r - start_round + 1) * args.round_timeout_s
+            stop_seen = None
+            while True:
+                if stop_file is not None and stop_file.exists():
+                    # The supervisor writes the stop file only after every
+                    # swarm submit landed: the buffer is quiescent after a
+                    # short grace — drain whatever is left and vote stop.
+                    if stop_seen is None:
+                        stop_seen = time.monotonic()
+                    elif time.monotonic() - stop_seen > 0.5:
+                        break
+                if time.monotonic() > deadline:
+                    break
+                await asyncio.sleep(0.02)
+            out, mass, metas = await server.drain_ingest_fedavg_partial()
+            want_stop = (
+                (stop_file is not None and stop_file.exists())
+                or (r + 1) >= args.rounds
+            )
+            row = host_partial_row(
+                None if out is None else np.asarray(out), mass, flat_size,
+                extra=(1.0 if want_stop else 0.0,),
+            )
+            hb.beat(round_number=r, status="dispatch")
+
+            def dispatch(row=row, base=base):
+                # One collective, nothing else on the wire: the psum'd row
+                # comes back and the FedAvg apply happens in numpy — bitwise
+                # identical on every host (ring all-reduce results are
+                # rank-identical), so no broadcast/materialization stream
+                # ever coexists with the psum.
+                total_dev = psum_fn(assemble_host_rows(mesh, row))
+                jax.block_until_ready(total_dev)
+                return apply_summed_row(base, np.asarray(total_dev),
+                                        flat_size)
+
+            try:
+                # Executor thread: the event loop — and with it the wire
+                # listener — stays live while gloo blocks on the psum.
+                new_flat, tail = await loop.run_in_executor(
+                    None,
+                    lambda: watchdog.run(
+                        dispatch, round_number=r,
+                        tick=lambda: hb.beat(round_number=r,
+                                             status="dispatch"),
+                    ),
+                )
+            except HostFailure as exc:
+                log(f"watchdog: {exc}")
+                hb.beat(round_number=r, status="peer_failure")
+                # os._exit, not sys.exit: atexit would barrier on the dead
+                # peer (see _hostchaos_rounds).
+                os._exit(PEER_FAILURE_RC)
+            except Exception as exc:  # gloo/coordination error: a peer died
+                log(f"dispatch failed (peer loss?): "
+                    f"{type(exc).__name__}: {exc}")
+                hb.beat(round_number=r, status="peer_failure")
+                os._exit(PEER_FAILURE_RC)
+            global_mass = float(tail[0])
+            stop_votes = float(tail[1])
+            dt = time.perf_counter() - t_round
+            if global_mass > 0.0:
+                base = new_flat
+                # Strict-beat pacing means the quota no longer gates WHEN a
+                # round fires — it gates how the ledger scores the beat: a
+                # drain below completion_required() still advances the model
+                # (the mass-weighted reduce is exact at any cohort size) but
+                # is charged DEGRADED so under-filled beats are visible in
+                # nanofed_rounds_total without stalling the collective.
+                status = ("COMPLETED" if len(metas) >= required
+                          else "DEGRADED")
+            else:
+                status = "FAILED"  # every host drained empty; params keep
+            rerouted = sum(
+                1 for m in metas
+                if not str(m.client_id).startswith(f"h{host}_")
+            )
+            rerouted_total += rerouted
+            clients_seen.update(str(m.client_id) for m in metas)
+            sentinel = want_stop and not metas and global_mass <= 0.0
+            if not sentinel:
+                ledger.charge(
+                    status=status, num_clients=len(metas), duration_s=dt,
+                    expected=args.round_quota,
+                )
+                rounds_meta.append({
+                    "round": r, "drained": len(metas),
+                    "mass": round(float(mass), 3),
+                    "global_mass": round(global_mass, 3),
+                    "rerouted_in": rerouted,
+                    "duration_s": round(dt, 4), "status": status,
+                })
+                if progress is not None:
+                    with progress.open("a") as f:
+                        f.write(json.dumps({
+                            "round": r, "drained": len(metas),
+                            "mass": round(float(mass), 3),
+                            "rerouted_in": rerouted,
+                            "duration_s": round(dt, 4),
+                            "wall_t": time.time(),
+                        }) + "\n")
+                log(f"round {r}: drained {len(metas)} (mass {mass:.1f}, "
+                    f"{rerouted} rerouted in) global mass "
+                    f"{global_mass:.1f} [{status}] {dt:.2f}s")
+            r += 1
+            await server.publish_model(to_tree(base), r)
+            hb.beat(round_number=r, status="running")
+            if r % args.block_size == 0 and not sentinel:
+                store.commit(r // args.block_size, r, to_tree(base), {},
+                             hosts=hosts_list)
+                log(f"committed generation {r // args.block_size} "
+                    f"at round {r}")
+            if stop_votes >= n_hosts - 0.5:
+                log(f"stop consensus at round {r} "
+                    f"({stop_votes:.0f}/{n_hosts} votes)")
+                break
+
+        if r % args.block_size != 0:
+            store.commit(r // args.block_size + 1, r, to_tree(base), {},
+                         hosts=hosts_list)
+        server.stop_training()
+        await asyncio.sleep(0.2)  # let /status pollers observe the stop
+        hb.beat(round_number=r, status="done")
+        result = {
+            "mode": "federate",
+            "host": host,
+            "start_round": start_round,
+            "end_round": r,
+            "rounds": rounds_meta,
+            "clients_distinct": len(clients_seen),
+            "rerouted_in_total": rerouted_total,
+            "topology": {
+                "process_count": info["process_count"],
+                "hosts": args.hosts,
+                "host_ids": hosts_list,
+                "devices": jax.device_count(),
+                "mesh_shape": list(mesh_shape(mesh)),
+            },
+        }
+        await server.stop()
+        return result
+
+    result = asyncio.run(_serve())
+    if args.out is not None:
+        Path(args.out).write_text(json.dumps(result, indent=2))
         log(f"wrote {args.out}")
     return 0
 
@@ -1063,14 +1394,530 @@ def run_hostchaos(args: argparse.Namespace) -> int:
     return 0
 
 
+def _spawn_federate(
+    args: argparse.Namespace,
+    host_ids: list[int],
+    port: int,
+    *,
+    phase: str,
+    hb_dir: Path,
+    ckpt_dir: Path,
+    resume: bool,
+    plan_path: Path | None,
+    stop_file: Path,
+    tmp: Path,
+) -> list[subprocess.Popen]:
+    """One federate worker per LOGICAL host id (dense process ids per phase,
+    stable host ids across the kill — same convention as hostchaos).  Every
+    worker gets its own ready/progress/result files: the supervisor reads
+    per-host round stats even from a phase that ends in a reap."""
+    procs = []
+    n = len(host_ids)
+    for pid, host in enumerate(host_ids):
+        cmd = [
+            sys.executable, str(Path(__file__).resolve()), "worker",
+            "--job", "federate",
+            "--process-id", str(pid),
+            "--num-processes", str(n),
+            "--coordinator", f"localhost:{port}",
+            "--hosts", str(n),
+            "--rounds", str(args.max_rounds),
+            "--model", args.model,
+            "--seed", str(args.seed),
+            "--devices-per-process", str(args.devices_per_process),
+            "--block-size", str(args.block_size),
+            "--watchdog-deadline", str(args.federate_watchdog),
+            "--host-id", str(host),
+            "--hosts-list", ",".join(str(h) for h in host_ids),
+            "--hb-dir", str(hb_dir),
+            "--ckpt-dir", str(ckpt_dir),
+            "--wire-port", str(args.wire_port),
+            "--ingest-capacity", str(args.ingest_capacity),
+            "--staleness-window", str(args.staleness_window),
+            "--round-quota", str(args.round_quota),
+            "--min-completion-rate", str(args.min_completion_rate),
+            "--round-timeout-s", str(args.round_timeout_s),
+            "--stop-file", str(stop_file),
+            "--ready-file", str(tmp / f"fed_ready_h{host}.json"),
+            "--progress", str(tmp / f"fed_progress_{phase}_h{host}.jsonl"),
+            "--out", str(tmp / f"fed_result_{phase}_h{host}.json"),
+        ]
+        if resume:
+            cmd += ["--resume"]
+        if plan_path is not None:
+            cmd += ["--fault-plan", str(plan_path)]
+        procs.append(subprocess.Popen(cmd, env=_worker_env(args, pid)))
+    return procs
+
+
+def run_federate(args: argparse.Namespace) -> int:
+    """ONE STACK: wire clients drain straight into the hierarchical mesh
+    reduce.  W jax.distributed mesh hosts each run an HTTP listener + device
+    ingest buffer; the loadgen swarm drives the wire population against them
+    (VirtualClock schedule, real sockets); each round is host-local drains +
+    ONE cross-host psum.  With ``--kill-round`` a seeded plan crashes one
+    host mid-campaign: its wire clients reroute to survivors live
+    (retry/rotation/dedup), the mesh re-forms over the survivors from the
+    newest committed generation, and the dead host's population re-drives —
+    zero lost submits, asserted."""
+    import asyncio
+
+    import numpy as np
+
+    from nanofed_tpu.communication.retry import RetryPolicy
+    from nanofed_tpu.faults.plan import FaultEvent, FaultPlan
+    from nanofed_tpu.loadgen.swarm import SwarmConfig, latency_digest, run_swarm
+    from nanofed_tpu.observability.telemetry import RunTelemetry
+    from nanofed_tpu.parallel.resilience import no_orphans
+    from nanofed_tpu.persistence import GenerationStore
+    from nanofed_tpu.utils.clock import VirtualClock
+
+    if args.num_processes < 2:
+        raise SystemExit("federate needs --num-processes >= 2 (one wire "
+                         "listener per mesh host)")
+    P = args.num_processes
+    tmp = Path(args.tmp_dir)
+    tmp.mkdir(parents=True, exist_ok=True)
+    hb_dir = _fresh_dir(tmp / "fed_hb")
+    ckpt = _fresh_dir(tmp / "fed_ckpt")
+    stop_file = tmp / "federate_stop"
+    stop_file.unlink(missing_ok=True)
+    for stale in list(tmp.glob("fed_result_*.json")) + list(
+        tmp.glob("fed_progress_*.jsonl")
+    ):
+        stale.unlink()
+
+    hosts = list(range(P))
+    counts = [args.clients // P + (1 if i < args.clients % P else 0)
+              for i in range(P)]
+    urls = [f"http://127.0.0.1:{args.wire_port + h}" for h in hosts]
+
+    kill = args.kill_round is not None
+    victim = args.kill_host if args.kill_host is not None else P - 1
+    plan = None
+    plan_path = None
+    if kill:
+        plan = FaultPlan(seed=args.seed, events=(
+            FaultEvent(kind="host_crash", round=args.kill_round, host=victim),
+        ))
+        plan_path = tmp / "federate_plan.json"
+        plan.save(plan_path)
+
+    # Canned payload base = the same deterministic init the workers publish,
+    # so the servers' delta reconstruction lands on base + noise exactly.
+    import jax
+
+    from nanofed_tpu.models import get_model
+
+    base_params = jax.tree.map(
+        np.asarray, get_model(args.model).init(jax.random.key(args.seed))
+    )
+
+    if args.telemetry_dir is None:
+        telemetry_dir = _fresh_dir(tmp / "fed_telemetry")
+    else:
+        telemetry_dir = Path(args.telemetry_dir)
+        telemetry_dir.mkdir(parents=True, exist_ok=True)
+
+    all_pids: list[int] = []
+    t0 = time.time()
+
+    def _retry(seed: int) -> RetryPolicy:
+        # Generous on purpose: backoffs ride the VirtualClock (~no real
+        # time), and zero lost submits means no client may exhaust while a
+        # reroute target is still alive.
+        return RetryPolicy(max_attempts=64, base_backoff_s=0.05,
+                           max_backoff_s=1.0, multiplier=1.5,
+                           budget_s=None, seed=seed)
+
+    def _wait_ready(procs: list, live_hosts: list[int]) -> None:
+        deadline = time.time() + args.timeout
+        paths = {h: tmp / f"fed_ready_h{h}.json" for h in live_hosts}
+        ready: set[int] = set()
+        while len(ready) < len(paths):
+            for h, p in paths.items():
+                if h not in ready and p.exists():
+                    ready.add(h)
+            for q in procs:
+                rc = q.poll()
+                if rc is not None:
+                    _reap(procs)
+                    raise SystemExit(
+                        f"federate worker exited rc={rc} during bring-up"
+                    )
+            if time.time() > deadline:
+                _reap(procs)
+                raise SystemExit("federate workers not ready within "
+                                 f"{args.timeout:.0f}s")
+            time.sleep(0.1)
+
+    async def _drive(procs: list, live_hosts: list[int], jobs: list,
+                     expect_kill: bool) -> tuple[list, dict]:
+        """Run the sub-swarms concurrently with a worker monitor.  The
+        monitor's stop decisions are what keep 'zero lost submits' true: a
+        pending submit aimed at a doomed fleet is terminated early (and
+        re-driven next phase), never left to exhaust its retries as a
+        failure."""
+        stop_event = asyncio.Event()
+        clock = VirtualClock()
+        state: dict = {"t_kill": None, "unexpected": None}
+
+        async def monitor() -> None:
+            while not stop_event.is_set():
+                rcs = [q.poll() for q in procs]
+                for h, rc in zip(live_hosts, rcs):
+                    if rc is None:
+                        continue
+                    if rc == HOST_CRASH_RC and expect_kill and h == victim:
+                        if state["t_kill"] is None:
+                            state["t_kill"] = time.time()
+                            print(f"# host {h} killed by plan (rc={rc}); "
+                                  "wire clients rerouting to survivors for "
+                                  f"{args.reroute_grace:.1f}s", flush=True)
+                    elif rc == PEER_FAILURE_RC and expect_kill:
+                        # A survivor's watchdog fired before the grace ended:
+                        # stop the swarm now — pending submits terminate
+                        # early instead of failing against a dead fleet.
+                        stop_event.set()
+                        return
+                    else:
+                        state["unexpected"] = (h, rc)
+                        stop_event.set()
+                        return
+                if state["t_kill"] is not None and (
+                    time.time() - state["t_kill"] >= args.reroute_grace
+                ):
+                    # Reroutes demonstrated live; the remaining population
+                    # re-drives against the recovered mesh in phase C.
+                    stop_event.set()
+                    return
+                if all(rc is not None for rc in rcs):
+                    stop_event.set()
+                    return
+                await asyncio.sleep(0.2)  # REAL time: process liveness poll
+
+        mon = asyncio.ensure_future(monitor())
+        try:
+            results = await asyncio.gather(*(
+                run_swarm(url, base_params, cfg, clock=clock,
+                          stop=stop_event, client_indices=idx)
+                for url, cfg, idx in jobs
+            ))
+        finally:
+            stop_event.set()
+            mon.cancel()
+            try:
+                await mon
+            except (asyncio.CancelledError, Exception):
+                pass
+        return results, state
+
+    def _cfg(owner: int, phase_salt: int, failover: tuple[str, ...],
+             n_clients: int) -> "SwarmConfig":
+        return SwarmConfig(
+            num_clients=n_clients,
+            submits_per_client=args.submits_per_client,
+            arrival="uniform",
+            arrival_rate=args.arrival_rate,
+            seed=args.seed + 17 * owner + phase_salt,
+            retry=_retry(args.seed + 31 * owner + phase_salt),
+            client_prefix=f"h{owner}",
+            failover_urls=failover,
+            connector_limit=256,
+            canned_payloads=4,
+        )
+
+    # ---- phase A: full mesh, full population -------------------------------
+    for h in hosts:
+        (tmp / f"fed_ready_h{h}.json").unlink(missing_ok=True)
+    print(f"# federate: {P} mesh hosts x wire listeners, {args.clients} wire "
+          "clients"
+          + (f"; planned host_crash on host {victim} at round "
+             f"{args.kill_round}" if kill else ""), flush=True)
+    procs = _spawn_federate(
+        args, hosts, args.port, phase="a", hb_dir=hb_dir, ckpt_dir=ckpt,
+        resume=False, plan_path=plan_path, stop_file=stop_file, tmp=tmp,
+    )
+    all_pids += [p.pid for p in procs]
+    _wait_ready(procs, hosts)
+    print("# all listeners ready; releasing the swarm", flush=True)
+
+    jobs_a = [
+        (urls[h],
+         _cfg(h, 0, tuple(urls[j] for j in hosts if j != h), counts[h]),
+         None)
+        for h in hosts
+    ]
+    results_a, state_a = asyncio.run(_drive(procs, hosts, jobs_a, kill))
+    swarm_a = dict(zip(hosts, results_a))
+    if state_a["unexpected"] is not None:
+        _reap(procs)
+        raise SystemExit(
+            f"federate worker host {state_a['unexpected'][0]} exited "
+            f"rc={state_a['unexpected'][1]} mid-campaign"
+        )
+
+    results_c: dict[int, object] = {}
+    survivors = hosts
+    recovery = None
+    if not kill:
+        stop_file.write_text("stop\n")
+        _wait(procs, args.timeout)
+    else:
+        if state_a["t_kill"] is None:
+            _reap(procs)
+            raise SystemExit("kill was planned but the victim never died — "
+                             "lower --kill-round or raise the population")
+        # The survivors are blocked in a psum the dead victim will never
+        # join: phase A is over for them.  Reap and re-form.
+        _reap(procs)
+        survivors = [h for h in hosts if h != victim]
+        rec = GenerationStore(ckpt).latest_complete()
+        resumed_round = rec.round_number if rec is not None else 0
+        recovery = {
+            "victim": victim,
+            "kill_round": args.kill_round,
+            "reroute_grace_s": args.reroute_grace,
+            "resumed_generation": rec.generation if rec is not None else None,
+            "resumed_round": resumed_round,
+            "hosts_after": len(survivors),
+        }
+        print(f"# phase C: re-forming over hosts {survivors}, resuming at "
+              f"round {resumed_round}; re-driving the dead host's "
+              f"{counts[victim]} wire clients", flush=True)
+
+        for h in survivors:
+            (tmp / f"fed_ready_h{h}.json").unlink(missing_ok=True)
+        procs = _spawn_federate(
+            args, survivors, args.port + 7, phase="c", hb_dir=hb_dir,
+            ckpt_dir=ckpt, resume=True, plan_path=None, stop_file=stop_file,
+            tmp=tmp,
+        )
+        all_pids += [p.pid for p in procs]
+        _wait_ready(procs, survivors)
+
+        surv_urls = [urls[h] for h in survivors]
+        # The victim's whole population re-drives against the survivors: its
+        # listener is gone, and anything a survivor accepted after the last
+        # committed generation died undrained with phase A (the same
+        # at-most-one-block unit hostchaos drills).  Survivors' clients that
+        # terminated early when the swarm stopped re-drive too.
+        # Stripe the victim's population across the survivors (one job per
+        # survivor, disjoint index stripes) instead of pointing 25k clients
+        # at one primary URL: rotation-on-failure balances a CRASH, but a
+        # re-drive is a planned dispatch — spread it up front.
+        owners = []
+        jobs_c = []
+        for j, s in enumerate(survivors):
+            stripe = list(range(counts[victim]))[j::len(survivors)]
+            if not stripe:
+                continue
+            owners.append(victim)
+            jobs_c.append((
+                urls[s],
+                _cfg(victim, 1 + j,
+                     tuple(u for u in surv_urls if u != urls[s]),
+                     counts[victim]),
+                stripe,
+            ))
+        for h in survivors:
+            missing = sorted(
+                set(range(counts[h])) - set(swarm_a[h].completed_indices)
+            )
+            if missing:
+                owners.append(h)
+                jobs_c.append((
+                    urls[h],
+                    _cfg(h, 1,
+                         tuple(u for u in surv_urls if u != urls[h]),
+                         counts[h]),
+                    missing,
+                ))
+        results, state_c = asyncio.run(_drive(procs, survivors, jobs_c, False))
+        if state_c["unexpected"] is not None:
+            _reap(procs)
+            raise SystemExit(
+                f"federate worker host {state_c['unexpected'][0]} exited "
+                f"rc={state_c['unexpected'][1]} during recovery"
+            )
+        results_c = {}
+        for owner, res in zip(owners, results):
+            prev = results_c.get(owner)
+            if prev is None:
+                results_c[owner] = res
+            else:
+                # The victim's population runs as one stripe per survivor:
+                # fold the stripes back into one per-owner ledger.
+                prev.latencies_s += res.latencies_s
+                prev.accepted += res.accepted
+                prev.duplicates += res.duplicates
+                prev.rejected_429 += res.rejected_429
+                prev.retries += res.retries
+                prev.stale_refreshes += res.stale_refreshes
+                prev.failed += res.failed
+                prev.terminated_early += res.terminated_early
+                prev.reroutes += res.reroutes
+                prev.completed_indices += res.completed_indices
+        stop_file.write_text("stop\n")
+        _wait(procs, args.timeout)
+
+    # ---- accounting + assertions ------------------------------------------
+    all_results = list(swarm_a.values()) + list(results_c.values())
+    latencies = [x for r in all_results for x in r.latencies_s]
+    digest = latency_digest(latencies)
+    failed = sum(r.failed for r in all_results)
+    reroutes = sum(r.reroutes for r in all_results)
+    accepted = sum(r.accepted for r in all_results)
+    duplicates = sum(r.duplicates for r in all_results)
+    terminated = sum(r.terminated_early for r in all_results)
+
+    lost: dict[int, int] = {}
+    for h in hosts:
+        done = set(swarm_a[h].completed_indices)
+        if h in results_c:
+            done |= set(results_c[h].completed_indices)
+        missing_n = counts[h] - len(done & set(range(counts[h])))
+        if missing_n:
+            lost[h] = missing_n
+
+    progress_lines: list[dict] = []
+    per_host_phase_a: dict[int, int] = {}
+    for phase in ("a", "c"):
+        for h in hosts:
+            lines = _read_progress(tmp / f"fed_progress_{phase}_h{h}.jsonl")
+            if phase == "a":
+                per_host_phase_a[h] = len(lines)
+            progress_lines += lines
+    durations = sorted(ln["duration_s"] for ln in progress_lines)
+    median_round = durations[len(durations) // 2] if durations else None
+    drained_total = sum(ln["drained"] for ln in progress_lines)
+    rerouted_drained = sum(ln.get("rerouted_in", 0) for ln in progress_lines)
+    orphans = no_orphans(all_pids)
+
+    assert failed == 0, (
+        f"lost submits: {failed} logical submits never got a 200 "
+        f"(per-host: {[(h, swarm_a[h].failed) for h in hosts]})"
+    )
+    assert not lost, (
+        f"clients never completed across phases (host -> count): {lost}"
+    )
+    assert all(per_host_phase_a[h] > 0 for h in hosts), (
+        f"a host drained no rounds in phase A: {per_host_phase_a}"
+    )
+    if kill:
+        assert reroutes > 0, (
+            "the kill fired but no wire client rerouted — the grace window "
+            "closed before any submit hit the dead listener"
+        )
+        assert rerouted_drained > 0, (
+            "no rerouted client's update was ever drained by another host"
+        )
+    assert not orphans, f"orphan worker processes survived the run: {orphans}"
+
+    artifact = {
+        "record_type": "federation",
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "seed": args.seed,
+        "model": args.model,
+        "wire_clients": args.clients,
+        "submits_per_client": args.submits_per_client,
+        "per_host_clients": counts,
+        "topology": {
+            "hosts": P,
+            "devices_per_process": args.devices_per_process,
+            # Hosts-only reduce mesh: one device per process, so the round's
+            # cross-host psum compiles to one all-reduce with one replica
+            # group (one gloo stream per beat).
+            "mesh_shape": [P, 1, 1],
+            "wire_ports": [args.wire_port + h for h in hosts],
+            "survivors": survivors,
+        },
+        "rounds": {
+            "drained_rounds": len(progress_lines),
+            "median_round_s": median_round,
+            "rounds_per_sec": (
+                round(1.0 / median_round, 4) if median_round else None
+            ),
+            "round_quota": args.round_quota,
+            "min_completion_rate": args.min_completion_rate,
+            "updates_aggregated": drained_total,
+        },
+        "wire": {
+            "accepted": accepted,
+            "duplicates": duplicates,
+            "failed": failed,
+            "terminated_early_redriven": terminated,
+            "reroutes": reroutes,
+            "rerouted_updates_drained": rerouted_drained,
+            "submit_latency": digest,
+        },
+        "chaos": (
+            {"plan": json.loads(plan.to_json()), **recovery}
+            if kill else None
+        ),
+        "zero_lost_submits": True,
+        "orphans": orphans,
+        "platform": "cpu",
+        "basis": (
+            "multi-process jax.distributed over loopback (gloo CPU "
+            "collectives) with a REAL aiohttp wire tier: each mesh host runs "
+            "an HTTP listener + device ingest buffer, drains host-locally "
+            "(the buffer's batched coefs @ buffer reduce), and joins ONE "
+            "cross-host psum per round.  The swarm's arrival schedule and "
+            "backoffs ride a VirtualClock; submit latencies are real "
+            "wall-clock against live sockets.  Measures the fused "
+            "wire-to-mesh PROGRAM and protocol at population scale, not TPU "
+            "silicon."
+        ),
+        "harness": "scripts/multihost_harness.py federate",
+        "walltime_s": round(time.time() - t0, 1),
+    }
+    tel = RunTelemetry(telemetry_dir)
+    tel.record(
+        "federation",
+        wire_clients=args.clients,
+        hosts=P,
+        survivors=len(survivors),
+        rounds=len(progress_lines),
+        rounds_per_sec=artifact["rounds"]["rounds_per_sec"],
+        p99_submit_s=digest["p99_s"],
+        accepted=accepted,
+        duplicates=duplicates,
+        failed=failed,
+        reroutes=reroutes,
+        rerouted_updates_drained=rerouted_drained,
+        terminated_early_redriven=terminated,
+        zero_lost_submits=True,
+        host_killed=victim if kill else None,
+        kill_round=args.kill_round,
+    )
+    tel.close()
+
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    path = out_dir / f"{args.artifact_prefix}_{stamp}_{P}h.json"
+    path.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact, indent=2))
+    print(f"# artifact written to {path}")
+    print(f"# telemetry: {telemetry_dir} (digest: python -m nanofed_tpu.cli "
+          f"metrics-summary {telemetry_dir})")
+    print(f"federate OK: {args.clients} wire clients over {P} hosts, "
+          f"{len(progress_lines)} drained rounds, p99 submit "
+          f"{digest['p99_s']}s, {reroutes} reroutes, zero lost submits")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "mode", choices=["smoke", "bench", "hostchaos", "worker"],
+        "mode", choices=["smoke", "bench", "hostchaos", "federate", "worker"],
         help="smoke: 2-process parity vs 1-D reference; bench: 100k-client "
         "throughput artifact; hostchaos: seeded kill-and-recover drill with "
-        "elastic mesh re-formation; worker: internal (one jax.distributed "
-        "process)",
+        "elastic mesh re-formation; federate: wire swarm drains straight "
+        "into the hierarchical mesh reduce (listener per host, one "
+        "cross-host psum per round, optional mid-campaign host kill); "
+        "worker: internal (one jax.distributed process)",
     )
     parser.add_argument("--clients", type=int, default=None)
     parser.add_argument("--capacity", type=int, default=8,
@@ -1090,7 +1937,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=12421)
     parser.add_argument("--timeout", type=float, default=600.0,
                         help="per-phase worker timeout (tier-1-safe)")
-    parser.add_argument("--job", choices=["smoke", "bench", "hostchaos"],
+    parser.add_argument("--job",
+                        choices=["smoke", "bench", "hostchaos", "federate"],
                         default="smoke",
                         help="(worker) which launcher job this worker serves "
                         "— a FULL flag name: an abbreviated --mod* would "
@@ -1125,8 +1973,8 @@ def main(argv: list[str] | None = None) -> int:
                         help="(hostchaos) extra rounds after the failed host "
                         "rejoins the mesh (0 disables the rejoin phase)")
     parser.add_argument("--telemetry-dir", default=None,
-                        help="(hostchaos) where the supervisor writes "
-                        "telemetry.jsonl (default: <tmp-dir>/telemetry)")
+                        help="(hostchaos/federate) where the supervisor "
+                        "writes telemetry.jsonl (default under --tmp-dir)")
     # hostchaos: worker-side identity + wiring (set by the supervisor)
     parser.add_argument("--fault-plan", default=None,
                         help="(worker) fault-plan JSON path")
@@ -1143,16 +1991,78 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--resume", action="store_true",
                         help="(worker) resume from the newest complete "
                         "generation in --ckpt-dir")
+    # federate: wire tier + round pacing (supervisor) and listener wiring
+    # (worker, set by the supervisor)
+    parser.add_argument("--wire-port", type=int, default=18480,
+                        help="(federate) base HTTP port; host h listens on "
+                        "wire-port + h")
+    parser.add_argument("--round-quota", type=int, default=1024,
+                        help="(federate) accepted updates a host waits for "
+                        "before draining its round")
+    parser.add_argument("--min-completion-rate", type=float, default=1.0,
+                        help="(federate) fraction of --round-quota that "
+                        "counts the round COMPLETED in the ledger")
+    parser.add_argument("--round-timeout-s", type=float, default=10.0,
+                        help="(federate) round beat period: deadlines are "
+                        "shared offsets from the bring-up-barrier epoch, so "
+                        "hosts dispatch the cross-host psum near-"
+                        "simultaneously regardless of quota skew; must stay "
+                        "well under XLA's fixed 30s gloo collective timeout")
+    parser.add_argument("--ingest-capacity", type=int, default=8192,
+                        help="(federate) DeviceIngestBuffer slots per host — "
+                        "size for the failover worst case: one survivor "
+                        "absorbs a dead host's whole undrained population")
+    parser.add_argument("--staleness-window", type=int, default=8,
+                        help="(federate) server staleness window; the worker "
+                        "floors it at 1 (window 0 clears accepted-but-"
+                        "undrained submits on every publish)")
+    parser.add_argument("--submits-per-client", type=int, default=1)
+    parser.add_argument("--arrival-rate", type=float, default=4000.0,
+                        help="(federate) swarm arrivals/s per host on the "
+                        "virtual clock")
+    parser.add_argument("--max-rounds", type=int, default=10_000,
+                        help="(federate) worker round ceiling; the campaign "
+                        "normally ends by stop-file consensus when the "
+                        "swarm is drained")
+    parser.add_argument("--kill-round", type=int, default=None,
+                        help="(federate) plan a host_crash at this round; "
+                        "omit for a no-chaos campaign")
+    parser.add_argument("--kill-host", type=int, default=None,
+                        help="(federate) logical host the plan kills "
+                        "(default: the last host)")
+    parser.add_argument("--reroute-grace", type=float, default=6.0,
+                        help="(federate) real seconds of live rerouting to "
+                        "survivors after the kill before the swarm pauses "
+                        "for mesh re-formation")
+    parser.add_argument("--federate-watchdog", type=float, default=240.0,
+                        help="(federate) cross-host dispatch deadline — "
+                        "generous: round cadence is swarm-driven")
+    parser.add_argument("--artifact-prefix", default="federation",
+                        help="(federate) artifact filename prefix under "
+                        "--out-dir")
+    parser.add_argument("--stop-file", default=None,
+                        help="(worker) path whose existence votes to stop "
+                        "the campaign")
+    parser.add_argument("--ready-file", default=None,
+                        help="(worker) JSON written once the wire listener "
+                        "is up and the mesh barrier has passed")
     args = parser.parse_args(argv)
 
     if args.clients is None:
-        args.clients = 100_000 if args.mode == "bench" else 16
+        if args.mode == "bench":
+            args.clients = 100_000
+        elif args.mode == "federate":
+            args.clients = 2000
+        else:
+            args.clients = 16
     if args.mode == "worker":
         return run_worker(args)
     if args.mode == "smoke":
         return run_smoke(args)
     if args.mode == "hostchaos":
         return run_hostchaos(args)
+    if args.mode == "federate":
+        return run_federate(args)
     return run_bench(args)
 
 

@@ -226,6 +226,188 @@ def test_fedbuff_batched_equals_per_submit_with_staleness():
                                rtol=1e-4, atol=1e-5)
 
 
+# ---------------------------------------------------------------------------
+# Ingest-on-hosts parity (ISSUE 19): host-local PARTIAL drains composed by the
+# one cross-host psum ≡ a single host draining the union of the buffers.  This
+# is the algebraic contract the wire→mesh bridge rests on — unnormalized
+# Σ w δ / Σ w is the union's weighted mean under ANY client→host partition.
+# ---------------------------------------------------------------------------
+
+FLAT = 11
+
+
+def _pipeline(capacity):
+    from nanofed_tpu.ingest.pipeline import IngestPipeline
+
+    return IngestPipeline(
+        {"w": np.zeros(FLAT, np.float32)}, IngestConfig(capacity=capacity),
+        registry=MetricsRegistry(),
+    )
+
+
+def _hier_mesh():
+    from nanofed_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(shape=(2, 4, 1))  # 2 virtual hosts over the 8-dev suite
+
+
+def test_hierarchical_fedavg_partials_equal_union_drain_strict():
+    """Three FedAvg rounds through two host-local buffers + the ONE cross-host
+    reduce track the single-host union drain to 1e-4 — with the cross-host
+    dispatch under ``jax.transfer_guard("disallow")`` (strict mode: committed
+    inputs, zero implicit transfers)."""
+    from nanofed_tpu.communication.federation import (
+        assemble_host_rows,
+        build_cross_host_reduce,
+        host_partial_row,
+    )
+    from nanofed_tpu.parallel.mesh import replicated_sharding
+
+    mesh = _hier_mesh()
+    repl = replicated_sharding(mesh)
+    fn = build_cross_host_reduce(mesh, FLAT)
+    hosts = [_pipeline(8), _pipeline(8)]
+    union = _pipeline(16)
+    rng = np.random.default_rng(5)
+    hier = rng.normal(size=FLAT).astype(np.float32)
+    flat_union = hier.copy()
+    for r in range(3):
+        union.note_version(r, {"w": flat_union}, window=0)
+        for h, pipe in enumerate(hosts):
+            for j in range(3 + h):  # uneven cohorts: 3 on host0, 4 on host1
+                delta = (rng.normal(size=FLAT) * 0.1).astype(np.float32)
+                cid, w = f"h{h}_c{j}", float(1 + j + 2 * h)
+                for target in (pipe, union):
+                    target.offer(delta, client_id=cid, round_number=r,
+                                 metrics={"num_samples": w})
+        rows = []
+        for pipe in hosts:
+            out, mass, metas = pipe.drain_fedavg_partial()
+            assert metas, "host drained empty"
+            rows.append(host_partial_row(np.asarray(out), mass, FLAT))
+        rows_dev = assemble_host_rows(mesh, np.stack(rows))
+        base_dev = jax.device_put(jnp.asarray(hier), repl)
+        with jax.transfer_guard("disallow"):
+            new_dev, tail_dev = fn(rows_dev, base_dev)
+        hier = np.asarray(new_dev)
+        u_out, u_metas = union.drain_fedavg(r)
+        assert len(u_metas) == 7
+        flat_union = np.asarray(u_out)
+        np.testing.assert_allclose(hier, flat_union, rtol=1e-4, atol=1e-4)
+        assert float(np.asarray(tail_dev)[0]) == pytest.approx(
+            sum(m.weight for m in u_metas)
+        )
+
+
+def test_hierarchical_fedbuff_partials_match_union_staleness():
+    """Per-host FedBuff partial drains + the cross-host reduce reproduce the
+    union drain: IDENTICAL staleness/discount multisets (union of the hosts'
+    stats ≡ the single-host stats) and the same applied params — server_lr
+    and 1/K applied once, globally, after the psum."""
+    from nanofed_tpu.communication.federation import (
+        assemble_host_rows,
+        build_cross_host_reduce,
+        host_partial_row,
+    )
+    from nanofed_tpu.parallel.mesh import replicated_sharding
+
+    mesh = _hier_mesh()
+    fn = build_cross_host_reduce(mesh, FLAT)
+    hosts = [_pipeline(8), _pipeline(8)]
+    union = _pipeline(16)
+    rng = np.random.default_rng(9)
+    versions = {v: rng.normal(size=FLAT).astype(np.float32) for v in range(3)}
+    for pipe in (*hosts, union):
+        for v, flat in versions.items():
+            pipe.note_version(v, {"w": flat}, window=2)
+    # (host, base_version) offers: mixed staleness on both hosts, plus one
+    # slot whose base version left the window (skipped identically).
+    offers = [(0, 2), (0, 1), (0, 0), (1, 2), (1, 1), (1, 7)]
+    for j, (h, v) in enumerate(offers):
+        delta = (rng.normal(size=FLAT) * 0.1).astype(np.float32)
+        cid = f"c{j}"
+        hosts[h].offer(delta, client_id=cid, round_number=v,
+                       metrics={"num_samples": 1.0})
+        union.offer(delta, client_id=cid, round_number=v,
+                    metrics={"num_samples": 1.0})
+    rows, stats_union_of_hosts = [], {"staleness": [], "discounts": [],
+                                      "skipped": 0}
+    for pipe in hosts:
+        out, metas, stats = pipe.drain_fedbuff_partial(
+            k=pipe.fill, current_version=2
+        )
+        stats_union_of_hosts["staleness"] += stats["staleness"]
+        stats_union_of_hosts["discounts"] += stats["discounts"]
+        stats_union_of_hosts["skipped"] += stats["num_skipped_out_of_window"]
+        rows.append(host_partial_row(
+            np.asarray(out), float(stats["num_aggregated"]), FLAT
+        ))
+    u_out, u_live, u_stats = union.drain_fedbuff(
+        k=6, current_version=2, server_lr=1.0
+    )
+    assert sorted(stats_union_of_hosts["staleness"]) == sorted(
+        u_stats["staleness"]
+    )
+    assert sorted(stats_union_of_hosts["discounts"]) == sorted(
+        u_stats["discounts"]
+    )
+    assert stats_union_of_hosts["skipped"] == u_stats[
+        "num_skipped_out_of_window"
+    ] == 1
+    base_dev = jax.device_put(
+        jnp.asarray(versions[2]), replicated_sharding(mesh)
+    )
+    new_dev, tail_dev = fn(assemble_host_rows(mesh, np.stack(rows)), base_dev)
+    assert int(np.asarray(tail_dev)[0]) == u_stats["num_aggregated"] == 5
+    np.testing.assert_allclose(
+        np.asarray(new_dev), np.asarray(u_out), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fused_drained_ingest_program_matches_two_stage():
+    """The single fused program (per-device ingest slabs → host-local reduce →
+    one hosts psum → apply) and the two-stage runtime path (host partial rows
+    → cross-host reduce) are the same function."""
+    from nanofed_tpu.communication.federation import (
+        assemble_host_rows,
+        build_cross_host_reduce,
+        build_drained_ingest_reduce,
+        host_partial_row,
+    )
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from nanofed_tpu.parallel.mesh import CLIENT_AXIS, HOST_AXIS, replicated_sharding
+
+    mesh = _hier_mesh()
+    cap, shards = 4, 8  # 2 hosts x 4 client shards
+    rng = np.random.default_rng(3)
+    buf = rng.normal(size=(shards, cap, FLAT)).astype(np.float32)
+    coefs = np.abs(rng.normal(size=(shards, cap))).astype(np.float32)
+    coefs[0, 1] = 0.0  # an unoccupied slot: exact-zero coefficient
+    base = rng.normal(size=FLAT).astype(np.float32)
+    spec = NamedSharding(mesh, P((HOST_AXIS, CLIENT_AXIS)))
+    fused = build_drained_ingest_reduce(mesh, cap, FLAT)
+    out_fused = fused(
+        jax.device_put(buf, spec), jax.device_put(coefs, spec),
+        jax.device_put(jnp.asarray(base), replicated_sharding(mesh)),
+    )
+    rows = []
+    for h in range(2):
+        shard_slice = slice(h * 4, (h + 1) * 4)
+        num = np.einsum("sc,scp->p", coefs[shard_slice], buf[shard_slice])
+        rows.append(host_partial_row(
+            num, float(coefs[shard_slice].sum()), FLAT
+        ))
+    two_stage = build_cross_host_reduce(mesh, FLAT)
+    out_two, _ = two_stage(
+        assemble_host_rows(mesh, np.stack(rows)),
+        jax.device_put(jnp.asarray(base), replicated_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_fused), np.asarray(out_two), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_ingest_refuses_per_update_mechanisms():
     """validation/robust need individual update trees, which batched ingest
     folds away at submit time — the combination must refuse loudly."""

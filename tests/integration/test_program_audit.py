@@ -1,4 +1,4 @@
-"""Program auditor end to end: the six-variant reference catalog audits clean
+"""Program auditor end to end: the seven-variant reference catalog audits clean
 at the jaxpr/AOT level, every seeded mutant trips exactly its check (no check
 is vacuous), the Coordinator wires audits into strict mode and telemetry, and
 ``metrics-summary`` digests the ``audit`` records into an ``audits`` block."""
@@ -24,6 +24,7 @@ VARIANTS = {
     "fsdp_2d": {"clients", "model"},
     "hier_3axis": {"hosts", "clients", "model"},
     "adapter": {"clients"},
+    "drained_ingest": {"hosts", "clients", "model"},
 }
 
 
@@ -38,7 +39,7 @@ def reports(catalog):
     return {r.program: r for r in catalog.audit_all(compile=True)}
 
 
-def test_all_six_variants_audit_clean(reports):
+def test_all_variants_audit_clean(reports):
     assert set(reports) == set(VARIANTS)
     for name, rep in reports.items():
         assert rep.ok, f"{name}: {[f.render() for f in rep.findings]}"
