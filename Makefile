@@ -1,6 +1,6 @@
 # Parity with the reference's Makefile targets (install/test/lint/format/docs/release).
 
-.PHONY: test test-fast lint lint-fed audit-smoke bench bench-smoke chaos-smoke hostchaos-smoke federation-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
+.PHONY: test test-fast lint lint-fed audit-smoke bench bench-smoke chaos-smoke hostchaos-smoke federation-smoke trace-smoke profile-smoke loadtest-smoke autotune-smoke retune-smoke warm-cache adapter-smoke adapter-evidence fleet-smoke fleet-evidence multihost-smoke multihost-bench tenants-smoke tenants-bench example dryrun dryrun-multichip-2d api-docs notebook accuracy metrics-summary clean
 
 test:
 	python -m pytest tests/ -q
@@ -68,6 +68,22 @@ federation-smoke:
 	  --round-timeout-s 20 --timeout 300 --out-dir /tmp/nanofed_federation_runs
 	python -m nanofed_tpu.cli metrics-summary /tmp/nanofed_multihost/fed_telemetry | \
 	  python -c "import json,sys; d=json.load(sys.stdin); f=d['federations']; assert f['count'] >= 1 and f['zero_lost_submits'], f; print('metrics-summary digests federation OK')"
+
+# Trace smoke (observability.tracing + critical_path): a REAL 2-process
+# federate run with per-host telemetry streams, then `nanofed-tpu trace`
+# merges them — the Chrome timeline must parse non-empty, every accepted
+# submit must resolve to exactly one consuming round (the subcommand's exit
+# code enforces it), and each round's critical-path segments must sum to
+# >= 95% of its measured walltime.
+trace-smoke:
+	python scripts/multihost_harness.py federate --num-processes 2 \
+	  --clients 200 --round-quota 50 --ingest-capacity 512 \
+	  --round-timeout-s 20 --timeout 300 --out-dir /tmp/nanofed_trace_runs \
+	  --telemetry-dir /tmp/nanofed_trace_tel
+	python -m nanofed_tpu.cli trace /tmp/nanofed_trace_tel \
+	  --chrome-out /tmp/nanofed_trace_timeline.json \
+	  > /tmp/nanofed_trace_digest.json
+	python -c "import json; d = json.load(open('/tmp/nanofed_trace_digest.json')); t = json.load(open('/tmp/nanofed_trace_timeline.json')); assert t['traceEvents'], 'empty merged timeline'; r = d['trace_resolution']; assert r['resolved'] and r['consumed_submits'] > 0, r; c = d['coverage']; assert c['min'] >= 0.95, c; print('trace-smoke OK:', r['consumed_submits'], 'submits resolved across', c['rounds'], 'rounds; coverage min', c['min'])"
 
 # Loadtest smoke (nanofed_tpu.loadgen): a ~200-client synthetic swarm on a
 # VirtualClock drives BOTH serving paths — per-submit and batched device

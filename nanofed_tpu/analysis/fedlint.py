@@ -207,6 +207,16 @@ _WALL_CLOCK_CALLS = {
     "datetime.date.today",
 }
 
+#: FED010 allowlist: ``(module, function)`` bodies whose wall-clock reads are
+#: sanctioned.  ``observability.tracing.forensic_now`` is THE forensic-stamp
+#: doorway — one audited ``time.time()`` behind a documented contract
+#: (cross-artifact correlation only, never protocol behavior) — so callers
+#: route through it instead of scattering per-site suppression pragmas, and
+#: the reasoning lives once, here and in that function's docstring.
+_FORENSIC_CLOCK_FUNCS = {
+    ("nanofed_tpu.observability.tracing", "forensic_now"),
+}
+
 _SUPPRESS_RE = re.compile(
     r"#\s*fedlint:\s*(disable|disable-file)\s*=\s*([A-Z0-9,\s]+?)\s*(?:\(([^)]*)\))?\s*$"
 )
@@ -1250,11 +1260,28 @@ def _check_wall_clock(model: _FileModel, out: list[Diagnostic]) -> None:
     """FED010: wall-clock reads in the Clock-injected subsystems."""
     if not model.module.startswith(_CLOCKED_PREFIXES):
         return
+    # Line ranges of this module's allowlisted forensic-clock functions: a
+    # wall-clock call INSIDE one is the sanctioned doorway, not a finding.
+    allowed_names = {
+        fn for mod, fn in _FORENSIC_CLOCK_FUNCS if mod == model.module
+    }
+    allowed_ranges: list[tuple[int, int]] = []
+    if allowed_names:
+        for node in ast.walk(model.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in allowed_names
+            ):
+                allowed_ranges.append(
+                    (node.lineno, node.end_lineno or node.lineno)
+                )
     for node in ast.walk(model.tree):
         if not isinstance(node, ast.Call):
             continue
         name = model.resolve(node.func)
         if name in _WALL_CLOCK_CALLS:
+            if any(lo <= node.lineno <= hi for lo, hi in allowed_ranges):
+                continue
             out.append(Diagnostic(
                 model.path, node.lineno, node.col_offset, "FED010",
                 f"{name}() in {model.module}: this subsystem takes an "

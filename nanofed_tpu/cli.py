@@ -678,6 +678,40 @@ def _cmd_metrics_summary(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """Merge a federation's per-host ``telemetry.jsonl`` streams (observability
+    subsystem) into one clock-aligned story: the per-round critical-path
+    digest on stdout, and — with ``--chrome-out`` — a host-laned Chrome/
+    Perfetto timeline (load it at ui.perfetto.dev or chrome://tracing)."""
+    from pathlib import Path
+
+    from nanofed_tpu.observability import (
+        clock_offsets,
+        federation_timeline,
+        load_host_streams,
+        merge_timeline,
+    )
+
+    root = Path(args.path)
+    streams = load_host_streams(root)
+    if not streams:
+        print(f"error: no telemetry.jsonl streams found under {root} — run "
+              "the federate/hostchaos harness with --telemetry-dir first",
+              file=sys.stderr)
+        return 1
+    if args.chrome_out is not None:
+        timeline = merge_timeline(streams, clock_offsets(streams))
+        out = Path(args.chrome_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(timeline))
+        print(f"# wrote {len(timeline['traceEvents'])} trace events to {out}",
+              file=sys.stderr)
+    digest = federation_timeline(root, include_trace_map=args.trace_map)
+    print(json.dumps(digest, indent=2))
+    resolution = digest.get("trace_resolution") or {}
+    return 0 if resolution.get("resolved", True) else 1
+
+
 def _cmd_audit(args: argparse.Namespace) -> int:
     """Audit the round programs at the jaxpr/AOT level WITHOUT running a
     federation (``analysis.program_audit``): collective-schedule consistency
@@ -1138,6 +1172,28 @@ def main(argv: list[str] | None = None) -> int:
         "for the most recent one (default: runs)",
     )
 
+    trace = sub.add_parser(
+        "trace",
+        help="merge a federation's per-host telemetry.jsonl streams into one "
+        "clock-aligned timeline: per-round critical-path digest + trace "
+        "resolution on stdout, optional Chrome/Perfetto trace file",
+    )
+    trace.add_argument(
+        "path", nargs="?", default="runs",
+        help="the --telemetry-dir of a federate/hostchaos run (per-host "
+        "streams live in host_*/ subdirs; default: runs)",
+    )
+    trace.add_argument(
+        "--chrome-out", default=None, metavar="TRACE.json",
+        help="also write the merged host-laned Chrome trace_event file here "
+        "(open at ui.perfetto.dev or chrome://tracing)",
+    )
+    trace.add_argument(
+        "--trace-map", action="store_true",
+        help="include the full per-trace consumption map in the JSON digest "
+        "(one entry per accepted submit; large)",
+    )
+
     profile = sub.add_parser(
         "profile",
         help="compile the round programs (single step, fused block, SCAFFOLD) "
@@ -1367,6 +1423,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_chaos_plan(args)
     if args.cmd == "metrics-summary":
         return _cmd_metrics_summary(args)
+    if args.cmd == "trace":
+        return _cmd_trace(args)
     if args.cmd == "profile":
         return _cmd_profile(args)
     if args.cmd == "audit":

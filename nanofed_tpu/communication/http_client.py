@@ -34,6 +34,7 @@ from nanofed_tpu.communication.http_server import (
     HEADER_SIGNATURE,
     HEADER_STATUS,
     HEADER_SUBMIT,
+    HEADER_TRACE,
 )
 from nanofed_tpu.communication.retry import (
     RETRYABLE_STATUSES,
@@ -43,6 +44,7 @@ from nanofed_tpu.communication.retry import (
 from nanofed_tpu.core.exceptions import NanoFedError
 from nanofed_tpu.core.types import Params
 from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.observability.tracing import new_trace
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from nanofed_tpu.utils.logger import Logger
 
@@ -349,6 +351,12 @@ class HTTPClient:
             HEADER_ROUND: str(self.current_round),
             HEADER_METRICS: json.dumps(metrics),
             HEADER_SUBMIT: f"{self.client_id}:{self.current_round}:{self._submit_seq}",
+            # Trace context, derived from the same identity as the idempotency
+            # key: retries of this logical submit ride ONE trace, so the round
+            # that finally consumes it resolves every wire attempt at once.
+            HEADER_TRACE: new_trace(
+                self.client_id, self.current_round, self._submit_seq
+            ).header(),
         }
         staged_residual: Params | None = None
         if self.update_encoding in (ENCODING_Q8_DELTA, ENCODING_TOPK8):

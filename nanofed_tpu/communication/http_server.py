@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import json
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Iterable
 
@@ -47,6 +48,7 @@ from nanofed_tpu.communication.transport import (
 )
 from nanofed_tpu.core.types import ModelUpdate, Params
 from nanofed_tpu.observability.registry import MetricsRegistry, get_registry
+from nanofed_tpu.observability.tracing import TraceContext, parse_trace
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from nanofed_tpu.utils.dates import get_current_time
 from nanofed_tpu.utils.logger import Logger
@@ -68,6 +70,7 @@ HEADER_SECAGG = "X-NanoFed-SecAgg"  # "masked" flags a pairwise-masked uint32 pa
 HEADER_ENCODING = "X-NanoFed-Encoding"  # absent/"npz" = full params; "q8-delta" = codec
 HEADER_SUBMIT = "X-NanoFed-Submit"  # idempotency key: one per LOGICAL submit, rides retries
 HEADER_TIER = "X-NanoFed-Tier"  # fleet mode: which DeviceTier this client belongs to
+HEADER_TRACE = "X-NanoFed-Trace"  # W3C-style trace context: 00-<trace>-<span>-<flags>
 
 
 @dataclass(frozen=True)
@@ -108,6 +111,7 @@ class HTTPServer:
         transport: HTTPTransport | None = None,
         tenant: str | None = None,
         fleet: Any | None = None,
+        tracer: Any | None = None,
     ) -> None:
         """``client_keys`` maps client_id -> PEM public key.  With
         ``require_signatures=True`` every update must carry a valid RSA-PSS signature
@@ -184,7 +188,14 @@ class HTTPServer:
         and excludes ``require_signatures`` (signatures cover dense-params
         reconstructions, which tier submits never materialize) and masked
         SecAgg submits (rejected 400 per request).  Untagged requests behave
-        exactly as without a fleet — mixed cohorts are first-class."""
+        exactly as without a fleet — mixed cohorts are first-class.
+
+        ``tracer`` (a ``nanofed_tpu.observability.SpanTracer``, duck-typed)
+        opens a ``submit-decode`` span around each admitted submit's
+        offloaded decode, carrying the request's ``X-NanoFed-Trace`` trace id
+        as an attribute — the wire-to-mesh hop of the distributed-tracing
+        story.  ``tracer=None`` (default) records nothing; tracing is
+        observability, never admission control."""
         if staleness_window < 0:
             raise ValueError("staleness_window must be >= 0")
         if fleet is not None and ingest is None:
@@ -215,6 +226,7 @@ class HTTPServer:
         self._clock = clock or SYSTEM_CLOCK
         self.ingest = ingest
         self.fleet = fleet
+        self._tracer = tracer
         # Built lazily at the first publish_model (the params template fixes
         # the buffer's flat size); every mutation happens under self._lock.
         self._ingest_pipeline: Any | None = None
@@ -761,6 +773,19 @@ class HTTPServer:
             return await self._ingest_pipeline.run_decode(fn, *args, **kwargs)
         return await asyncio.to_thread(fn, *args, **kwargs)
 
+    def _decode_span(
+        self, trace: TraceContext | None, client_id: str, encoding: str
+    ) -> Any:
+        """A ``submit-decode`` span around the offloaded decode when a tracer
+        is wired, tagged with the submit's trace id — the hop that links the
+        wire header to the decode-pool work.  No tracer -> no-op context."""
+        if self._tracer is None:
+            return nullcontext()
+        attrs: dict[str, Any] = {"client": client_id, "encoding": encoding}
+        if trace is not None:
+            attrs["trace"] = trace.trace_id
+        return self._tracer.span("submit-decode", **attrs)
+
     async def _read_body(self, request: web.Request) -> bytes:
         """Read the request body via the transport's bounded-read primitive
         (``client_max_size`` bounds the size): a slowloris peer trickling
@@ -1029,6 +1054,10 @@ class HTTPServer:
                 status=429,
                 headers={"Retry-After": f"{self.retry_after_s:g}"},
             )
+        # Trace context rides along from here: a malformed/absent header is
+        # simply an untraced submit (None) — tracing is observability, never
+        # admission control.
+        trace = parse_trace(request.headers.get(HEADER_TRACE))
         self._inflight += 1
         try:
             if masked:
@@ -1038,7 +1067,7 @@ class HTTPServer:
                 )
             return await self._admitted_submit_update(
                 request, client_id, round_number, metrics, submit_id, fingerprint,
-                tier=tier,
+                tier=tier, trace=trace,
             )
         finally:
             self._inflight -= 1
@@ -1046,7 +1075,7 @@ class HTTPServer:
     async def _admitted_submit_update(
         self, request: web.Request, client_id: str, round_number: int,
         metrics: dict[str, Any], submit_id: str | None, fingerprint: str,
-        tier: str | None = None,
+        tier: str | None = None, trace: TraceContext | None = None,
     ) -> web.StreamResponse:
         """The body of a plain-update submit AFTER admission: the caller holds
         one in-flight slot for the duration (read + decode + verify + buffer)."""
@@ -1128,7 +1157,8 @@ class HTTPServer:
                 def _decode_tier() -> Any:
                     return self.fleet.decode_submit(tier, body, round_number)
 
-                ingest_flat = await self._offload(_decode_tier)
+                with self._decode_span(trace, client_id, encoding):
+                    ingest_flat = await self._offload(_decode_tier)
                 params = None
             elif (
                 self._ingest_pipeline is not None
@@ -1144,10 +1174,12 @@ class HTTPServer:
                     # device dispatch anywhere on this path.
                     return flatten_params(_decode()) - base_flat
 
-                ingest_flat = await self._offload(_decode_flat)
+                with self._decode_span(trace, client_id, encoding):
+                    ingest_flat = await self._offload(_decode_flat)
                 params = None
             else:
-                params = await self._offload(_decode)
+                with self._decode_span(trace, client_id, encoding):
+                    params = await self._offload(_decode)
         except Exception as e:
             self._reject_update("bad_payload")
             if tier is not None:
@@ -1166,6 +1198,7 @@ class HTTPServer:
             return await self._ingest_buffer_update(
                 client_id, round_number, metrics, submit_id, fingerprint,
                 params, base_flat, ingest_flat, tier=tier,
+                trace="" if trace is None else trace.trace_id,
             )
         async with self._lock:
             # Authoritative duplicate re-check: two concurrent attempts of the
@@ -1205,7 +1238,7 @@ class HTTPServer:
         self, client_id: str, round_number: int, metrics: dict[str, Any],
         submit_id: str | None, fingerprint: str, params: Params | None,
         base_flat: Any, flat_delta: Any | None = None,
-        tier: str | None = None,
+        tier: str | None = None, trace: str = "",
     ) -> web.StreamResponse:
         """Batched-ingest tail of an admitted plain submit: flatten the decoded
         params into a delta against the snapshotted base (worker pool — one
@@ -1255,7 +1288,7 @@ class HTTPServer:
                 metrics = dict(metrics, tier=tier)
             slot = self._ingest_pipeline.offer(
                 flat_delta, client_id=client_id, round_number=round_number,
-                metrics=metrics,
+                metrics=metrics, trace=trace,
             )
             if slot is not None:
                 self._record_submit_locked(client_id, submit_id, fingerprint)

@@ -86,6 +86,7 @@ class SlotMeta(NamedTuple):
     weight: float  # FedAvg aggregation weight (client sample count)
     metrics: Mapping[str, Any]
     seq: int  # arrival order — FedBuff drains the K oldest
+    trace: str = ""  # X-NanoFed-Trace trace id; "" when the submit was untraced
 
 
 class DeviceIngestBuffer:
@@ -177,6 +178,7 @@ class DeviceIngestBuffer:
         round_number: int,
         weight: float,
         metrics: Mapping[str, Any] | None = None,
+        trace: str = "",
     ) -> int | None:
         """Write one client's flattened delta into a slot; returns the slot, or
         None when the buffer is FULL (the caller converts that to 429 +
@@ -201,6 +203,7 @@ class DeviceIngestBuffer:
         self._meta[slot] = SlotMeta(
             slot=slot, client_id=client_id, round_number=int(round_number),
             weight=float(weight), metrics=dict(metrics or {}), seq=self._seq,
+            trace=trace,
         )
         self._client_slot[client_id] = slot
         return slot

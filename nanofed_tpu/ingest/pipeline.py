@@ -200,6 +200,7 @@ class IngestPipeline:
         client_id: str,
         round_number: int,
         metrics: Mapping[str, Any] | None = None,
+        trace: str = "",
     ) -> int | None:
         replaced = self.buffer.has_client(client_id)
         slot = self.buffer.offer(
@@ -208,6 +209,7 @@ class IngestPipeline:
             round_number=round_number,
             weight=weight_from_metrics(metrics),
             metrics=metrics or {},
+            trace=trace,
         )
         if slot is None:
             self._m_offers.inc(result="buffer_full")

@@ -40,9 +40,11 @@ from nanofed_tpu.communication.http_server import (
     HEADER_ROUND,
     HEADER_SUBMIT,
     HEADER_TIER,
+    HEADER_TRACE,
 )
 from nanofed_tpu.communication.retry import RetryPolicy, parse_retry_after
 from nanofed_tpu.core.types import Params
+from nanofed_tpu.observability.tracing import new_trace
 from nanofed_tpu.utils.aio import spawn_logged
 from nanofed_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
@@ -354,6 +356,12 @@ async def _submit_once(
                                     f"{client_id}:{submitted_round}"
                                     f":{seq}:{refresh}"
                                 ),
+                                # Same identity as the submit key -> same
+                                # trace across this logical submit's retries,
+                                # and deterministic under the swarm's seed.
+                                HEADER_TRACE: new_trace(
+                                    client_id, submitted_round, seq, refresh
+                                ).header(),
                             }
                             if config.encoding != "npz":
                                 headers[HEADER_ENCODING] = config.encoding

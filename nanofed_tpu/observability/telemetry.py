@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
 import time
@@ -56,9 +57,14 @@ class RunTelemetry:
         self.path = self.run_dir / TELEMETRY_FILENAME
         self.registry = registry or get_registry()
         self._lock = threading.Lock()
-        # Line-buffered append handle: one flushed write per record, so concurrent
-        # writers (the aiohttp loop + worker threads) interleave whole lines only.
-        self._file = self.path.open("a", buffering=1)
+        # O_APPEND fd + ONE os.write per record: the kernel makes each append
+        # atomic at the file offset, so records never interleave mid-line even
+        # when SEVERAL RunTelemetry instances (concurrent tenant engines) share
+        # one telemetry.jsonl — a stdio handle only guarantees whole lines per
+        # HANDLE, and flushes above the buffer size split into multiple writes.
+        self._fd = os.open(
+            str(self.path), os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+        )
         self._closed = False
         self.tracer = SpanTracer(
             registry=self.registry,
@@ -80,7 +86,7 @@ class RunTelemetry:
         with self._lock:
             if self._closed:
                 return
-            self._file.write(line + "\n")
+            os.write(self._fd, (line + "\n").encode("utf-8"))
 
     def close(self) -> None:
         """Append the final registry snapshot and release the file handle.
@@ -93,9 +99,9 @@ class RunTelemetry:
                 {"type": "metrics_snapshot", "t": round(time.time(), 3),
                  "metrics": self.registry.snapshot()}
             )
-            self._file.write(snapshot + "\n")
+            os.write(self._fd, (snapshot + "\n").encode("utf-8"))
             self._closed = True
-            self._file.close()
+            os.close(self._fd)
 
 
 _jax_bridge_installed = False
@@ -179,6 +185,8 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
     spans: dict[str, list[float]] = {}
     rounds: dict[str, int] = {}
     round_durations: list[float] = []
+    segment_durations: dict[str, list[float]] = {}
+    clock_syncs: list[dict[str, Any]] = []
     snapshot: dict[str, Any] | None = None
     program_profiles: dict[str, dict[str, Any]] = {}
     loadtests: dict[str, dict[str, Any]] = {}
@@ -215,6 +223,11 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                 rounds[status] = rounds.get(status, 0) + 1
                 if "duration_s" in rec:
                     round_durations.append(float(rec["duration_s"]))
+                # Critical-path decomposition (observability.critical_path):
+                # federate workers attach per-round segment timings that tile
+                # the round walltime — accumulate per segment for the digest.
+                for seg, v in (rec.get("segments") or {}).items():
+                    segment_durations.setdefault(str(seg), []).append(float(v))
             elif rtype == "metrics_snapshot":
                 snapshot = rec.get("metrics")
             elif rtype == "program_profile":
@@ -281,14 +294,27 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
                     if k in rec
                 })
             elif rtype == "recovery":
-                # One completed elastic recovery: the MTTR evidence record.
+                # One completed elastic recovery: the MTTR evidence record —
+                # since the flight recorder, MTTR arrives decomposed into
+                # named phases (detect/reap/respawn/bring_up/recompile) with
+                # a pointer to the dumped ring.
                 recoveries.append({
                     k: rec[k]
                     for k in (
                         "recovery_s", "resumed_generation", "resumed_round",
                         "rounds_lost", "hosts_before", "hosts_after",
-                        "reshape", "rejoin",
+                        "reshape", "rejoin", "mttr_phases", "flight_recorder",
                     )
+                    if k in rec
+                })
+            elif rtype == "clock_sync":
+                # A federate worker's bring-up-barrier epoch: the wall time at
+                # its warm-psum anchor.  The barrier makes these simultaneous
+                # across hosts, so the spread IS the cross-host clock skew the
+                # timeline merger subtracts.
+                clock_syncs.append({
+                    k: rec[k]
+                    for k in ("host", "anchor_wall", "process_id")
                     if k in rec
                 })
             elif rtype == "tenant":
@@ -425,6 +451,22 @@ def summarize_telemetry(path: str | Path) -> dict[str, Any]:
         out["topology"] = topology
     if round_durations:
         out["round_duration"] = _digest(round_durations)
+    if segment_durations:
+        # Critical-path layer (observability.critical_path): where round
+        # walltime actually goes — wire_wait / decode / drain / collective /
+        # apply / publish, digested per segment across all rounds seen.
+        out["critical_path"] = {
+            seg: _digest(d) for seg, d in sorted(segment_durations.items())
+        }
+    if clock_syncs:
+        walls = sorted(
+            float(c["anchor_wall"]) for c in clock_syncs if "anchor_wall" in c
+        )
+        out["clock_sync"] = {
+            "hosts": len(clock_syncs),
+            **({"anchor_spread_s": round(walls[-1] - walls[0], 6)}
+               if walls else {}),
+        }
     if program_profiles:
         # Compiled-program cost layer (observability.profiling): per-program
         # compiler FLOPs, peak device bytes, and the roofline verdict.

@@ -94,6 +94,12 @@ class RoundLedger:
             if track_dropouts
             else None
         )
+        self._m_critical_path = registry.histogram(
+            "nanofed_round_critical_path_seconds",
+            "Per-round walltime by critical-path segment "
+            "(wire_wait/decode/drain/collective/apply/publish)",
+            labels=("segment",),
+        )
 
     def charge(
         self,
@@ -103,16 +109,31 @@ class RoundLedger:
         duration_s: float,
         expected: int | None = None,
         telemetry_fields: dict[str, Any] | None = None,
+        segments: dict[str, float] | None = None,
     ) -> None:
         """Charge one round outcome: counter by lowercased status, duration
         observation, cohort gauge, dropouts (when tracked and ``expected`` is
-        given), and — when this front has telemetry — the ``round`` record."""
+        given), and — when this front has telemetry — the ``round`` record.
+
+        ``segments`` is the round's critical-path decomposition (segment name
+        -> seconds; the federate worker passes wire_wait/decode/drain/
+        collective/apply/publish, which tile ``duration_s``): each observes
+        ``nanofed_round_critical_path_seconds{segment}`` and the rounded dict
+        rides the ``round`` telemetry record as ``segments``."""
         self._m_rounds.inc(status=str(status).lower())
         self._m_round_duration.observe(duration_s)
         self._m_cohort.set(num_clients)
         if self._m_dropouts is not None and expected is not None:
             self._m_dropouts.inc(max(0, expected - num_clients))
+        if segments:
+            for seg, seconds in segments.items():
+                self._m_critical_path.observe(float(seconds), segment=str(seg))
         if self.telemetry is not None and telemetry_fields is not None:
+            if segments:
+                telemetry_fields = dict(telemetry_fields)
+                telemetry_fields.setdefault("segments", {
+                    str(seg): round(float(v), 6) for seg, v in segments.items()
+                })
             self.telemetry.record("round", **telemetry_fields)
 
     @staticmethod

@@ -76,7 +76,9 @@ MODULES = [
     ("observability", ["nanofed_tpu.observability.registry",
                        "nanofed_tpu.observability.spans",
                        "nanofed_tpu.observability.telemetry",
-                       "nanofed_tpu.observability.profiling"]),
+                       "nanofed_tpu.observability.profiling",
+                       "nanofed_tpu.observability.tracing",
+                       "nanofed_tpu.observability.critical_path"]),
     ("tuning", ["nanofed_tpu.tuning.autotuner",
                 "nanofed_tpu.tuning.epilogues"]),
     ("analysis", ["nanofed_tpu.analysis.fedlint",

@@ -528,11 +528,27 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
     # quiet peer trips it, aborting the fleet mid-campaign with a torn-pair
     # gloo error instead of a clean round.
     anchor = time.monotonic()
+    anchor_wall = time.time()  # forensic: the same instant on the wall clock
     log(f"cross-host reduce compiled on mesh {mesh_shape(mesh)} "
         "(bring-up barrier passed)")
 
     registry = MetricsRegistry()
-    ledger = RoundLedger(registry, track_dropouts=True)
+    telemetry = None
+    if args.telemetry_dir:
+        from nanofed_tpu.observability import RunTelemetry
+
+        # One stream per worker, merged by `nanofed-tpu trace`: the
+        # clock_sync record pins this host's wall clock to the barrier
+        # epoch every host just exited simultaneously — the offsets the
+        # timeline merger subtracts ARE the differences of these stamps.
+        telemetry = RunTelemetry(
+            Path(args.telemetry_dir) / f"host_{host}", registry=registry
+        )
+        telemetry.record(
+            "clock_sync", host=host, anchor_wall=round(anchor_wall, 6),
+            process_id=info["process_index"],
+        )
+    ledger = RoundLedger(registry, telemetry=telemetry, track_dropouts=True)
     required = completion_required(args.round_quota, args.min_completion_rate)
     n_hosts = len(hosts_list)
     progress = Path(args.progress) if args.progress else None
@@ -547,6 +563,7 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
             # accepted but not yet drained.
             staleness_window=max(1, args.staleness_window),
             ingest=IngestConfig(capacity=args.ingest_capacity),
+            tracer=None if telemetry is None else telemetry.tracer,
         )
         await server.start()
         await server.publish_model(to_tree(flat), start_round)
@@ -575,6 +592,11 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
                     await asyncio.sleep(delay)
             hb.beat(round_number=r, status="collecting")
             t_round = time.perf_counter()
+            start_wall = time.time()  # forensic: timeline lane placement
+            pipeline = server._ingest_pipeline
+            decode_before = (
+                pipeline.decode_busy_seconds() if pipeline is not None else 0.0
+            )
             # Shared beat: every host's round-r deadline is the same offset
             # from the warm-psum epoch, and the beat is STRICT — a full
             # quota never dispatches early.  Both halves are load-bearing:
@@ -598,7 +620,21 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
                 if time.monotonic() > deadline:
                     break
                 await asyncio.sleep(0.02)
+            # Critical-path attribution: decode runs on pool threads DURING
+            # this wait, so the beat wait splits into decode (the pool's busy
+            # seconds this round, clamped to the window) and wire_wait (the
+            # remainder — genuinely waiting on the wire).  With the
+            # sequential drain/collective/apply/publish stages below, the six
+            # segments tile the round walltime.
+            wait_measured = time.perf_counter() - t_round
+            decode_busy = (
+                (pipeline.decode_busy_seconds() if pipeline is not None
+                 else 0.0) - decode_before
+            )
+            seg_decode = min(max(0.0, decode_busy), wait_measured)
+            t_drain = time.perf_counter()
             out, mass, metas = await server.drain_ingest_fedavg_partial()
+            seg_drain = time.perf_counter() - t_drain
             want_stop = (
                 (stop_file is not None and stop_file.exists())
                 or (r + 1) >= args.rounds
@@ -609,16 +645,24 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
             )
             hb.beat(round_number=r, status="dispatch")
 
+            dispatch_t: dict = {}
+
             def dispatch(row=row, base=base):
                 # One collective, nothing else on the wire: the psum'd row
                 # comes back and the FedAvg apply happens in numpy — bitwise
                 # identical on every host (ring all-reduce results are
                 # rank-identical), so no broadcast/materialization stream
-                # ever coexists with the psum.
+                # ever coexists with the psum.  Timed in two marks: the
+                # blocked collective vs the host-side FedAvg apply.
+                t0 = time.perf_counter()
                 total_dev = psum_fn(assemble_host_rows(mesh, row))
                 jax.block_until_ready(total_dev)
-                return apply_summed_row(base, np.asarray(total_dev),
-                                        flat_size)
+                t1 = time.perf_counter()
+                applied = apply_summed_row(base, np.asarray(total_dev),
+                                           flat_size)
+                dispatch_t["collective"] = t1 - t0
+                dispatch_t["apply"] = time.perf_counter() - t1
+                return applied
 
             try:
                 # Executor thread: the event loop — and with it the wire
@@ -644,7 +688,6 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
                 os._exit(PEER_FAILURE_RC)
             global_mass = float(tail[0])
             stop_votes = float(tail[1])
-            dt = time.perf_counter() - t_round
             if global_mass > 0.0:
                 base = new_flat
                 # Strict-beat pacing means the quota no longer gates WHEN a
@@ -664,13 +707,43 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
             rerouted_total += rerouted
             clients_seen.update(str(m.client_id) for m in metas)
             sentinel = want_stop and not metas and global_mass <= 0.0
+            round_r = r
+            r += 1
+            # Publish BEFORE charging the beat: the publish is the round's
+            # last critical-path segment, so the charged walltime (and the
+            # segments that tile it) must include it.
+            t_publish = time.perf_counter()
+            await server.publish_model(to_tree(base), r)
+            seg_publish = time.perf_counter() - t_publish
+            dt = time.perf_counter() - t_round
+            hb.beat(round_number=r, status="running")
             if not sentinel:
+                segments = {
+                    "wire_wait": max(0.0, wait_measured - seg_decode),
+                    "decode": seg_decode,
+                    "drain": seg_drain,
+                    "collective": dispatch_t.get("collective", 0.0),
+                    "apply": dispatch_t.get("apply", 0.0),
+                    "publish": seg_publish,
+                }
                 ledger.charge(
                     status=status, num_clients=len(metas), duration_s=dt,
-                    expected=args.round_quota,
+                    expected=args.round_quota, segments=segments,
+                    telemetry_fields={
+                        "round": round_r, "host": host, "status": status,
+                        "duration_s": round(dt, 6),
+                        "start_wall": round(start_wall, 6),
+                        "drained": len(metas),
+                        "mass": round(float(mass), 3),
+                        "rerouted_in": rerouted,
+                        # Every consumed submit's trace id — the join key the
+                        # trace resolver uses to link wire submits to the
+                        # round that consumed them ("" = untraced submit).
+                        "traces": [m.trace for m in metas],
+                    },
                 )
                 rounds_meta.append({
-                    "round": r, "drained": len(metas),
+                    "round": round_r, "drained": len(metas),
                     "mass": round(float(mass), 3),
                     "global_mass": round(global_mass, 3),
                     "rerouted_in": rerouted,
@@ -679,18 +752,15 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
                 if progress is not None:
                     with progress.open("a") as f:
                         f.write(json.dumps({
-                            "round": r, "drained": len(metas),
+                            "round": round_r, "drained": len(metas),
                             "mass": round(float(mass), 3),
                             "rerouted_in": rerouted,
                             "duration_s": round(dt, 4),
                             "wall_t": time.time(),
                         }) + "\n")
-                log(f"round {r}: drained {len(metas)} (mass {mass:.1f}, "
-                    f"{rerouted} rerouted in) global mass "
+                log(f"round {round_r}: drained {len(metas)} "
+                    f"(mass {mass:.1f}, {rerouted} rerouted in) global mass "
                     f"{global_mass:.1f} [{status}] {dt:.2f}s")
-            r += 1
-            await server.publish_model(to_tree(base), r)
-            hb.beat(round_number=r, status="running")
             if r % args.block_size == 0 and not sentinel:
                 store.commit(r // args.block_size, r, to_tree(base), {},
                              hosts=hosts_list)
@@ -724,6 +794,8 @@ def _federate_worker(args: argparse.Namespace, info: dict, log) -> int:
             },
         }
         await server.stop()
+        if telemetry is not None:
+            telemetry.close()  # appends the final metrics_snapshot record
         return result
 
     result = asyncio.run(_serve())
@@ -1009,6 +1081,11 @@ def run_hostchaos(args: argparse.Namespace) -> int:
     zero orphans)."""
     from nanofed_tpu.faults.plan import FaultPlan
     from nanofed_tpu.observability.telemetry import RunTelemetry
+    from nanofed_tpu.observability.tracing import (
+        FLIGHT_RECORDER_FILENAME,
+        FlightRecorder,
+        mttr_decomposition,
+    )
     from nanofed_tpu.parallel.resilience import (
         HostMonitor,
         no_orphans,
@@ -1082,6 +1159,10 @@ def run_hostchaos(args: argparse.Namespace) -> int:
         telemetry_dir = Path(args.telemetry_dir)
         telemetry_dir.mkdir(parents=True, exist_ok=True)
     tel = RunTelemetry(telemetry_dir)
+    # Bounded crash forensics: marks accumulate in-process and are dumped at
+    # the reap — dump() create-if-missing and never raises, so a forensics
+    # failure can never abort the recovery it is documenting.
+    recorder = FlightRecorder(name="hostchaos-supervisor")
     all_pids: list[int] = []
     t0 = time.time()
     hosts = list(range(P))
@@ -1191,6 +1272,7 @@ def run_hostchaos(args: argparse.Namespace) -> int:
         if victim is None:
             time.sleep(0.2)
     t_detect = time.time()
+    recorder.note("kill_detected", host=victim, fault=kind)
     victim_hb = hb_a / f"host_{victim}.hb.json"
     last_beat_wall = None
     victim_round = None
@@ -1207,6 +1289,11 @@ def run_hostchaos(args: argparse.Namespace) -> int:
     # an orphan blocked in gloo would hold the rendezvous port forever.
     # (Every detection path above already counted the failure by kind.)
     _reap(procs)
+    recorder.note("reaped", victim=victim, fault=kind)
+    dump_path = recorder.dump(
+        telemetry_dir / FLIGHT_RECORDER_FILENAME,
+        extra={"victim": victim, "kind": kind},
+    )
     plan_round = next(
         (e.round for e in host_events if e.host == victim), victim_round
     )
@@ -1242,20 +1329,55 @@ def run_hostchaos(args: argparse.Namespace) -> int:
         progress=progress_c,
     )
     all_pids += [p.pid for p in procs]
+    respawn_mark = recorder.note("respawned", hosts=survivors)
     _wait(procs, args.timeout)
+    # S2: the telemetry dir (and the supervisor's stream in it) must survive
+    # the crash + reap — a recovery drill whose evidence vanished proves
+    # nothing.
+    assert telemetry_dir.exists() and tel.path.exists(), (
+        f"telemetry did not survive the worker crash: dir={telemetry_dir} "
+        f"stream={tel.path}"
+    )
     recovered = json.loads((tmp / "hc_c.json").read_text())
     prog_c = _read_progress(progress_c)
     if not prog_c:
         raise SystemExit("hostchaos: recovered run reported no rounds")
     mttr_s = round(prog_c[0]["wall_t"] - t_detect, 3)
     metrics["recovery_seconds"].observe(mttr_s)
+    # Retroactive mark: map the first post-recovery round's wall clock onto
+    # the recorder's monotonic axis via the respawn mark (both clocks were
+    # read in this process).
+    recorder.note(
+        "first_progress", wall=round(prog_c[0]["wall_t"], 6),
+        t_mono=round(
+            respawn_mark["t_mono"]
+            + max(0.0, prog_c[0]["wall_t"] - respawn_mark["t_wall"]),
+            6,
+        ),
+    )
+    mttr_phases = mttr_decomposition(recorder.snapshot(), [
+        ("kill_detected", None),
+        ("reaped", "reap"),
+        ("respawned", "respawn"),
+        ("first_progress", "recompile"),
+    ])
+    if detection_s is not None:
+        # Detection is measured from the victim's LAST heartbeat, which
+        # predates every recorder mark — prepend it rather than difference it.
+        mttr_phases = {"detect": detection_s, **mttr_phases}
+    recorder.dump(
+        telemetry_dir / FLIGHT_RECORDER_FILENAME,
+        extra={"victim": victim, "kind": kind, "mttr_phases": mttr_phases},
+    )
     print(f"# mesh re-formed over hosts {survivors}: first post-recovery "
-          f"round done {mttr_s}s after detection (MTTR)", flush=True)
+          f"round done {mttr_s}s after detection (MTTR: {mttr_phases})",
+          flush=True)
     tel.record(
         "recovery", recovery_s=mttr_s, resumed_generation=resumed_gen,
         resumed_round=resumed_round, rounds_lost=rounds_lost,
         hosts_before=P, hosts_after=len(survivors), reshape=True,
-        rejoin=False,
+        rejoin=False, mttr_phases=mttr_phases,
+        flight_recorder=None if dump_path is None else str(dump_path),
     )
 
     # ---- phase D (optional): the failed host rejoins at a generation
@@ -1406,6 +1528,7 @@ def _spawn_federate(
     plan_path: Path | None,
     stop_file: Path,
     tmp: Path,
+    telemetry_dir: Path | None = None,
 ) -> list[subprocess.Popen]:
     """One federate worker per LOGICAL host id (dense process ids per phase,
     stable host ids across the kill — same convention as hostchaos).  Every
@@ -1446,6 +1569,10 @@ def _spawn_federate(
             cmd += ["--resume"]
         if plan_path is not None:
             cmd += ["--fault-plan", str(plan_path)]
+        if telemetry_dir is not None:
+            # Each worker appends its own stream under host_<h>/ — one
+            # telemetry.jsonl per process, merged by `nanofed-tpu trace`.
+            cmd += ["--telemetry-dir", str(telemetry_dir)]
         procs.append(subprocess.Popen(cmd, env=_worker_env(args, pid)))
     return procs
 
@@ -1467,7 +1594,13 @@ def run_federate(args: argparse.Namespace) -> int:
     from nanofed_tpu.communication.retry import RetryPolicy
     from nanofed_tpu.faults.plan import FaultEvent, FaultPlan
     from nanofed_tpu.loadgen.swarm import SwarmConfig, latency_digest, run_swarm
+    from nanofed_tpu.observability.critical_path import federation_timeline
     from nanofed_tpu.observability.telemetry import RunTelemetry
+    from nanofed_tpu.observability.tracing import (
+        FLIGHT_RECORDER_FILENAME,
+        FlightRecorder,
+        mttr_decomposition,
+    )
     from nanofed_tpu.parallel.resilience import no_orphans
     from nanofed_tpu.persistence import GenerationStore
     from nanofed_tpu.utils.clock import VirtualClock
@@ -1518,6 +1651,13 @@ def run_federate(args: argparse.Namespace) -> int:
     else:
         telemetry_dir = Path(args.telemetry_dir)
         telemetry_dir.mkdir(parents=True, exist_ok=True)
+
+    # Crash flight recorder: every supervisor lifecycle mark lands in this
+    # bounded ring; on reaping a crashed host the ring dumps next to the
+    # telemetry (dump() creates missing dirs and never raises — a forensics
+    # failure must not break the recovery it documents), and the marks
+    # decompose the recovery's MTTR into named phases.
+    recorder = FlightRecorder(name="federate-supervisor")
 
     all_pids: list[int] = []
     t0 = time.time()
@@ -1571,6 +1711,7 @@ def run_federate(args: argparse.Namespace) -> int:
                     if rc == HOST_CRASH_RC and expect_kill and h == victim:
                         if state["t_kill"] is None:
                             state["t_kill"] = time.time()
+                            recorder.note("kill_detected", host=h, rc=rc)
                             print(f"# host {h} killed by plan (rc={rc}); "
                                   "wire clients rerouting to survivors for "
                                   f"{args.reroute_grace:.1f}s", flush=True)
@@ -1589,6 +1730,8 @@ def run_federate(args: argparse.Namespace) -> int:
                 ):
                     # Reroutes demonstrated live; the remaining population
                     # re-drives against the recovered mesh in phase C.
+                    recorder.note("grace_elapsed",
+                                  grace_s=args.reroute_grace)
                     stop_event.set()
                     return
                 if all(rc is not None for rc in rcs):
@@ -1637,9 +1780,12 @@ def run_federate(args: argparse.Namespace) -> int:
     procs = _spawn_federate(
         args, hosts, args.port, phase="a", hb_dir=hb_dir, ckpt_dir=ckpt,
         resume=False, plan_path=plan_path, stop_file=stop_file, tmp=tmp,
+        telemetry_dir=telemetry_dir,
     )
     all_pids += [p.pid for p in procs]
+    recorder.note("spawned", phase="a", hosts=hosts)
     _wait_ready(procs, hosts)
+    recorder.note("fleet_ready", phase="a", hosts=hosts)
     print("# all listeners ready; releasing the swarm", flush=True)
 
     jobs_a = [
@@ -1671,6 +1817,14 @@ def run_federate(args: argparse.Namespace) -> int:
         # The survivors are blocked in a psum the dead victim will never
         # join: phase A is over for them.  Reap and re-form.
         _reap(procs)
+        recorder.note("reaped", victim=victim, phase="a")
+        # Dump the ring NEXT TO the telemetry the moment the crashed host is
+        # reaped: dump() creates missing parents and never raises, so this
+        # cannot break the recovery it documents.
+        dump_path = recorder.dump(
+            telemetry_dir / FLIGHT_RECORDER_FILENAME,
+            extra={"victim": victim, "kill_round": args.kill_round},
+        )
         survivors = [h for h in hosts if h != victim]
         rec = GenerationStore(ckpt).latest_complete()
         resumed_round = rec.round_number if rec is not None else 0
@@ -1681,6 +1835,7 @@ def run_federate(args: argparse.Namespace) -> int:
             "resumed_generation": rec.generation if rec is not None else None,
             "resumed_round": resumed_round,
             "hosts_after": len(survivors),
+            "flight_recorder": None if dump_path is None else str(dump_path),
         }
         print(f"# phase C: re-forming over hosts {survivors}, resuming at "
               f"round {resumed_round}; re-driving the dead host's "
@@ -1691,10 +1846,12 @@ def run_federate(args: argparse.Namespace) -> int:
         procs = _spawn_federate(
             args, survivors, args.port + 7, phase="c", hb_dir=hb_dir,
             ckpt_dir=ckpt, resume=True, plan_path=None, stop_file=stop_file,
-            tmp=tmp,
+            tmp=tmp, telemetry_dir=telemetry_dir,
         )
         all_pids += [p.pid for p in procs]
+        recorder.note("respawned", phase="c", hosts=survivors)
         _wait_ready(procs, survivors)
+        ready_mark = recorder.note("ready", phase="c", hosts=survivors)
 
         surv_urls = [urls[h] for h in survivors]
         # The victim's whole population re-drives against the survivors: its
@@ -1760,6 +1917,42 @@ def run_federate(args: argparse.Namespace) -> int:
                 prev.completed_indices += res.completed_indices
         stop_file.write_text("stop\n")
         _wait(procs, args.timeout)
+        # MTTR decomposition: "recompile" ends at the recovered fleet's first
+        # drained round.  That mark is only observable from the phase-C
+        # progress streams after the fact, so it is noted retroactively —
+        # its wall stamp mapped onto the monotonic axis via the ready mark.
+        first_wall = None
+        for h in survivors:
+            lines = _read_progress(tmp / f"fed_progress_c_h{h}.jsonl")
+            if lines:
+                w = lines[0].get("wall_t")
+                if w is not None and (first_wall is None or w < first_wall):
+                    first_wall = float(w)
+        if first_wall is not None:
+            recorder.note(
+                "first_progress", wall=round(first_wall, 6),
+                t_mono=round(
+                    ready_mark["t_mono"]
+                    + max(0.0, first_wall - ready_mark["t_wall"]), 6,
+                ),
+            )
+        mttr_phases = mttr_decomposition(recorder.snapshot(), [
+            ("kill_detected", None),
+            ("grace_elapsed", "reroute_grace"),
+            ("reaped", "reap"),
+            ("respawned", "respawn"),
+            ("ready", "bring_up"),
+            ("first_progress", "recompile"),
+        ])
+        recovery["mttr_phases"] = mttr_phases
+        recovery["recovery_s"] = round(sum(mttr_phases.values()), 3)
+        # Re-dump with the recovery marks included: the reap-time dump froze
+        # the crash context; this one appends the phases that followed.
+        recorder.dump(
+            telemetry_dir / FLIGHT_RECORDER_FILENAME,
+            extra={"victim": victim, "kill_round": args.kill_round,
+                   "mttr_phases": mttr_phases},
+        )
 
     # ---- accounting + assertions ------------------------------------------
     all_results = list(swarm_a.values()) + list(results_c.values())
@@ -1813,6 +2006,21 @@ def run_federate(args: argparse.Namespace) -> int:
             "no rerouted client's update was ever drained by another host"
         )
     assert not orphans, f"orphan worker processes survived the run: {orphans}"
+    if kill:
+        # The telemetry dir — including the dead host's stream — must
+        # survive a worker crash: the merged timeline is exactly the
+        # artifact a post-mortem needs, so losing it to the reap path
+        # would defeat the flight recorder's purpose.
+        worker_streams = list(telemetry_dir.glob("host_*/telemetry.jsonl"))
+        assert telemetry_dir.exists() and len(worker_streams) >= P, (
+            f"telemetry did not survive the crash: {telemetry_dir} has "
+            f"{len(worker_streams)} worker streams, expected >= {P}"
+        )
+
+    # Merged-timeline digest (clock-aligned at the bring-up-barrier epoch):
+    # the per-round critical-path table and the submit->round trace
+    # resolution ride the artifact — the evidence a reader checks first.
+    timeline = federation_timeline(telemetry_dir)
 
     artifact = {
         "record_type": "federation",
@@ -1855,6 +2063,12 @@ def run_federate(args: argparse.Namespace) -> int:
             {"plan": json.loads(plan.to_json()), **recovery}
             if kill else None
         ),
+        "critical_path": {
+            "rounds": timeline["rounds"],
+            "segments": timeline.get("segments"),
+            "coverage": timeline.get("coverage"),
+        },
+        "trace_resolution": timeline["trace_resolution"],
         "zero_lost_submits": True,
         "orphans": orphans,
         "platform": "cpu",
@@ -1891,6 +2105,12 @@ def run_federate(args: argparse.Namespace) -> int:
         host_killed=victim if kill else None,
         kill_round=args.kill_round,
     )
+    if kill:
+        tel.record(
+            "host_failure", kind="host_crash", host=victim,
+            round=args.kill_round,
+        )
+        tel.record("recovery", **recovery)
     tel.close()
 
     out_dir = Path(args.out_dir)
@@ -1902,6 +2122,8 @@ def run_federate(args: argparse.Namespace) -> int:
     print(f"# artifact written to {path}")
     print(f"# telemetry: {telemetry_dir} (digest: python -m nanofed_tpu.cli "
           f"metrics-summary {telemetry_dir})")
+    print(f"# merged timeline: python -m nanofed_tpu.cli trace "
+          f"{telemetry_dir} --chrome-out /tmp/nanofed_timeline.json")
     print(f"federate OK: {args.clients} wire clients over {P} hosts, "
           f"{len(progress_lines)} drained rounds, p99 submit "
           f"{digest['p99_s']}s, {reroutes} reroutes, zero lost submits")

@@ -106,6 +106,34 @@ def test_offer_full_returns_none_and_slots_recycle():
                                rtol=1e-4, atol=1e-6)
 
 
+def test_offer_trace_rides_slot_meta_into_drain():
+    """The X-NanoFed-Trace trace id offered with a submit must come back on
+    the drained SlotMeta (how a round names the submits it consumed) — and a
+    latest-wins replacement must replace the trace with it."""
+    params = _params()
+    base = flatten_params(params)
+    buf = DeviceIngestBuffer(params, capacity=4)
+    d0, d1, d2 = _deltas(3)
+    buf.offer(d0, client_id="c0", round_number=0, weight=1.0, trace="aa" * 16)
+    buf.offer(d1, client_id="c1", round_number=0, weight=1.0)  # untraced
+    buf.offer(d2, client_id="c0", round_number=0, weight=1.0, trace="bb" * 16)
+    _, metas = buf.drain_fedavg(base)
+    assert {m.client_id: m.trace for m in metas} == {
+        "c0": "bb" * 16, "c1": "",
+    }
+
+
+def test_pipeline_offer_forwards_trace():
+    params = _params()
+    pipe = IngestPipeline(params, IngestConfig(capacity=4, batch_size=4),
+                          registry=MetricsRegistry())
+    (d,) = _deltas(1, size=flatten_params(params).size)
+    assert pipe.offer(d, client_id="c0", round_number=0,
+                      metrics={"num_samples": 2}, trace="cd" * 16) is not None
+    _, _, metas = pipe.drain_fedavg_partial()
+    assert [m.trace for m in metas] == ["cd" * 16]
+
+
 def test_clear_frees_everything():
     params = _params()
     buf = DeviceIngestBuffer(params, capacity=4)

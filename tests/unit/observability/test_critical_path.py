@@ -1,0 +1,153 @@
+"""Merged federation timelines (observability.critical_path): stream loading,
+clock alignment at the bring-up barrier, the Chrome timeline, per-round
+critical-path coverage, and trace resolution — all on synthetic streams."""
+
+import json
+
+import pytest
+
+from nanofed_tpu.observability import (
+    clock_offsets,
+    critical_path_rounds,
+    federation_timeline,
+    load_host_streams,
+    merge_timeline,
+    resolve_traces,
+    segment_digest,
+    summarize_telemetry,
+)
+
+
+def _write(path, records):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _round(host, rnd, start, dur, traces, scale=1.0):
+    # Segments tile `scale` of the duration, split 50/10/15/10/10/5 percent.
+    split = (0.50, 0.10, 0.15, 0.10, 0.10, 0.05)
+    names = ("wire_wait", "decode", "drain", "collective", "apply", "publish")
+    return {
+        "type": "round", "host": host, "round": rnd, "status": "COMPLETED",
+        "duration_s": dur, "start_wall": start, "drained": len(traces),
+        "segments": {n: round(dur * scale * f, 6) for n, f in zip(names, split)},
+        "traces": traces,
+    }
+
+
+@pytest.fixture
+def telemetry_dir(tmp_path):
+    """Two workers with a 0.5s clock skew plus the supervisor's stream."""
+    _write(tmp_path / "telemetry.jsonl", [
+        {"type": "host_failure", "kind": "host_crash", "host": 1, "round": 1},
+        {"type": "recovery", "recovery_s": 2.5,
+         "mttr_phases": {"reap": 0.5, "respawn": 1.0, "recompile": 1.0}},
+    ])
+    _write(tmp_path / "host_0" / "telemetry.jsonl", [
+        {"type": "clock_sync", "host": 0, "anchor_wall": 1000.0,
+         "process_id": 0},
+        _round(0, 0, 1000.2, 1.0, ["aa" * 16, "bb" * 16]),
+        _round(0, 1, 1001.2, 1.0, ["cc" * 16], scale=0.96),
+        {"type": "span", "name": "submit-decode", "start_unix": 1000.4,
+         "duration_s": 0.05, "attrs": {"trace": "aa" * 16}},
+    ])
+    _write(tmp_path / "host_1" / "telemetry.jsonl", [
+        {"type": "clock_sync", "host": 1, "anchor_wall": 1000.5,
+         "process_id": 1},
+        _round(1, 0, 1000.7, 1.0, ["dd" * 16]),
+    ])
+    return tmp_path
+
+
+def test_load_host_streams_labels_and_torn_lines(telemetry_dir):
+    (telemetry_dir / "host_1" / "telemetry.jsonl").open("a").write(
+        '{"type": "round", "torn'  # crashed writer's tail
+    )
+    streams = load_host_streams(telemetry_dir)
+    assert set(streams) == {".", "host_0", "host_1"}
+    assert len(streams["host_1"]) == 2  # the torn line is skipped, not fatal
+    # A single file loads as the "." stream.
+    only = load_host_streams(telemetry_dir / "host_0" / "telemetry.jsonl")
+    assert set(only) == {"."} and len(only["."]) == 4
+
+
+def test_clock_offsets_pin_the_barrier(telemetry_dir):
+    streams = load_host_streams(telemetry_dir)
+    offsets = clock_offsets(streams)
+    # host_0 is the reference (lowest labelled stream with a clock_sync);
+    # host_1's clock runs 0.5s ahead, so 0.5s is SUBTRACTED from its stamps.
+    assert offsets == {".": 0.0, "host_0": 0.0, "host_1": -0.5}
+
+
+def test_merge_timeline_lanes_and_alignment(telemetry_dir):
+    streams = load_host_streams(telemetry_dir)
+    doc = merge_timeline(streams, clock_offsets(streams))
+    events = doc["traceEvents"]
+    pids = {e["pid"] for e in events}
+    assert pids == {0, 1, 1000}  # two worker lanes + the supervisor lane
+    rounds = [e for e in events if e["ph"] == "X" and e.get("tid") == 0]
+    segments = [e for e in events if e.get("tid") == 1]
+    decodes = [e for e in events if e.get("tid") == 2]
+    spans = [e for e in events if e.get("tid") == 3]
+    assert len(rounds) == 3 and len(decodes) == 3 and len(spans) == 1
+    # Both hosts' round 0 started 0.2s after their shared barrier: after
+    # alignment the two beats coincide on the timeline.
+    r0 = {e["pid"]: e["ts"] for e in rounds if e["args"]["round"] == 0}
+    assert r0[1] == pytest.approx(r0[0])
+    # Sequential segments tile each beat contiguously (decode is an overlay).
+    host0_r0 = sorted((e for e in segments
+                       if e["pid"] == 0 and e["args"]["round"] == 0),
+                      key=lambda e: e["ts"])
+    for prev, nxt in zip(host0_r0, host0_r0[1:]):
+        assert nxt["ts"] == pytest.approx(prev["ts"] + prev["dur"])
+
+
+def test_critical_path_rounds_coverage(telemetry_dir):
+    rows = critical_path_rounds(load_host_streams(telemetry_dir))
+    assert [(r["host"], r["round"]) for r in rows] == [(0, 0), (1, 0), (0, 1)]
+    assert rows[0]["coverage"] == pytest.approx(1.0)
+    assert rows[2]["coverage"] == pytest.approx(0.96)  # the scaled round
+    digest = segment_digest(rows)
+    assert set(digest["segments"]) == {
+        "wire_wait", "decode", "drain", "collective", "apply", "publish",
+    }
+    assert digest["coverage"]["rounds"] == 3
+    assert digest["coverage"]["min"] == pytest.approx(0.96)
+
+
+def test_resolve_traces_healthy_and_degraded(telemetry_dir):
+    streams = load_host_streams(telemetry_dir)
+    res = resolve_traces(streams)
+    assert res["consumed_submits"] == 4
+    assert res["unique_traces"] == 4
+    assert res["untraced"] == 0 and res["multi_consumed"] == {}
+    assert res["resolved"] is True
+    assert res["by_trace"]["cc" * 16] == {"host": 0, "round": 1}
+    # An untraced submit or a double consumption breaks resolution.
+    streams["host_1"].append(_round(1, 1, 1001.7, 1.0, ["", "aa" * 16]))
+    res = resolve_traces(streams)
+    assert res["untraced"] == 1
+    assert res["multi_consumed_count"] == 1
+    assert res["resolved"] is False
+
+
+def test_federation_timeline_digest(telemetry_dir):
+    digest = federation_timeline(telemetry_dir)
+    assert digest["streams"]["host_1"]["clock_offset_s"] == -0.5
+    assert len(digest["rounds"]) == 3
+    assert digest["coverage"]["min"] >= 0.95  # the acceptance bar
+    assert digest["trace_resolution"]["resolved"] is True
+    assert "by_trace" not in digest["trace_resolution"]  # withheld by default
+    assert digest["recoveries"][0]["mttr_phases"]["reap"] == 0.5
+    assert digest["host_failures"][0]["kind"] == "host_crash"
+    with_map = federation_timeline(telemetry_dir, include_trace_map=True)
+    assert len(with_map["trace_resolution"]["by_trace"]) == 4
+
+
+def test_summarize_telemetry_digests_segments_and_clock_sync(telemetry_dir):
+    summary = summarize_telemetry(telemetry_dir / "host_0" / "telemetry.jsonl")
+    assert summary["critical_path"]["wire_wait"]["count"] == 2
+    assert summary["critical_path"]["publish"]["total_s"] == pytest.approx(
+        0.05 + 0.048
+    )
+    assert summary["clock_sync"] == {"hosts": 1, "anchor_spread_s": 0.0}

@@ -1,0 +1,90 @@
+"""S3 boundary semantics: SpanTracer nesting across ``asyncio.to_thread``
+(the HTTP server's decode-offload shape) and the Prometheus label-escape
+round trip in the registry's text exposition."""
+
+import asyncio
+import re
+import threading
+
+from nanofed_tpu.observability import MetricsRegistry, SpanTracer
+
+
+def test_span_nesting_across_to_thread_boundary():
+    """The server wraps its decode offload in a span on a POOL thread while
+    the handler's own span stays open on the event-loop thread.  The stacks
+    are thread-local: the pool-side span must come out a ROOT (depth 0, no
+    parent), not a child of the handler span — cross-thread parentage would
+    fabricate a nesting the scheduler never guaranteed."""
+    tracer = SpanTracer(registry=False, annotate_device=False)
+
+    def decode():
+        with tracer.span("submit-decode", trace="ab" * 16):
+            with tracer.span("unpack"):
+                pass
+        return threading.get_ident()
+
+    async def handler():
+        with tracer.span("handle-submit"):
+            return await asyncio.to_thread(decode)
+
+    pool_tid = asyncio.run(handler())
+    records = {r.name: r for r in tracer.records}
+    assert records["handle-submit"].depth == 0
+    assert records["submit-decode"].depth == 0
+    assert records["submit-decode"].parent_id is None
+    assert records["submit-decode"].thread_id == pool_tid
+    assert records["submit-decode"].attrs == {"trace": "ab" * 16}
+    # WITHIN the pool thread, nesting still works normally.
+    assert records["unpack"].depth == 1
+    assert records["unpack"].parent_id == records["submit-decode"].span_id
+    # The handler span stayed open across the await and closed last.
+    assert records["handle-submit"].duration_s >= records["submit-decode"].duration_s
+
+
+def test_span_stack_isolated_per_thread_after_boundary():
+    """A span left open on one thread must not leak parentage into spans
+    opened on another thread afterwards (the pool thread is reused)."""
+    tracer = SpanTracer(registry=False, annotate_device=False)
+
+    async def run():
+        with tracer.span("outer"):
+            await asyncio.to_thread(lambda: tracer.span("first").__enter__())
+        # Same process, new to_thread hop: the leaked-open "first" span lives
+        # on the POOL thread's stack, so a main-thread span is unaffected.
+        with tracer.span("after"):
+            pass
+
+    asyncio.run(run())
+    after = next(r for r in tracer.records if r.name == "after")
+    assert after.depth == 0 and after.parent_id is None
+
+
+def _unescape_label(value: str) -> str:
+    """Inverse of the Prometheus text-format escaping (backslash, quote,
+    newline) — what a scraper applies when parsing the exposition."""
+    out, i = [], 0
+    while i < len(value):
+        if value[i] == "\\" and i + 1 < len(value):
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[value[i + 1]])
+            i += 2
+        else:
+            out.append(value[i])
+            i += 1
+    return "".join(out)
+
+
+def test_histogram_label_escape_round_trip():
+    reg = MetricsRegistry()
+    h = reg.histogram("nanofed_test_seconds", "escape test", labels=("name",))
+    hostile = 'quote " backslash \\ newline \n tab \t done'
+    h.observe(0.5, name=hostile)
+    lines = h.collect()
+    # Every rendered line stays single-line (the newline was escaped) ...
+    assert all("\n" not in line for line in lines)
+    count_line = next(line for line in lines
+                      if line.startswith("nanofed_test_seconds_count"))
+    rendered = re.search(r'name="((?:[^"\\]|\\.)*)"', count_line).group(1)
+    # ... and a conforming scraper recovers the exact original value.
+    assert rendered != hostile
+    assert _unescape_label(rendered) == hostile
+    assert h.sample_count(name=hostile) == 1

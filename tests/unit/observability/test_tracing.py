@@ -1,0 +1,116 @@
+"""Trace-context wire format + the crash flight recorder
+(observability.tracing): derived ids, lenient parsing, the bounded ring,
+dump-never-raises, and the MTTR phase decomposition."""
+
+import json
+
+from nanofed_tpu.observability import (
+    FlightRecorder,
+    TraceContext,
+    mttr_decomposition,
+    new_trace,
+    parse_trace,
+)
+from nanofed_tpu.observability.tracing import TRACE_VERSION
+
+
+def test_header_round_trip():
+    ctx = new_trace("client-7", 3, 0)
+    header = ctx.header()
+    version, trace_id, span_id, flags = header.split("-")
+    assert version == TRACE_VERSION
+    assert len(trace_id) == 32 and len(span_id) == 16 and flags == "01"
+    parsed = parse_trace(header)
+    assert parsed == ctx
+
+
+def test_trace_ids_are_derived_not_drawn():
+    # Retries of one logical submit share ONE trace (the idempotency contract
+    # in trace form); a different submit sequence is a different trace.
+    assert new_trace("c0", 5, 2) == new_trace("c0", 5, 2)
+    assert new_trace("c0", 5, 2).trace_id != new_trace("c0", 5, 3).trace_id
+    # The unit separator keeps part boundaries significant.
+    assert new_trace("ab", "c").trace_id != new_trace("a", "bc").trace_id
+
+
+def test_child_keeps_trace_forks_span_deterministically():
+    root = new_trace("c0", 0, 0)
+    child = root.child("decode")
+    assert child.trace_id == root.trace_id
+    assert child.span_id != root.span_id
+    assert root.child("decode") == child  # re-processing re-derives, not forks
+
+
+def test_parse_is_lenient_never_raises():
+    assert parse_trace(None) is None
+    assert parse_trace("") is None
+    assert parse_trace("not a trace") is None
+    assert parse_trace("00-short-deadbeefdeadbeef-01") is None
+    assert parse_trace("00-" + "g" * 32 + "-" + "a" * 16 + "-01") is None
+    assert parse_trace("00-" + "a" * 32 + "-" + "b" * 16) is None  # 3 fields
+    # A bare 32-hex trace id is accepted (degraded clients).
+    bare = parse_trace("A" * 32)
+    assert bare is not None and bare.trace_id == "a" * 32
+
+
+def test_flight_recorder_ring_is_bounded():
+    rec = FlightRecorder(capacity=4, name="t")
+    for i in range(10):
+        rec.note("tick", i=i)
+    events = rec.snapshot()
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]  # newest survive
+    assert all("t_mono" in e and "t_wall" in e for e in events)
+
+
+def test_flight_recorder_explicit_t_mono_overrides_stamp():
+    # The harness notes first_progress RETROACTIVELY by mapping a wall stamp
+    # onto the monotonic axis — the explicit kwarg must win over the auto one.
+    rec = FlightRecorder(capacity=8)
+    mark = rec.note("first_progress", t_mono=123.456)
+    assert mark["t_mono"] == 123.456
+    assert rec.snapshot()[-1]["t_mono"] == 123.456
+
+
+def test_dump_creates_parents_and_reports_drops(tmp_path):
+    rec = FlightRecorder(capacity=2, name="supervisor")
+    for i in range(5):
+        rec.note("tick", i=i)
+    out = rec.dump(tmp_path / "deep" / "nested" / "flight_recorder.json",
+                   extra={"victim": 1})
+    assert out is not None and out.exists()
+    doc = json.loads(out.read_text())
+    assert doc["recorder"] == "supervisor"
+    assert doc["events_dropped"] == 3
+    assert doc["victim"] == 1
+    assert [e["i"] for e in doc["events"]] == [3, 4]
+
+
+def test_dump_never_raises(tmp_path):
+    # Dump runs inside the supervisor's reap path: any failure must come back
+    # as None, never as an exception that would abort the recovery.
+    blocker = tmp_path / "file"
+    blocker.write_text("not a directory")
+    rec = FlightRecorder(capacity=2)
+    rec.note("tick")
+    assert rec.dump(blocker / "sub" / "flight_recorder.json") is None
+
+
+def test_mttr_decomposition_phases_and_partial_recovery():
+    events = [
+        {"kind": "kill_detected", "t_mono": 10.0},
+        {"kind": "reaped", "t_mono": 10.5},
+        {"kind": "reaped", "t_mono": 99.0},  # re-noted marks must not stretch
+        {"kind": "respawned", "t_mono": 11.0},
+        {"kind": "first_progress", "t_mono": 14.0},
+    ]
+    sequence = [
+        ("kill_detected", None),
+        ("reaped", "reap"),
+        ("respawned", "respawn"),
+        ("ready", "bring_up"),  # absent mark: phase skipped, chain continues
+        ("first_progress", "recompile"),
+    ]
+    phases = mttr_decomposition(events, sequence)
+    assert phases == {"reap": 0.5, "respawn": 0.5, "recompile": 3.0}
+    assert mttr_decomposition([], sequence) == {}
